@@ -1,0 +1,56 @@
+"""
+magicsoup_tpu — a TPU-native framework for simulating cell metabolic and
+transduction pathway evolution, with the capabilities of
+mRcSchwering/magic-soup re-designed for JAX/XLA on TPU.
+
+Cells live on a 2D torus map; their string genomes deterministically encode
+proteomes whose catalytic/transporter/regulatory domains drive a reversible
+Michaelis-Menten integrator over molecule concentrations.  Users create
+evolutionary pressure by selectively killing and dividing cells between
+steps.  The numeric core runs as fused XLA programs over fixed-capacity
+HBM-resident tensors; genome string work runs in a multithreaded C++ engine
+(with a pure-Python fallback); sharding utilities in
+:mod:`magicsoup_tpu.parallel` scale the world across a TPU mesh.
+"""
+from magicsoup_tpu.containers import (
+    CatalyticDomain,
+    Cell,
+    Chemistry,
+    DomainType,
+    Molecule,
+    Protein,
+    RegulatoryDomain,
+    TransporterDomain,
+)
+from magicsoup_tpu.factories import (
+    CatalyticDomainFact,
+    GenomeFact,
+    RegulatoryDomainFact,
+    TransporterDomainFact,
+)
+from magicsoup_tpu.genetics import Genetics
+from magicsoup_tpu.kinetics import Kinetics
+from magicsoup_tpu.mutations import point_mutations, recombinations
+from magicsoup_tpu.world import World
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CatalyticDomain",
+    "CatalyticDomainFact",
+    "Cell",
+    "Chemistry",
+    "DomainType",
+    "Genetics",
+    "GenomeFact",
+    "Kinetics",
+    "Molecule",
+    "Protein",
+    "RegulatoryDomain",
+    "RegulatoryDomainFact",
+    "TransporterDomain",
+    "TransporterDomainFact",
+    "World",
+    "point_mutations",
+    "recombinations",
+]

@@ -1,0 +1,569 @@
+"""
+Value objects describing the simulated chemistry and interpreted cell state:
+:class:`Molecule`, :class:`Chemistry`, the three domain views
+(:class:`CatalyticDomain`, :class:`TransporterDomain`,
+:class:`RegulatoryDomain`), :class:`Protein` and :class:`Cell`.
+
+Parity reference: `python/magicsoup/containers.py` — the same registry
+semantics (process-global molecule interning, attribute-mismatch errors,
+pickle round-trip via ``__getnewargs__``), dict round-trips with the
+"C"/"T"/"R" type tags, and lazily computed :class:`Cell` views.
+"""
+import warnings
+from collections import Counter
+from typing import Protocol, TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from magicsoup_tpu.world import World
+
+
+class Molecule:
+    """
+    A molecule species of the simulated world.
+
+    Parameters:
+        name: Unique identifier of this molecule species.
+        energy: Energy for 1 mol of this molecule species (in J).
+        half_life: Half life in time steps (see ``World.degrade_molecules``).
+        diffusivity: How fast the species diffuses over the molecule map per
+            step; the ratio a/b of molecules moving to each of the 8 Moore
+            neighbors (a) vs. staying on the pixel (b).  1.0 spreads the pixel
+            evenly over its 3x3 neighborhood in one step.
+        permeability: How fast the species permeates cell membranes per step;
+            the ratio of molecules permeating into the cell vs. staying
+            outside.  1.0 equilibrates cell and pixel in one step.
+
+    Molecules are interned process-wide by name: constructing a second
+    instance with the same name returns the first instance, and mismatching
+    attributes raise a ``ValueError``
+    (reference: `containers.py:91-132`).  Use
+    :meth:`Molecule.from_name` to look up an existing species.
+
+    Default units: mM for concentrations, s per time step, J/mol for energy.
+    """
+
+    _instances: dict[str, "Molecule"] = {}
+
+    _attrs = ("energy", "half_life", "diffusivity", "permeability")
+
+    def __new__(
+        cls,
+        name: str,
+        energy: float,
+        half_life: int = 100_000,
+        diffusivity: float = 0.1,
+        permeability: float = 0.0,
+    ):
+        if name in cls._instances:
+            prev = cls._instances[name]
+            new_vals = {
+                "energy": energy,
+                "half_life": half_life,
+                "diffusivity": diffusivity,
+                "permeability": permeability,
+            }
+            for key, val in new_vals.items():
+                old = getattr(prev, key)
+                if old != val:
+                    raise ValueError(
+                        f"Trying to instantiate Molecule {name} with {key} {val}."
+                        f" But {name} already exists with {key} {old}"
+                    )
+        else:
+            lowered = name.lower()
+            similar = [k for k in cls._instances if k.lower() == lowered]
+            if similar:
+                warnings.warn(
+                    f"Creating new molecule {name}. There are molecules with"
+                    f" similar names: {', '.join(similar)}. Give them identical"
+                    " names if these are the same molecules."
+                )
+            cls._instances[name] = super().__new__(cls)
+        return cls._instances[name]
+
+    @classmethod
+    def from_name(cls, name: str) -> "Molecule":
+        """Get Molecule instance from its name (if already defined)"""
+        if name not in cls._instances:
+            raise ValueError(f"Molecule {name} was not defined yet")
+        return cls._instances[name]
+
+    def __getnewargs__(self):
+        # so pickle can restore interned instances
+        return (
+            self.name,
+            self.energy,
+            self.half_life,
+            self.diffusivity,
+            self.permeability,
+        )
+
+    def __init__(
+        self,
+        name: str,
+        energy: float,
+        half_life: int = 100_000,
+        diffusivity: float = 0.1,
+        permeability: float = 0.0,
+    ):
+        self.name = name
+        self.energy = float(energy)  # int would break kinetics energy tensor
+        self.half_life = half_life
+        self.diffusivity = diffusivity
+        self.permeability = permeability
+        self._hash = hash(self.name)
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __lt__(self, other: "Molecule") -> bool:
+        return self.name < other.name
+
+    def __eq__(self, other) -> bool:
+        return hash(self) == hash(other)
+
+    def __repr__(self) -> str:
+        kwargs = {
+            "name": self.name,
+            "energy": self.energy,
+            "half_life": self.half_life,
+            "diffusivity": self.diffusivity,
+            "permeability": self.permeability,
+        }
+        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
+        return f"{type(self).__name__}({','.join(args)})"
+
+    def __str__(self) -> str:
+        return self.name
+
+
+class Chemistry:
+    """
+    The molecules and reactions available in a simulation.
+
+    Parameters:
+        molecules: All :class:`Molecule` species of this simulation.
+        reactions: Possible reactions as tuples ``(substrates, products)``,
+            both lists of :class:`Molecule`.  Every reaction can run in both
+            directions.  Stoichiometric coefficients > 1 are expressed by
+            listing a molecule multiple times.
+
+    Duplicate molecules and reactions are removed while preserving order;
+    reactions referencing undefined molecules raise
+    (reference: `containers.py:226-252`).  ``chemistry.mol_2_idx`` /
+    ``chemistry.molname_2_idx`` map molecules / names to their index — the
+    ordering used by every tensor in :class:`World`.  Two chemistries can be
+    combined with ``&``.
+    """
+
+    def __init__(
+        self,
+        molecules: list[Molecule],
+        reactions: list[tuple[list[Molecule], list[Molecule]]],
+    ):
+        self.molecules = list(dict.fromkeys(molecules))
+        keyed = [(tuple(sorted(s)), tuple(sorted(p))) for s, p in reactions]
+        unique = list(dict.fromkeys(keyed))
+        self.reactions = [(list(s), list(p)) for s, p in unique]
+
+        defined = set(molecules)
+        used: set[Molecule] = set()
+        for substrates, products in reactions:
+            used.update(substrates)
+            used.update(products)
+        if used > defined:
+            missing = ", ".join(str(d) for d in used - defined)
+            raise ValueError(
+                "These molecules were not defined but are part of some"
+                f" reactions: {missing}."
+                "Please define all molecules."
+            )
+
+        self.mol_2_idx = {d: i for i, d in enumerate(self.molecules)}
+        self.molname_2_idx = {d.name: i for i, d in enumerate(self.molecules)}
+
+    def __and__(self, other: "Chemistry") -> "Chemistry":
+        return Chemistry(
+            molecules=self.molecules + other.molecules,
+            reactions=self.reactions + other.reactions,
+        )
+
+    def __repr__(self) -> str:
+        kwargs = {"molecules": self.molecules, "reactions": self.reactions}
+        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
+        return f"{type(self).__name__}({','.join(args)})"
+
+
+class DomainType(Protocol):
+    """Protocol for interpreted domain views"""
+
+    start: int
+    end: int
+
+    def to_dict(self) -> dict:
+        ...
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "DomainType":
+        ...
+
+
+class CatalyticDomain:
+    """
+    Human-readable view of a translated catalytic domain.
+
+    Parameters:
+        reaction: ``(substrates, products)`` of :class:`Molecule` lists.
+        km: Michaelis-Menten constant of the reaction (mM).
+        vmax: Maximum velocity of the reaction (mmol/s).
+        start: Domain start on the CDS (0-based, included).
+        end: Domain end on the CDS (excluded).
+
+    Not meant to be instantiated by users — obtained from ``cell.proteome``.
+    """
+
+    def __init__(
+        self,
+        reaction: tuple[list[Molecule], list[Molecule]],
+        km: float,
+        vmax: float,
+        start: int,
+        end: int,
+    ):
+        self.start = start
+        self.end = end
+        self.substrates, self.products = reaction
+        self.km = km
+        self.vmax = vmax
+
+    def to_dict(self) -> dict:
+        """Get dict representation of domain"""
+        spec = {
+            "reaction": (
+                [d.name for d in self.substrates],
+                [d.name for d in self.products],
+            ),
+            "km": self.km,
+            "vmax": self.vmax,
+            "start": self.start,
+            "end": self.end,
+        }
+        return {"type": "C", "spec": spec}
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "CatalyticDomain":
+        """Create instance from dict; molecules are given by name"""
+        lft, rgt = dct["reaction"]
+        return cls(
+            reaction=(
+                [Molecule.from_name(name=d) for d in lft],
+                [Molecule.from_name(name=d) for d in rgt],
+            ),
+            km=dct["km"],
+            vmax=dct["vmax"],
+            start=dct["start"],
+            end=dct["end"],
+        )
+
+    def __repr__(self) -> str:
+        ins = ",".join(str(d) for d in self.substrates)
+        outs = ",".join(str(d) for d in self.products)
+        return f"CatalyticDomain({ins}<->{outs},Km={self.km:.2e},Vmax={self.vmax:.2e})"
+
+    def __str__(self) -> str:
+        subs_cnts = Counter(str(d) for d in self.substrates)
+        prods_cnts = Counter(str(d) for d in self.products)
+        subs_str = " + ".join(f"{d} {k}" for k, d in subs_cnts.items())
+        prods_str = " + ".join(f"{d} {k}" for k, d in prods_cnts.items())
+        return f"{subs_str} <-> {prods_str} | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+
+
+class TransporterDomain:
+    """
+    Human-readable view of a translated transporter domain.
+
+    Parameters:
+        molecule: The transported :class:`Molecule`.
+        km: Michaelis-Menten constant of the transport (mM).
+        vmax: Maximum velocity of the transport (mmol/s).
+        is_exporter: Direction in which this domain couples energetically
+            with other domains of the same protein.
+        start: Domain start on the CDS.
+        end: Domain end on the CDS.
+    """
+
+    def __init__(
+        self,
+        molecule: Molecule,
+        km: float,
+        vmax: float,
+        is_exporter: bool,
+        start: int,
+        end: int,
+    ):
+        self.start = start
+        self.end = end
+        self.molecule = molecule
+        self.km = km
+        self.vmax = vmax
+        self.is_exporter = is_exporter
+
+    def to_dict(self) -> dict:
+        """Get dict representation of domain"""
+        spec = {
+            "molecule": self.molecule.name,
+            "km": self.km,
+            "vmax": self.vmax,
+            "is_exporter": self.is_exporter,
+            "start": self.start,
+            "end": self.end,
+        }
+        return {"type": "T", "spec": spec}
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "TransporterDomain":
+        """Create instance from dict; molecules are given by name"""
+        return cls(
+            molecule=Molecule.from_name(name=dct["molecule"]),
+            km=dct["km"],
+            vmax=dct["vmax"],
+            is_exporter=dct["is_exporter"],
+            start=dct["start"],
+            end=dct["end"],
+        )
+
+    def __repr__(self) -> str:
+        sign = "exporter" if self.is_exporter else "importer"
+        return (
+            f"TransporterDomain({self.molecule},Km={self.km:.2e},"
+            f"Vmax={self.vmax:.2e},{sign})"
+        )
+
+    def __str__(self) -> str:
+        sign = "exporter" if self.is_exporter else "importer"
+        return f"{self.molecule} {sign} | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+
+
+class RegulatoryDomain:
+    """
+    Human-readable view of a translated regulatory domain.
+
+    Parameters:
+        effector: Effector :class:`Molecule`.
+        hill: Hill coefficient (degree of cooperativity).
+        km: Ligand concentration producing half occupation (mM).
+        is_inhibiting: Whether the domain inhibits (otherwise activates).
+        is_transmembrane: If true the domain reacts to extracellular
+            molecules instead of intracellular ones.
+        start: Domain start on the CDS.
+        end: Domain end on the CDS.
+    """
+
+    def __init__(
+        self,
+        effector: Molecule,
+        hill: int,
+        km: float,
+        is_inhibiting: bool,
+        is_transmembrane: bool,
+        start: int,
+        end: int,
+    ):
+        self.start = start
+        self.end = end
+        self.effector = effector
+        self.km = km
+        self.hill = int(hill)
+        self.is_transmembrane = is_transmembrane
+        self.is_inhibiting = is_inhibiting
+
+    def to_dict(self) -> dict:
+        """Get dict representation of domain"""
+        spec = {
+            "effector": self.effector.name,
+            "km": self.km,
+            "hill": self.hill,
+            "is_inhibiting": self.is_inhibiting,
+            "is_transmembrane": self.is_transmembrane,
+            "start": self.start,
+            "end": self.end,
+        }
+        return {"type": "R", "spec": spec}
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "RegulatoryDomain":
+        """Create instance from dict; molecules are given by name"""
+        return cls(
+            effector=Molecule.from_name(name=dct["effector"]),
+            km=dct["km"],
+            hill=dct["hill"],
+            is_inhibiting=dct["is_inhibiting"],
+            is_transmembrane=dct["is_transmembrane"],
+            start=dct["start"],
+            end=dct["end"],
+        )
+
+    def __repr__(self) -> str:
+        loc = "transmembrane" if self.is_transmembrane else "cytosolic"
+        eff = "inhibiting" if self.is_inhibiting else "activating"
+        return f"ReceptorDomain({self.effector},Km={self.km:.2e},hill={self.hill},{loc},{eff})"
+
+    def __str__(self) -> str:
+        loc = "[e]" if self.is_transmembrane else "[i]"
+        post = "inhibitor" if self.is_inhibiting else "activator"
+        return f"{self.effector}{loc} {post} | Km {self.km:.2e} Hill {self.hill}"
+
+
+class Protein:
+    """
+    Human-readable view of a translated protein.
+
+    Parameters:
+        domains: Domain views of this protein.
+        cds_start: Start coordinate of its coding region.
+        cds_end: End coordinate of its coding region.
+        is_fwd: Whether the CDS lies on the forward or reverse-complement
+            strand; coordinates always follow the parsing direction, so a
+            reverse CDS maps back to 5'-3' coordinates as ``n - cds_start``.
+    """
+
+    def __init__(
+        self, domains: list[DomainType], cds_start: int, cds_end: int, is_fwd: bool
+    ):
+        self.domains = domains
+        self.n_domains = len(domains)
+        self.cds_start = cds_start
+        self.cds_end = cds_end
+        self.is_fwd = is_fwd
+
+    def to_dict(self) -> dict:
+        """Get dict representation of protein"""
+        return {
+            "domains": [d.to_dict() for d in self.domains],
+            "cds_start": self.cds_start,
+            "cds_end": self.cds_end,
+            "is_fwd": self.is_fwd,
+        }
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "Protein":
+        """
+        Create Protein instance from dict.  Domains are a list of dicts
+        ``{"type": t, "spec": {...}}`` with ``t`` one of ``"C"`` (catalytic),
+        ``"T"`` (transporter), ``"R"`` (regulatory).
+        """
+        type_map = {
+            "C": CatalyticDomain,
+            "T": TransporterDomain,
+            "R": RegulatoryDomain,
+        }
+        doms: list[DomainType] = []
+        for dom in dct["domains"]:
+            dom_cls = type_map.get(dom["type"])
+            if dom_cls is not None:
+                doms.append(dom_cls.from_dict(dom["spec"]))
+        return Protein(
+            cds_start=dct["cds_start"],
+            cds_end=dct["cds_end"],
+            is_fwd=dct["is_fwd"],
+            domains=doms,
+        )
+
+    def __repr__(self) -> str:
+        kwargs = {
+            "cds_start": self.cds_start,
+            "cds_end": self.cds_end,
+            "domains": self.domains,
+        }
+        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
+        return f"{type(self).__name__}({','.join(args)})"
+
+    def __str__(self) -> str:
+        domstrs = [str(d).split(" | ")[0] for d in self.domains]
+        return " | ".join(domstrs)
+
+
+class Cell:
+    """
+    Lazily-evaluated view of one cell and its environment.
+
+    Parameters:
+        world: Originating :class:`World`.
+        genome: Genome string of this cell.
+        position: Position ``(x, y)`` on the cell map.
+        idx: Current cell index.
+        label: Label of origin, used to track cells.
+        n_steps_alive: Steps this cell lived since its last division.
+        n_divisions: Number of times this cell's ancestors divided.
+        proteome: List of :class:`Protein` (computed lazily).
+        int_molecules: Intracellular concentrations (row of
+            ``world.cell_molecules``; computed lazily).
+        ext_molecules: Extracellular concentrations (pixel of
+            ``world.molecule_map``; computed lazily).
+
+    Obtained from ``World.get_cell()``; the proteome is re-translated from
+    the genome on first access (reference: `containers.py:697-705`).
+    """
+
+    def __init__(
+        self,
+        world: "World",
+        genome: str,
+        position: tuple[int, int] = (-1, -1),
+        idx: int = -1,
+        label: str = "C",
+        n_steps_alive: int = 0,
+        n_divisions: int = 0,
+        proteome: list[Protein] | None = None,
+        int_molecules: np.ndarray | None = None,
+        ext_molecules: np.ndarray | None = None,
+    ):
+        self.world = world
+        self.genome = genome
+        self.label = label
+        self.position = position
+        self.idx = idx
+        self.n_steps_alive = n_steps_alive
+        self.n_divisions = n_divisions
+        self._proteome = proteome
+        self._int_molecules = int_molecules
+        self._ext_molecules = ext_molecules
+
+    @property
+    def int_molecules(self) -> np.ndarray:
+        if self._int_molecules is None:
+            self._int_molecules = np.asarray(
+                self.world.cell_molecules[self.idx, :]
+            )
+        return self._int_molecules
+
+    @property
+    def ext_molecules(self) -> np.ndarray:
+        if self._ext_molecules is None:
+            x, y = self.position
+            self._ext_molecules = np.asarray(self.world.molecule_map[:, x, y])
+        return self._ext_molecules
+
+    @property
+    def proteome(self) -> list[Protein]:
+        if self._proteome is None:
+            (cdss,) = self.world.genetics.translate_genomes(genomes=[self.genome])
+            if len(cdss) > 0:
+                self._proteome = self.world.kinetics.get_proteome(proteome=cdss)
+            else:
+                self._proteome = []
+        return self._proteome
+
+    def __repr__(self) -> str:
+        kwargs = {
+            "genome": self.genome,
+            "position": self.position,
+            "idx": self.idx,
+            "label": self.label,
+            "n_steps_alive": self.n_steps_alive,
+            "n_divisions": self.n_divisions,
+        }
+        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
+        return f"{type(self).__name__}({','.join(args)})"

@@ -1,0 +1,439 @@
+"""
+Genome engineering: generate nucleotide sequences that encode a desired
+proteome (the inverse of translation).
+
+Parity reference: `python/magicsoup/factories.py:24-498` — each domain
+factory picks a random type-codon of its domain type and samples tokens via
+the Kinetics inverse maps (``closest_value`` for target Km/Vmax/hill);
+unspecified scalars become random non-stop codons; :class:`GenomeFact`
+validates the proteome, wraps each CDS in start/stop codons and pads with
+start/stop-free random sequence up to ``target_size``.
+
+Note: the reference's ``GenomeFact.from_dicts`` never appends the built
+domain lists and always yields an empty proteome (SURVEY.md §2 quirks);
+that bug is fixed here.
+"""
+import random
+from collections import Counter
+from typing import TYPE_CHECKING, Protocol
+
+from magicsoup_tpu.constants import CODON_SIZE
+from magicsoup_tpu.containers import Molecule
+from magicsoup_tpu.util import closest_value, random_genome, round_down
+
+if TYPE_CHECKING:
+    from magicsoup_tpu.world import World
+
+
+class DomainFactType(Protocol):
+    """Protocol for domain factories"""
+
+    def validate(self, world: "World"):
+        ...
+
+    def gen_coding_sequence(self, world: "World") -> str:
+        ...
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "DomainFactType":
+        ...
+
+
+def _scalar_codon(
+    world: "World",
+    inverse_map: dict,
+    target,
+    rng: random.Random,
+) -> str:
+    """Codon for a scalar token: closest mapped value to target, or a random
+    non-stop codon if no target given."""
+    genetics = world.genetics
+    if target is None:
+        return random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+    val = closest_value(values=inverse_map, key=target)
+    idx = rng.choice(inverse_map[val])
+    return genetics.idx_2_one_codon[idx]
+
+
+class CatalyticDomainFact:
+    """
+    Factory generating nucleotide sequences encoding a catalytic domain.
+
+    Arguments:
+        reaction: ``(substrates, products)`` tuple of the chemistry
+            reaction (stoichiometry > 1 = list the molecule repeatedly).
+        km: Target Michaelis-Menten constant (mM); closest mapped value is
+            used.  Random if ``None``.
+        vmax: Target maximum velocity (mM/s); closest mapped value is used.
+            Random if ``None``.
+    """
+
+    def __init__(
+        self,
+        reaction: tuple[list[Molecule], list[Molecule]],
+        km: float | None = None,
+        vmax: float | None = None,
+    ):
+        substrates, products = reaction
+        self.substrates = sorted(substrates)
+        self.products = sorted(products)
+        self.km = km
+        self.vmax = vmax
+
+    def validate(self, world: "World"):
+        """Validate this domain factory's attributes against the world"""
+        all_reacts = [
+            (tuple(sorted(s)), tuple(sorted(p))) for s, p in world.chemistry.reactions
+        ]
+        all_reacts.extend([(p, s) for s, p in all_reacts])
+        if (tuple(self.substrates), tuple(self.products)) not in all_reacts:
+            lft = " + ".join(d.name for d in self.substrates)
+            rgt = " + ".join(d.name for d in self.products)
+            raise ValueError(
+                f"CatalyticDomainFact has this reaction defined: {lft} <-> {rgt}."
+                " This world's chemistry doesn't define this reaction."
+            )
+
+    def gen_coding_sequence(self, world: "World") -> str:
+        """Generate a nucleotide sequence for this domain"""
+        # layout: type codons | Vmax codon | Km codon | direction codon |
+        # reaction 2-codon token
+        kinetics = world.kinetics
+        genetics = world.genetics
+        rng = world._rng
+        dom_seq = rng.choice(genetics.domain_types[1])
+        i0_seq = _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng)
+        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
+
+        react = (tuple(self.substrates), tuple(self.products))
+        is_fwd = True
+        if react not in kinetics.catal_2_idxs:
+            react = (tuple(self.products), tuple(self.substrates))
+            is_fwd = False
+        i2 = rng.choice(kinetics.sign_2_idxs[is_fwd])
+        i2_seq = genetics.idx_2_one_codon[i2]
+        i3 = rng.choice(kinetics.catal_2_idxs[react])
+        i3_seq = genetics.idx_2_two_codon[i3]
+        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "CatalyticDomainFact":
+        """Create from a domain dict (``CatalyticDomain.to_dict()``)"""
+        dct = dct["spec"]
+        subs, prods = dct["reaction"]
+        reaction = (
+            [Molecule.from_name(d) for d in subs],
+            [Molecule.from_name(d) for d in prods],
+        )
+        return cls(reaction=reaction, km=dct.get("km"), vmax=dct.get("vmax"))
+
+    def __repr__(self) -> str:
+        ins = ",".join(str(d) for d in self.substrates)
+        outs = ",".join(str(d) for d in self.products)
+        args = [f"{ins}<->{outs}"]
+        if self.km is not None:
+            args.append(f"Km={self.km:.2e}")
+        if self.vmax is not None:
+            args.append(f"Vmax={self.vmax:.2e}")
+        return f"CatalyticDomain({','.join(args)})"
+
+    def __str__(self) -> str:
+        subs_cnts = Counter(str(d) for d in self.substrates)
+        prods_cnts = Counter(str(d) for d in self.products)
+        subs_str = " + ".join(f"{d} {k}" for k, d in subs_cnts.items())
+        prods_str = " + ".join(f"{d} {k}" for k, d in prods_cnts.items())
+        optargs = []
+        if self.km is not None:
+            optargs.append(f"Km {self.km:.2e}")
+        if self.vmax is not None:
+            optargs.append(f"Vmax {self.vmax:.2e}")
+        args = f"{subs_str} <-> {prods_str}"
+        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+
+
+class TransporterDomainFact:
+    """
+    Factory generating nucleotide sequences encoding a transporter domain.
+
+    Arguments:
+        molecule: The molecule species to be transported.
+        km: Target Michaelis-Menten constant (mM); random if ``None``.
+        vmax: Target maximum velocity (mM/s); random if ``None``.
+        is_exporter: Energetic coupling direction; random if ``None``.
+    """
+
+    def __init__(
+        self,
+        molecule: Molecule,
+        km: float | None = None,
+        vmax: float | None = None,
+        is_exporter: bool | None = None,
+    ):
+        self.molecule = molecule
+        self.km = km
+        self.vmax = vmax
+        self.is_exporter = is_exporter
+
+    def validate(self, world: "World"):
+        """Validate this domain factory's attributes against the world"""
+        if self.molecule not in world.chemistry.molecules:
+            raise ValueError(
+                f"TransporterDomainFact has this molecule defined: {self.molecule}."
+                " This world's chemistry doesn't define this molecule species."
+            )
+
+    def gen_coding_sequence(self, world: "World") -> str:
+        """Generate a nucleotide sequence for this domain"""
+        kinetics = world.kinetics
+        genetics = world.genetics
+        rng = world._rng
+        dom_seq = rng.choice(genetics.domain_types[2])
+        i0_seq = _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng)
+        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
+
+        if self.is_exporter is not None:
+            i2 = rng.choice(kinetics.sign_2_idxs[self.is_exporter])
+            i2_seq = genetics.idx_2_one_codon[i2]
+        else:
+            i2_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+
+        i3 = rng.choice(kinetics.trnsp_2_idxs[self.molecule])
+        i3_seq = genetics.idx_2_two_codon[i3]
+        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "TransporterDomainFact":
+        """Create from a domain dict (``TransporterDomain.to_dict()``)"""
+        dct = dct["spec"]
+        return cls(
+            molecule=Molecule.from_name(dct["molecule"]),
+            km=dct.get("km"),
+            vmax=dct.get("vmax"),
+            is_exporter=dct.get("is_exporter"),
+        )
+
+    def __repr__(self) -> str:
+        args = [str(self.molecule)]
+        if self.km is not None:
+            args.append(f"Km={self.km:.2e}")
+        if self.vmax is not None:
+            args.append(f"Vmax={self.vmax:.2e}")
+        if self.is_exporter is not None:
+            args.append("exporter" if self.is_exporter else "importer")
+        return f"TransporterDomain({','.join(args)})"
+
+    def __str__(self) -> str:
+        optargs = []
+        if self.km is not None:
+            optargs.append(f"Km {self.km:.2e}")
+        if self.vmax is not None:
+            optargs.append(f"Vmax {self.vmax:.2e}")
+        sign = "transporter"
+        if self.is_exporter is not None:
+            sign = "exporter" if self.is_exporter else "importer"
+        args = f"{self.molecule} {sign}"
+        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+
+
+class RegulatoryDomainFact:
+    """
+    Factory generating nucleotide sequences encoding a regulatory domain.
+
+    Arguments:
+        effector: Effector molecule species.
+        is_transmembrane: React to extracellular instead of intracellular
+            effector concentrations.
+        is_inhibiting: Inhibiting vs. activating; random if ``None``.
+        km: Target ligand concentration of half occupation (mM); random if
+            ``None``.
+        hill: Target hill coefficient (1, 3, 5 available); random if
+            ``None``.
+    """
+
+    def __init__(
+        self,
+        effector: Molecule,
+        is_transmembrane: bool,
+        is_inhibiting: bool | None = None,
+        km: float | None = None,
+        hill: int | None = None,
+    ):
+        self.effector = effector
+        self.is_transmembrane = is_transmembrane
+        self.is_inhibiting = is_inhibiting
+        self.km = km
+        self.hill = hill
+
+    def validate(self, world: "World"):
+        """Validate this domain factory's attributes against the world"""
+        if self.effector not in world.chemistry.molecules:
+            raise ValueError(
+                f"RegulatoryDomainFact has this effector defined: {self.effector}."
+                " This world's chemistry doesn't define this molecule species."
+            )
+
+    def gen_coding_sequence(self, world: "World") -> str:
+        """Generate a nucleotide sequence for this domain"""
+        kinetics = world.kinetics
+        genetics = world.genetics
+        rng = world._rng
+        dom_seq = rng.choice(genetics.domain_types[3])
+
+        if self.hill is not None:
+            val = closest_value(values=kinetics.hill_2_idxs, key=self.hill)
+            i0 = rng.choice(kinetics.hill_2_idxs[int(val)])
+            i0_seq = genetics.idx_2_one_codon[i0]
+        else:
+            i0_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+
+        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
+
+        if self.is_inhibiting is not None:
+            i2 = rng.choice(kinetics.sign_2_idxs[not self.is_inhibiting])
+            i2_seq = genetics.idx_2_one_codon[i2]
+        else:
+            i2_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+
+        i3 = rng.choice(kinetics.regul_2_idxs[(self.effector, self.is_transmembrane)])
+        i3_seq = genetics.idx_2_two_codon[i3]
+        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+
+    @classmethod
+    def from_dict(cls, dct: dict) -> "RegulatoryDomainFact":
+        """Create from a domain dict (``RegulatoryDomain.to_dict()``)"""
+        dct = dct["spec"]
+        return cls(
+            effector=Molecule.from_name(dct["effector"]),
+            km=dct["km"],
+            hill=dct.get("hill"),
+            is_inhibiting=dct.get("is_inhibiting"),
+            is_transmembrane=dct["is_transmembrane"],
+        )
+
+    def __repr__(self) -> str:
+        args = [f"{self.effector}"]
+        if self.km is not None:
+            args.append(f"Km={self.km:.2e}")
+        if self.hill is not None:
+            args.append(f"hill={self.hill}")
+        args.append("transmembrane" if self.is_transmembrane else "cytosolic")
+        if self.is_inhibiting is not None:
+            args.append("inhibiting" if self.is_inhibiting else "activating")
+        return f"ReceptorDomain({','.join(args)})"
+
+    def __str__(self) -> str:
+        loc = "[e]" if self.is_transmembrane else "[i]"
+        eff = "effector"
+        if self.is_inhibiting is not None:
+            eff = " inhibitor" if self.is_inhibiting else " activator"
+        args = f"{self.effector}{loc} {eff}"
+        optargs = []
+        if self.km is not None:
+            optargs.append(f"Km {self.km:.2e}")
+        if self.hill is not None:
+            optargs.append(f"Hill {self.hill}")
+        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+
+
+class GenomeFact:
+    """
+    Factory for generating genomes that translate into a desired proteome.
+
+    Arguments:
+        world: :class:`World` in which the genome will be used.
+        proteome: Desired proteome as a list (proteins) of lists of domain
+            factories.
+        target_size: Optional genome size; padded with start/stop-free
+            random sequence.  Smallest possible size if ``None``.
+
+    The generated genome always encodes the desired proteins, but larger
+    genomes may also encode additional proteins in other reading frames or
+    on the reverse-complement.
+    """
+
+    def __init__(
+        self,
+        world: "World",
+        proteome: list[list[DomainFactType]],
+        target_size: int | None = None,
+    ):
+        self.world = world
+        self.proteome = proteome
+
+        try:
+            _ = iter(proteome)
+        except TypeError as err:
+            raise ValueError(
+                "Proteome must be a list of lists representing domains in proteins."
+            ) from err
+        for pi, prot in enumerate(proteome):
+            try:
+                _ = iter(prot)
+            except TypeError as err:
+                raise ValueError(
+                    "Proteome must be a list of lists representing domains in proteins."
+                    f" Element {pi} of proteome is not iterable."
+                ) from err
+        for prot in proteome:
+            for dom in prot:
+                dom.validate(world=world)
+
+        self.req_nts = sum(
+            self.world.genetics.dom_size * len(d) + 2 * CODON_SIZE
+            for d in self.proteome
+        )
+        self.target_size = self.req_nts if target_size is None else target_size
+        if self.req_nts > self.target_size:
+            raise ValueError(
+                "Genome size too small."
+                f" The given proteome would require at least {self.req_nts} nucleotides."
+                f" But the given genome target size is target_size={self.target_size}."
+            )
+
+    def generate(self) -> str:
+        """Generate a genome with the desired proteome"""
+        rng = self.world._rng
+        cdss = [
+            [d.gen_coding_sequence(world=self.world) for d in p] for p in self.proteome
+        ]
+        n_pads = len(cdss) + 1
+        n_pad_nts = self.target_size - self.req_nts
+        pad_size = round_down(n_pad_nts / n_pads, to=1)
+        remaining_nts = n_pad_nts - n_pads * pad_size
+
+        start_codons = self.world.genetics.start_codons
+        stop_codons = self.world.genetics.stop_codons
+        excl_cdss = start_codons + stop_codons
+        pads = [random_genome(s=pad_size, excl=excl_cdss, rng=rng) for _ in range(n_pads)]
+        tail = random_genome(s=remaining_nts, excl=excl_cdss, rng=rng)
+
+        parts: list[str] = []
+        for cds in cdss:
+            parts.append(pads.pop())
+            parts.append(rng.choice(start_codons))
+            parts.extend(cds)
+            parts.append(rng.choice(stop_codons))
+        parts.append(pads.pop())
+        parts.append(tail)
+        return "".join(parts)
+
+    @classmethod
+    def from_dicts(cls, dcts: list[dict], world: "World") -> "GenomeFact":
+        """
+        Create a genome factory from protein dict representations
+        (``Protein.to_dict()``).
+        """
+        prots: list[list[DomainFactType]] = []
+        fact_types = {
+            "C": CatalyticDomainFact,
+            "T": TransporterDomainFact,
+            "R": RegulatoryDomainFact,
+        }
+        for prot_dct in dcts:
+            doms: list[DomainFactType] = []
+            for dom_dct in prot_dct["domains"]:
+                fact = fact_types.get(dom_dct["type"])
+                if fact is not None:
+                    doms.append(fact.from_dict(dom_dct))
+            prots.append(doms)
+        return GenomeFact(proteome=prots, world=world)

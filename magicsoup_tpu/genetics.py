@@ -1,0 +1,193 @@
+"""
+Codon machinery and genome -> proteome translation.
+
+Parity reference: `python/magicsoup/genetics.py:18-178`.  Same defaults
+(start codons TTG/GTG/ATG, stop codons TGA/TAG/TAA, 2 domain-type codons +
+3 one-codon scalar tokens + 1 two-codon vector token => 21-nt domains) and
+the same token-map construction: all 2-codon sequences not containing a
+start codon are shuffled and fractions assigned to the three domain types.
+
+TPU-first deltas:
+- explicit ``seed`` — the reference draws its genotype->phenotype mapping
+  from the global `random` module and is unreproducible across instances
+  (SURVEY.md §2 quirks); here the shuffle is driven by a private
+  ``random.Random(seed)``.
+- translation is engine-backed (C++/OpenMP or pure-Python fallback,
+  :mod:`magicsoup_tpu.native`) and primarily returns *flat numpy index
+  buffers* that feed the jitted cell-parameter assembly directly; the
+  reference's nested-list format is still available through
+  :meth:`Genetics.translate_genomes` for interpretation APIs.
+"""
+import random
+import warnings
+
+import numpy as np
+
+from magicsoup_tpu.constants import CODON_SIZE, ProteinSpecType
+from magicsoup_tpu.native import TranslationTables, translate_genomes_flat
+from magicsoup_tpu.util import codons
+
+
+def _get_n(p: float, s: int, name: str) -> int:
+    n = int(p * s)
+    if n == 0 and p > 0.0:
+        warnings.warn(
+            f"There will be no {name}."
+            f" Increase dom_type_size to accomodate low probabilities of having {name}."
+        )
+    return n
+
+
+class Genetics:
+    """
+    Class holding logic about transcribing and translating nucleotide
+    sequences.
+
+    Arguments:
+        start_codons: Codons which start a coding sequence.
+        stop_codons: Codons which stop a coding sequence.
+        p_catal_dom: Chance of encountering a catalytic domain in a random
+            nucleotide sequence.
+        p_transp_dom: Chance of encountering a transporter domain in a random
+            nucleotide sequence.
+        p_reg_dom: Chance of encountering a regulatory domain in a random
+            nucleotide sequence.
+        n_dom_type_codons: Number of codons encoding the domain type.
+        seed: Seed for the token-map shuffle (genotype->phenotype mapping).
+
+    A CDS starts at every start codon and ends with the first in-frame stop
+    codon; un-stopped CDSs are discarded; both strands are considered.  Each
+    CDS is one protein; every matched domain-type sequence inside it adds a
+    domain (see `docs/mechanics.md:22-28` of the reference).
+    """
+
+    def __init__(
+        self,
+        start_codons: tuple[str, ...] = ("TTG", "GTG", "ATG"),
+        stop_codons: tuple[str, ...] = ("TGA", "TAG", "TAA"),
+        p_catal_dom: float = 0.01,
+        p_transp_dom: float = 0.01,
+        p_reg_dom: float = 0.01,
+        n_dom_type_codons: int = 2,
+        seed: int | None = None,
+    ):
+        if any(len(d) != CODON_SIZE for d in start_codons):
+            raise ValueError(f"Not all start codons are of length {CODON_SIZE}")
+        if any(len(d) != CODON_SIZE for d in stop_codons):
+            raise ValueError(f"Not all stop codons are of length {CODON_SIZE}")
+        overlap = set(start_codons) & set(stop_codons)
+        if len(overlap) > 0:
+            raise ValueError(
+                "Overlapping start and stop codons:"
+                f" {','.join(str(d) for d in overlap)}"
+            )
+        if p_catal_dom + p_transp_dom + p_reg_dom > 1.0:
+            raise ValueError(
+                "p_catal_dom, p_transp_dom, p_reg_dom together must not be greater 1.0"
+            )
+
+        self.seed = seed
+        self.start_codons = list(start_codons)
+        self.stop_codons = list(stop_codons)
+
+        # domain structure: type codons + 3 x 1-codon + 1 x 2-codon tokens;
+        # a domain can end on the CDS-terminating stop codon, so the minimum
+        # CDS size equals dom_size
+        self.dom_size = (n_dom_type_codons + 5) * CODON_SIZE
+        self.dom_type_size = n_dom_type_codons * CODON_SIZE
+
+        # type sequences containing a start codon are excluded (they would
+        # open nested CDSs wherever a domain occurs)
+        rng = random.Random(seed)
+        sets = codons(n=n_dom_type_codons, excl_codons=self.start_codons)
+        rng.shuffle(sets)
+        n = len(sets)
+
+        n_catal_doms = _get_n(p=p_catal_dom, s=n, name="catalytic domains")
+        n_transp_doms = _get_n(p=p_transp_dom, s=n, name="transporter domains")
+        n_reg_doms = _get_n(p=p_reg_dom, s=n, name="allosteric domains")
+
+        # 1=catalytic, 2=transporter, 3=regulatory
+        self.domain_types: dict[int, list[str]] = {}
+        self.domain_types[1] = sets[:n_catal_doms]
+        del sets[:n_catal_doms]
+        self.domain_types[2] = sets[:n_transp_doms]
+        del sets[:n_transp_doms]
+        self.domain_types[3] = sets[:n_reg_doms]
+        del sets[:n_reg_doms]
+
+        self.domain_map = {d: k for k, v in self.domain_types.items() for d in v}
+
+        # premature stop codons cannot appear inside a CDS
+        self.one_codon_map = {d: i + 1 for i, d in enumerate(self._get_single_codons())}
+
+        # the second codon of a 2-codon token may be the CDS-final stop codon
+        self.two_codon_map = {d: i + 1 for i, d in enumerate(self._get_double_codons())}
+
+        # inverse maps for genome generation (factories)
+        self.idx_2_one_codon = {v: k for k, v in self.one_codon_map.items()}
+        self.idx_2_two_codon = {v: k for k, v in self.two_codon_map.items()}
+
+        # integer lookup tables for the genome engine
+        self.tables = TranslationTables(
+            start_codons=self.start_codons,
+            stop_codons=self.stop_codons,
+            domain_map=self.domain_map,
+            one_codon_map=self.one_codon_map,
+            two_codon_map=self.two_codon_map,
+            dom_size=self.dom_size,
+            dom_type_size=self.dom_type_size,
+        )
+
+    def translate_genomes_flat(
+        self, genomes: list[str]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """
+        Translate genomes into flat index buffers:
+        ``(prot_counts (g,), prots (P,4), doms (D,7))`` with protein rows
+        ``[cds_start, cds_end, is_fwd, n_doms]`` and domain rows
+        ``[dom_type, i0, i1, i2, i3, start, end]``.  This is the hot path
+        feeding :meth:`magicsoup_tpu.kinetics.Kinetics.set_cell_params`.
+        """
+        return translate_genomes_flat(genomes, self.tables)
+
+    def translate_genomes(self, genomes: list[str]) -> list[list[ProteinSpecType]]:
+        """
+        Translate all genomes into proteomes.
+
+        Returns a list (per genome) of lists (proteins) where each protein is
+        a tuple ``(domains, cds_start, cds_end, is_fwd)`` and each domain is
+        ``((dom_type, i0, i1, i2, i3), start, end)`` — the reference's nested
+        format (`genetics.py:124-168`), built from the engine's flat buffers.
+        """
+        if len(genomes) < 1:
+            return []
+        prot_counts, prots, doms = self.translate_genomes_flat(genomes)
+        out: list[list[ProteinSpecType]] = []
+        pi = 0
+        di = 0
+        for count in prot_counts.tolist():
+            proteome: list[ProteinSpecType] = []
+            for _ in range(count):
+                cds_start, cds_end, is_fwd, n_doms = prots[pi].tolist()
+                dom_specs = [
+                    (
+                        (int(dt), int(i0), int(i1), int(i2), int(i3)),
+                        int(start),
+                        int(end),
+                    )
+                    for dt, i0, i1, i2, i3, start, end in doms[di : di + n_doms].tolist()
+                ]
+                proteome.append((dom_specs, cds_start, cds_end, bool(is_fwd)))
+                pi += 1
+                di += n_doms
+            out.append(proteome)
+        return out
+
+    def _get_single_codons(self) -> list[str]:
+        seqs = codons(n=1)
+        return [d for d in seqs if d not in self.stop_codons]
+
+    def _get_double_codons(self) -> list[str]:
+        seqs = codons(n=2)
+        return [d for d in seqs if d[:CODON_SIZE] not in self.stop_codons]

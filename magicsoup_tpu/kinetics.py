@@ -1,0 +1,606 @@
+"""
+Protein kinetics: random genotype->phenotype token maps, the per-cell
+parameter tensors, and the signal integrator.
+
+Parity reference: `python/magicsoup/kinetics.py:292-992`.  Same state
+semantics — 9 tensors over (c cells, p proteins, s = 2 * n_molecules
+signals): ``Ke, Kmf, Kmb, Vmax`` (c,p) f32, ``Kmr`` (c,p,s) f32,
+``N, Nf, Nb, A`` (c,p,s) i32 — and the same token->parameter sampling
+distributions (Km/Vmax lognormal with rejection, signs 50/50, hill
+1..5 at 52/26/13/6/3%, uniformly-mapped reaction/transport/effector
+vectors, token 0 = empty).
+
+TPU-first deltas:
+- all tensors are jnp arrays at slot capacity; cells are rows, dead slots
+  are all-zero and inert (SURVEY.md §7 design delta 1)
+- parameter assembly consumes the genome engine's flat buffers through a
+  vectorized scatter + one jitted XLA program
+  (:mod:`magicsoup_tpu.ops.params`) instead of nested Python loops
+- ``integrate_signals`` is the jitted kernel in
+  :mod:`magicsoup_tpu.ops.integrate`
+- sampling is driven by an explicit seed (the reference draws from the
+  global `random` module and cannot be reproduced across instances)
+"""
+import math
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.constants import ProteinSpecType
+from magicsoup_tpu.containers import Chemistry, Molecule, Protein
+from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
+from magicsoup_tpu.ops.params import (
+    TokenTables,
+    compute_cell_params,
+    copy_params,
+    flat_to_dense,
+    pad_idxs,
+    pad_pow2,
+    permute_params,
+    scatter_params,
+    unset_params,
+)
+
+
+class _HillMapFact:
+    """Token -> 1,2,3,4,5 with chances 52/26/13/6/3% respectively"""
+
+    def __init__(self, rng: random.Random, max_token: int, zero_value: int = 0):
+        choices = [5] + 2 * [4] + 4 * [3] + 8 * [2] + 16 * [1]
+        self.numbers = np.array(
+            [zero_value] + rng.choices(choices, k=max_token), dtype=np.int32
+        )
+
+    def __call__(self, t: np.ndarray) -> np.ndarray:
+        return self.numbers[t]
+
+    def inverse(self) -> dict[int, list[int]]:
+        out = {}
+        for v in (1, 3, 5):
+            out[v] = np.argwhere(self.numbers == v).flatten().tolist()
+        return out
+
+
+class _LogNormWeightMapFact:
+    """Token -> float sampled from a range-rejected log-normal distribution"""
+
+    def __init__(
+        self,
+        rng: random.Random,
+        max_token: int,
+        weight_range: tuple[float, float],
+        zero_value: float = math.nan,
+    ):
+        min_w = min(weight_range)
+        max_w = max(weight_range)
+        l_min_w = math.log(min_w)
+        l_max_w = math.log(max_w)
+        mu = (l_min_w + l_max_w) / 2
+        sig = l_max_w - l_min_w
+        weights: list[float] = [zero_value]
+        for _ in range(max_token):
+            sample = math.exp(rng.gauss(mu, sig))
+            while not min_w <= sample <= max_w:
+                sample = math.exp(rng.gauss(mu, sig))
+            weights.append(sample)
+        self.weights = np.array(weights, dtype=np.float32)
+
+    def __call__(self, t: np.ndarray) -> np.ndarray:
+        return self.weights[t]
+
+    def inverse(self) -> dict[float, list[int]]:
+        out: dict[float, list[int]] = {}
+        for i in range(1, len(self.weights)):
+            out.setdefault(float(self.weights[i]), []).append(i)
+        return out
+
+
+class _SignMapFact:
+    """Token -> +1 or -1 with 50% probability each"""
+
+    def __init__(self, rng: random.Random, max_token: int, zero_value: int = 0):
+        self.signs = np.array(
+            [zero_value] + rng.choices([1, -1], k=max_token), dtype=np.int32
+        )
+
+    def __call__(self, t: np.ndarray) -> np.ndarray:
+        return self.signs[t]
+
+    def inverse(self) -> dict[bool, list[int]]:
+        return {
+            True: np.argwhere(self.signs == 1).flatten().tolist(),
+            False: np.argwhere(self.signs == -1).flatten().tolist(),
+        }
+
+
+class _VectorMapFact:
+    """Token -> one of a list of vectors, each mapped with equal frequency"""
+
+    def __init__(
+        self,
+        rng: random.Random,
+        max_token: int,
+        n_signals: int,
+        vectors: list[list[int]],
+        zero_value: int = 0,
+    ):
+        n_vectors = len(vectors)
+        M = np.full((max_token + 1, n_signals), zero_value, dtype=np.int32)
+
+        if n_vectors == 0:
+            self.M = M
+            return
+        if not all(len(d) == n_signals for d in vectors):
+            raise ValueError(f"Not all vectors have length of signal_size={n_signals}")
+        if n_vectors > max_token:
+            raise ValueError(
+                f"There are max_token={max_token} and {n_vectors} vectors."
+                " It is not possible to map all vectors"
+            )
+        for vector in vectors:
+            if all(d == 0 for d in vector):
+                raise ValueError(
+                    "At least one vector includes only zeros."
+                    " Each vector should contain at least one non-zero value."
+                )
+
+        idxs = rng.choices(range(n_vectors), k=max_token)
+        for row_i, idx in enumerate(idxs):
+            M[row_i + 1] = vectors[idx]
+        self.M = M
+
+    def __call__(self, t: np.ndarray) -> np.ndarray:
+        return self.M[t]
+
+
+class _ReactionMapFact(_VectorMapFact):
+    """Token -> signed stoichiometry vector of one reaction over 2n signals"""
+
+    def __init__(
+        self,
+        rng: random.Random,
+        molmap: dict[Molecule, int],
+        reactions: list[tuple[list[Molecule], list[Molecule]]],
+        max_token: int,
+        zero_value: int = 0,
+    ):
+        n_signals = 2 * len(molmap)
+        vectors = [[0] * n_signals for _ in range(len(reactions))]
+        for ri, (lft, rgt) in enumerate(reactions):
+            for mol in lft:
+                vectors[ri][molmap[mol]] -= 1
+            for mol in rgt:
+                vectors[ri][molmap[mol]] += 1
+        super().__init__(
+            rng=rng,
+            vectors=vectors,
+            n_signals=n_signals,
+            max_token=max_token,
+            zero_value=zero_value,
+        )
+
+    def inverse(
+        self,
+        molmap: dict[Molecule, int],
+        reactions: list[tuple[list[Molecule], list[Molecule]]],
+        n_signals: int,
+    ) -> dict[tuple[tuple[Molecule, ...], tuple[Molecule, ...]], list[int]]:
+        react_map = {}
+        for subs, prods in reactions:
+            t = np.zeros(n_signals, dtype=np.int32)
+            for sub in subs:
+                t[molmap[sub]] -= 1
+            for prod in prods:
+                t[molmap[prod]] += 1
+            idxs = np.argwhere((self.M == t).all(axis=1)).flatten().tolist()
+            react_map[(tuple(subs), tuple(prods))] = idxs
+        return react_map
+
+
+class _TransporterMapFact(_VectorMapFact):
+    """Token -> transport vector (-1 intracellular, +1 extracellular)"""
+
+    def __init__(
+        self,
+        rng: random.Random,
+        n_molecules: int,
+        max_token: int,
+        zero_value: int = 0,
+    ):
+        n_signals = 2 * n_molecules
+        vectors = [[0] * n_signals for _ in range(n_molecules)]
+        for mi in range(n_molecules):
+            vectors[mi][mi] = -1
+            vectors[mi][mi + n_molecules] = 1
+        super().__init__(
+            rng=rng,
+            vectors=vectors,
+            n_signals=n_signals,
+            max_token=max_token,
+            zero_value=zero_value,
+        )
+
+    def inverse(self, molecules: list[Molecule]) -> dict[Molecule, list[int]]:
+        return {
+            mol: np.argwhere(self.M[:, mi] != 0).flatten().tolist()
+            for mi, mol in enumerate(molecules)
+        }
+
+
+class _RegulatoryMapFact(_VectorMapFact):
+    """Token -> one-hot effector vector over 2n signals"""
+
+    def __init__(
+        self,
+        rng: random.Random,
+        n_molecules: int,
+        max_token: int,
+        zero_value: int = 0,
+    ):
+        n_signals = 2 * n_molecules
+        vectors = [[0] * n_signals for _ in range(n_signals)]
+        for mi in range(n_signals):
+            vectors[mi][mi] = 1
+        super().__init__(
+            rng=rng,
+            vectors=vectors,
+            n_signals=n_signals,
+            max_token=max_token,
+            zero_value=zero_value,
+        )
+
+    def inverse(
+        self, molecules: list[Molecule]
+    ) -> dict[tuple[Molecule, bool], list[int]]:
+        n = len(molecules)
+        reg_map = {}
+        for mi, mol in enumerate(molecules):
+            reg_map[(mol, False)] = np.argwhere(self.M[:, mi] != 0).flatten().tolist()
+            reg_map[(mol, True)] = (
+                np.argwhere(self.M[:, mi + n] != 0).flatten().tolist()
+            )
+        return reg_map
+
+
+class Kinetics:
+    """
+    Class holding the cell parameter tensors and the logic simulating
+    protein work.  Usually instantiated by :class:`World` — access it on
+    ``world.kinetics``.
+
+    Parameters:
+        chemistry: Simulation :class:`Chemistry`.
+        abs_temp: Absolute temperature (K); influences reaction equilibria.
+        km_range: Range for sampled Michaelis-Menten constants (mM).
+        vmax_range: Range for sampled maximum velocities (mM/s).
+        scalar_enc_size: Number of tokens encoding scalars (Vmax, Km, sign);
+            ``max(genetics.one_codon_map.values())``.
+        vector_enc_size: Number of tokens encoding vectors (reactions,
+            molecules); ``max(genetics.two_codon_map.values())``.
+        seed: Seed for the token->parameter sampling.
+
+    Cells are slot rows, proteins are ordered as translated; signals are
+    all intracellular molecules (chemistry order) then all extracellular
+    ones.  Dead/empty slots hold all-zero rows and do not react.
+    """
+
+    def __init__(
+        self,
+        chemistry: Chemistry,
+        abs_temp: float = 310.0,
+        km_range: tuple[float, float] = (1e-2, 100.0),
+        vmax_range: tuple[float, float] = (1e-3, 100.0),
+        scalar_enc_size: int = 64 - 3,
+        vector_enc_size: int = 4096 - 3 * 64,
+        seed: int | None = None,
+    ):
+        self.abs_temp = abs_temp
+        self.seed = seed
+        self.chemistry = chemistry
+        self.mol_names = [d.name for d in chemistry.molecules]
+        self.n_molecules = len(chemistry.molecules)
+        self.n_signals = 2 * self.n_molecules
+        mol_energies = np.array(
+            [d.energy for d in chemistry.molecules] * 2, dtype=np.float32
+        )
+
+        # sampling order follows the reference so distributions match
+        rng = random.Random(seed)
+        mol_2_mi = {d: i for i, d in enumerate(chemistry.molecules)}
+        self.km_map = _LogNormWeightMapFact(
+            rng=rng, max_token=scalar_enc_size, weight_range=km_range
+        )
+        self.vmax_map = _LogNormWeightMapFact(
+            rng=rng, max_token=scalar_enc_size, weight_range=vmax_range
+        )
+        self.sign_map = _SignMapFact(rng=rng, max_token=scalar_enc_size)
+        self.hill_map = _HillMapFact(rng=rng, max_token=scalar_enc_size)
+        self.reaction_map = _ReactionMapFact(
+            rng=rng,
+            molmap=mol_2_mi,
+            reactions=chemistry.reactions,
+            max_token=vector_enc_size,
+        )
+        self.transport_map = _TransporterMapFact(
+            rng=rng, n_molecules=self.n_molecules, max_token=vector_enc_size
+        )
+        self.effector_map = _RegulatoryMapFact(
+            rng=rng, n_molecules=self.n_molecules, max_token=vector_enc_size
+        )
+
+        # inverse maps for genome generation (factories)
+        self.km_2_idxs = self.km_map.inverse()
+        self.vmax_2_idxs = self.vmax_map.inverse()
+        self.sign_2_idxs = self.sign_map.inverse()
+        self.hill_2_idxs = self.hill_map.inverse()
+        self.trnsp_2_idxs = self.transport_map.inverse(molecules=chemistry.molecules)
+        self.regul_2_idxs = self.effector_map.inverse(molecules=chemistry.molecules)
+        self.catal_2_idxs = self.reaction_map.inverse(
+            molmap=mol_2_mi, reactions=chemistry.reactions, n_signals=self.n_signals
+        )
+
+        # device-side token tables consumed by the jitted assembly
+        self.tables = TokenTables(
+            km_weights=jnp.asarray(self.km_map.weights),
+            vmax_weights=jnp.asarray(self.vmax_map.weights),
+            signs=jnp.asarray(self.sign_map.signs),
+            hills=jnp.asarray(self.hill_map.numbers),
+            reactions=jnp.asarray(self.reaction_map.M),
+            transports=jnp.asarray(self.transport_map.M),
+            effectors=jnp.asarray(self.effector_map.M),
+            mol_energies=jnp.asarray(mol_energies),
+        )
+        self._abs_temp_arr = jnp.asarray(abs_temp, dtype=jnp.float32)
+
+        self.max_cells = 0
+        self.max_proteins = 0
+        self.params = self._alloc(0, 0)
+
+    # ------------------------------------------------------------------ #
+    # capacity management                                                #
+    # ------------------------------------------------------------------ #
+
+    def _alloc(self, c: int, p: int) -> CellParams:
+        s = self.n_signals
+        f32 = lambda *shape: jnp.zeros(shape, dtype=jnp.float32)  # noqa: E731
+        i32 = lambda *shape: jnp.zeros(shape, dtype=jnp.int32)  # noqa: E731
+        return CellParams(
+            Ke=f32(c, p),
+            Kmf=f32(c, p),
+            Kmb=f32(c, p),
+            Kmr=f32(c, p, s),
+            Vmax=f32(c, p),
+            N=i32(c, p, s),
+            Nf=i32(c, p, s),
+            Nb=i32(c, p, s),
+            A=i32(c, p, s),
+        )
+
+    def _resize(self, c: int, p: int):
+        old = self.params
+        new = self._alloc(c, p)
+        oc = min(self.max_cells, c)
+        op = min(self.max_proteins, p)
+        if oc > 0 and op > 0:
+            new = CellParams(
+                *(n.at[:oc, :op].set(o[:oc, :op]) for n, o in zip(new, old))
+            )
+        self.params = new
+        self.max_cells = c
+        self.max_proteins = p
+
+    def ensure_capacity(self, n_cells: int | None = None, n_proteins: int | None = None):
+        """Grow slot capacity (cells and/or proteins); never shrinks."""
+        c = max(self.max_cells, n_cells or 0)
+        p = max(self.max_proteins, n_proteins or 0)
+        if c != self.max_cells or p != self.max_proteins:
+            self._resize(c, p)
+
+    def increase_max_cells(self, by_n: int):
+        """Increase the cell dimension of all parameter tensors"""
+        self.ensure_capacity(n_cells=self.max_cells + by_n)
+
+    def increase_max_proteins(self, max_n: int):
+        """Ensure at least ``max_n`` rows in the protein dimension"""
+        self.ensure_capacity(n_proteins=max_n)
+
+    # ------------------------------------------------------------------ #
+    # parameter assembly                                                 #
+    # ------------------------------------------------------------------ #
+
+    def set_cell_params_flat(
+        self,
+        cell_idxs: np.ndarray | list[int],
+        prot_counts: np.ndarray,
+        prots: np.ndarray,
+        doms: np.ndarray,
+    ):
+        """
+        Translate flat genome-engine buffers into kinetic parameters and
+        write them to the given cell slots — the hot path of
+        spawn/update/mutate (reference: kinetics.py:521-625 + the Python
+        loop it replaces at kinetics.py:920-970).
+        """
+        cell_idxs = np.asarray(cell_idxs, dtype=np.int32)
+        b = len(cell_idxs)
+        if b == 0:
+            return
+        max_prots = int(prot_counts.max()) if len(prot_counts) else 0
+        if max_prots > self.max_proteins:
+            self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
+        dense, _ = flat_to_dense(
+            prot_counts, prots, doms, n_prots_cap=self.max_proteins
+        )
+        b_pad = pad_pow2(b)
+        dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=np.int32)
+        dense_pad[:b] = dense
+        idxs = pad_idxs(cell_idxs, oob=self.max_cells)
+        batch = compute_cell_params(
+            jnp.asarray(dense_pad), self.tables, self._abs_temp_arr
+        )
+        self.params = scatter_params(self.params, batch, jnp.asarray(idxs))
+
+    def set_cell_params(
+        self,
+        cell_idxs: list[int],
+        proteomes: list[list[ProteinSpecType]],
+    ):
+        """
+        Set cell parameters from nested proteome specifications (the
+        reference's API shape, `kinetics.py:521-538`).  ``proteomes`` come
+        from :meth:`Genetics.translate_genomes`.
+        """
+        prot_counts = np.array([len(p) for p in proteomes], dtype=np.int32)
+        prot_rows = []
+        dom_rows = []
+        for proteome in proteomes:
+            for doms, cds_start, cds_end, is_fwd in proteome:
+                prot_rows.append([cds_start, cds_end, int(is_fwd), len(doms)])
+                for (dt, i0, i1, i2, i3), start, end in doms:
+                    dom_rows.append([dt, i0, i1, i2, i3, start, end])
+        prots = np.array(prot_rows, dtype=np.int32).reshape(-1, 4)
+        doms_arr = np.array(dom_rows, dtype=np.int32).reshape(-1, 7)
+        self.set_cell_params_flat(cell_idxs, prot_counts, prots, doms_arr)
+
+    def unset_cell_params(self, cell_idxs: np.ndarray | list[int]):
+        """Zero the parameter rows of the given cell slots"""
+        cell_idxs = np.asarray(cell_idxs, dtype=np.int32)
+        if len(cell_idxs) == 0:
+            return
+        idxs = pad_idxs(cell_idxs, oob=self.max_cells)
+        self.params = unset_params(self.params, jnp.asarray(idxs))
+
+    def copy_cell_params(
+        self, from_idxs: np.ndarray | list[int], to_idxs: np.ndarray | list[int]
+    ):
+        """Copy parameter rows between cell slots (same-length index lists)"""
+        from_idxs = np.asarray(from_idxs, dtype=np.int32)
+        to_idxs = np.asarray(to_idxs, dtype=np.int32)
+        if len(from_idxs) == 0:
+            return
+        f = pad_idxs(from_idxs, oob=self.max_cells)
+        t = pad_idxs(to_idxs, oob=self.max_cells)
+        self.params = copy_params(self.params, jnp.asarray(f), jnp.asarray(t))
+
+    def remove_cell_params(self, keep: np.ndarray):
+        """
+        Compact cell slots down to the kept ones, preserving order — the
+        kept rows move to the front, freed rows are zeroed.  ``keep`` is a
+        bool array over all slots.
+        """
+        keep = np.asarray(keep, dtype=bool)
+        perm = np.concatenate([np.nonzero(keep)[0], np.nonzero(~keep)[0]])
+        n_keep = int(keep.sum())
+        self.permute_cells(perm.astype(np.int32), n_keep)
+
+    def permute_cells(self, perm: np.ndarray, n_keep: int):
+        """Gather slot rows by a full-capacity permutation; zero the tail"""
+        self.params = permute_params(
+            self.params, jnp.asarray(perm, dtype=jnp.int32), jnp.asarray(n_keep)
+        )
+
+    # ------------------------------------------------------------------ #
+    # integration                                                        #
+    # ------------------------------------------------------------------ #
+
+    def integrate_signals(self, X: jnp.ndarray) -> jnp.ndarray:
+        """
+        Simulate protein work for one time step.  ``X`` is (c, s) over all
+        cell slots (intracellular signals first, extracellular second);
+        returns the updated signals.
+        """
+        return integrate_signals(jnp.asarray(X, dtype=jnp.float32), self.params)
+
+    # ------------------------------------------------------------------ #
+    # interpretation                                                     #
+    # ------------------------------------------------------------------ #
+
+    def get_proteome(self, proteome: list[ProteinSpecType]) -> list[Protein]:
+        """
+        Interpret one index-level proteome as human-readable
+        :class:`Protein` objects (replaces the reference's native dict
+        builder, `rust/kinetics.rs:101-202`).
+        """
+        out = []
+        for dom_specs, cds_start, cds_end, is_fwd in proteome:
+            domains = []
+            for (dt, i0, i1, i2, i3), start, end in dom_specs:
+                dct = self._domain_dict(dt, i0, i1, i2, i3, start, end)
+                if dct is not None:
+                    domains.append(dct)
+            out.append(
+                Protein.from_dict(
+                    {
+                        "domains": domains,
+                        "cds_start": cds_start,
+                        "cds_end": cds_end,
+                        "is_fwd": is_fwd,
+                    }
+                )
+            )
+        return out
+
+    def _domain_dict(
+        self, dt: int, i0: int, i1: int, i2: int, i3: int, start: int, end: int
+    ) -> dict | None:
+        mols = self.mol_names
+        n_mols = self.n_molecules
+        km = float(self.km_map.weights[i1])
+        sign = int(self.sign_map.signs[i2])
+        if dt == 1:
+            vmax = float(self.vmax_map.weights[i0])
+            react = self.reaction_map.M[i3]
+            lfts: list[str] = []
+            rgts: list[str] = []
+            for mol_i, n in enumerate(react[:n_mols].tolist()):
+                signed_n = n * sign
+                if signed_n > 0:
+                    rgts.extend([mols[mol_i]] * abs(n))
+                elif signed_n < 0:
+                    lfts.extend([mols[mol_i]] * abs(n))
+            spec = {
+                "reaction": (lfts, rgts),
+                "km": km,
+                "vmax": vmax,
+                "start": start,
+                "end": end,
+            }
+            return {"type": "C", "spec": spec}
+        if dt == 2:
+            vmax = float(self.vmax_map.weights[i0])
+            trnspt = self.transport_map.M[i3]
+            nz = np.nonzero(trnspt)[0]
+            if len(nz) == 0:
+                raise ValueError("No transporter molecule identified")
+            mol_i = int(nz[0])
+            signed_n = int(trnspt[mol_i]) * sign
+            spec = {
+                "molecule": mols[mol_i % n_mols],
+                "km": km,
+                "vmax": vmax,
+                "is_exporter": signed_n < 0,
+                "start": start,
+                "end": end,
+            }
+            return {"type": "T", "spec": spec}
+        if dt == 3:
+            hill = int(self.hill_map.numbers[i0])
+            eff = self.effector_map.M[i3]
+            nz = np.nonzero(eff)[0]
+            if len(nz) == 0:
+                raise ValueError("No effector molecule identified")
+            i = int(nz[0])
+            signed_n = int(eff[i]) * sign
+            is_trns = i >= n_mols
+            spec = {
+                "effector": mols[i % n_mols],
+                "km": km,
+                "hill": hill,
+                "is_inhibiting": signed_n < 0,
+                "is_transmembrane": is_trns,
+                "start": start,
+                "end": end,
+            }
+            return {"type": "R", "spec": spec}
+        return None

@@ -1,0 +1,22 @@
+"""
+Native layer of the framework: the host-side genome engine.
+
+See :mod:`magicsoup_tpu.native.engine` (C++/ctypes primary) and
+:mod:`magicsoup_tpu.native._pyengine` (pure-Python fallback + shared
+lookup-table containers).
+"""
+from magicsoup_tpu.native.engine import (
+    TranslationTables,
+    has_native,
+    point_mutations,
+    recombinations,
+    translate_genomes_flat,
+)
+
+__all__ = [
+    "TranslationTables",
+    "has_native",
+    "point_mutations",
+    "recombinations",
+    "translate_genomes_flat",
+]

@@ -1,0 +1,258 @@
+"""
+Loader and ctypes bindings for the native genome engine.
+
+Compiles `src/genome.cpp` with g++ (``-O3 -fopenmp``) into the package
+directory on first use and exposes the flat-array API.  If no compiler is
+available (or ``MAGICSOUP_TPU_NO_NATIVE=1``), transparently falls back to the
+pure-Python engine in :mod:`magicsoup_tpu.native._pyengine` — same
+signatures, same flat formats.
+
+This replaces the reference's Rust/PyO3 `magicsoup._lib` cdylib
+(`rust/lib.rs:1-205`): string work runs on host threads (OpenMP instead of
+rayon) with the GIL released for the duration of each call (ctypes does that
+automatically).
+"""
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from pathlib import Path
+
+import numpy as np
+
+from magicsoup_tpu.native import _pyengine
+from magicsoup_tpu.native._pyengine import TranslationTables
+
+_SRC = Path(__file__).parent / "src" / "genome.cpp"
+_LIB_PATH = Path(__file__).parent / "_libmsgenome.so"
+_BUILD_LOCK = threading.Lock()
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_charp = ctypes.POINTER(ctypes.c_char)
+
+
+def _build_lib() -> Path | None:
+    """Compile the C++ engine if needed; returns the .so path or None"""
+    if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB_PATH
+    with _BUILD_LOCK:
+        if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _LIB_PATH
+        tmp = _LIB_PATH.with_suffix(".so.tmp")
+        cmd = [
+            "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+            "-fopenmp", str(_SRC), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError) as err:
+            warnings.warn(
+                f"Could not build native genome engine ({err});"
+                " falling back to the pure-Python engine."
+            )
+            return None
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+
+
+def _load_lib():
+    if os.environ.get("MAGICSOUP_TPU_NO_NATIVE") == "1":
+        return None
+    path = _build_lib()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.ms_free.argtypes = [ctypes.c_void_p]
+    lib.ms_free.restype = None
+    lib.ms_translate_genomes.argtypes = [
+        _charp, _i64p, ctypes.c_int64,  # data, offsets, n
+        _u8p, _u8p, _i32p, _i32p,  # codon_flags, dom_type_lut, 1c lut, 2c lut
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # dom_size, type_size, threads
+        _i32p,  # prot_counts out
+        ctypes.POINTER(_i32p), _i64p,  # prots, n_prots
+        ctypes.POINTER(_i32p), _i64p,  # doms, n_doms
+    ]
+    lib.ms_translate_genomes.restype = None
+    lib.ms_point_mutations.argtypes = [
+        _charp, _i64p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
+        ctypes.POINTER(_i64p), _i64p,
+    ]
+    lib.ms_point_mutations.restype = None
+    lib.ms_recombinations.argtypes = [
+        _charp, _i64p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
+        ctypes.POINTER(_i64p), _i64p,
+    ]
+    lib.ms_recombinations.restype = None
+    return lib
+
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable"""
+    global _LIB, _LIB_TRIED
+    if not _LIB_TRIED:
+        _LIB = _load_lib()
+        _LIB_TRIED = True
+    return _LIB
+
+
+def has_native() -> bool:
+    return get_lib() is not None
+
+
+def _concat(seqs: list[str]) -> tuple[bytes, np.ndarray]:
+    """Concatenate strings into one byte buffer + (n+1,) int64 offsets"""
+    offsets = np.zeros(len(seqs) + 1, dtype=np.int64)
+    lens = np.array([len(s) for s in seqs], dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return "".join(seqs).encode(), offsets
+
+
+def translate_genomes_flat(
+    genomes: list[str], tables: TranslationTables, n_threads: int = 0
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """
+    Flat-format genome translation (see `_pyengine` docstring for the
+    format).  Deterministic: the native and Python engines produce
+    identical output.
+    """
+    lib = get_lib()
+    if lib is None:
+        return _pyengine.translate_genomes_flat(genomes, tables)
+
+    data, offsets = _concat(genomes)
+    n = len(genomes)
+    prot_counts = np.zeros(n, dtype=np.int32)
+    out_prots = _i32p()
+    out_doms = _i32p()
+    n_prots = ctypes.c_int64()
+    n_doms = ctypes.c_int64()
+    one_lut = np.ascontiguousarray(tables.one_codon_lut, dtype=np.int32)
+    two_lut = np.ascontiguousarray(tables.two_codon_lut, dtype=np.int32)
+    lib.ms_translate_genomes(
+        ctypes.cast(data, _charp),
+        offsets.ctypes.data_as(_i64p),
+        n,
+        tables.codon_flags.ctypes.data_as(_u8p),
+        tables.dom_type_lut.ctypes.data_as(_u8p),
+        one_lut.ctypes.data_as(_i32p),
+        two_lut.ctypes.data_as(_i32p),
+        tables.dom_size,
+        tables.dom_type_size,
+        n_threads,
+        prot_counts.ctypes.data_as(_i32p),
+        ctypes.byref(out_prots),
+        ctypes.byref(n_prots),
+        ctypes.byref(out_doms),
+        ctypes.byref(n_doms),
+    )
+    try:
+        prots = np.ctypeslib.as_array(out_prots, shape=(n_prots.value, 4)).copy()
+        doms = np.ctypeslib.as_array(out_doms, shape=(n_doms.value, 7)).copy()
+    finally:
+        lib.ms_free(out_prots)
+        lib.ms_free(out_doms)
+    return prot_counts, prots, doms
+
+
+def _unpack_seqs(
+    lib, out_data, out_offsets, out_idxs, n: int, seqs_per_item: int
+) -> list[tuple]:
+    """Decode (data, offsets, idxs) triple returned by a mutation call"""
+    try:
+        if n == 0:
+            return []
+        offs = np.ctypeslib.as_array(out_offsets, shape=(seqs_per_item * n + 1,))
+        total = int(offs[-1])
+        buf = ctypes.string_at(out_data, total)
+        idxs = np.ctypeslib.as_array(out_idxs, shape=(n,))
+        out = []
+        for k in range(n):
+            parts = tuple(
+                buf[offs[seqs_per_item * k + j] : offs[seqs_per_item * k + j + 1]].decode()
+                for j in range(seqs_per_item)
+            )
+            out.append(parts + (int(idxs[k]),))
+        return out
+    finally:
+        lib.ms_free(out_data)
+        lib.ms_free(out_offsets)
+        lib.ms_free(out_idxs)
+
+
+def point_mutations(
+    seqs: list[str],
+    p: float,
+    p_indel: float,
+    p_del: float,
+    seed: int,
+    n_threads: int = 0,
+) -> list[tuple[str, int]]:
+    """Point mutations; returns only mutated sequences with input indices"""
+    if len(seqs) == 0:
+        return []
+    lib = get_lib()
+    if lib is None:
+        return _pyengine.point_mutations_flat(seqs, p, p_indel, p_del, seed)
+    data, offsets = _concat(seqs)
+    out_data = _charp()
+    out_offsets = _i64p()
+    out_idxs = _i64p()
+    out_n = ctypes.c_int64()
+    lib.ms_point_mutations(
+        ctypes.cast(data, _charp),
+        offsets.ctypes.data_as(_i64p),
+        len(seqs),
+        p, p_indel, p_del,
+        seed & 0xFFFFFFFFFFFFFFFF,
+        n_threads,
+        ctypes.byref(out_data),
+        ctypes.byref(out_offsets),
+        ctypes.byref(out_idxs),
+        ctypes.byref(out_n),
+    )
+    return _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 1)
+
+
+def recombinations(
+    seq_pairs: list[tuple[str, str]],
+    p: float,
+    seed: int,
+    n_threads: int = 0,
+) -> list[tuple[str, str, int]]:
+    """Strand-break recombinations; returns only recombined pairs"""
+    if len(seq_pairs) == 0:
+        return []
+    lib = get_lib()
+    if lib is None:
+        return _pyengine.recombinations_flat(seq_pairs, p, seed)
+    flat = [s for pair in seq_pairs for s in pair]
+    data, offsets = _concat(flat)
+    out_data = _charp()
+    out_offsets = _i64p()
+    out_idxs = _i64p()
+    out_n = ctypes.c_int64()
+    lib.ms_recombinations(
+        ctypes.cast(data, _charp),
+        offsets.ctypes.data_as(_i64p),
+        len(seq_pairs),
+        p,
+        seed & 0xFFFFFFFFFFFFFFFF,
+        n_threads,
+        ctypes.byref(out_data),
+        ctypes.byref(out_offsets),
+        ctypes.byref(out_idxs),
+        ctypes.byref(out_n),
+    )
+    return _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 2)

@@ -1,0 +1,473 @@
+// Native genome engine: genome->proteome translation, point mutations,
+// and recombinations over flat byte buffers.
+//
+// This is the TPU-framework counterpart of the reference's Rust cdylib
+// (rust/genetics.rs, rust/mutations.rs in mRcSchwering/magic-soup): the
+// heavy string work stays on host, parallelized with OpenMP threads, and
+// results are emitted as dense arrays that feed the JAX device path
+// directly.  Exposed through a plain C ABI consumed via ctypes
+// (magicsoup_tpu/native/engine.py); all buffers crossing the boundary are
+// caller-owned or allocated here and released with ms_free.
+//
+// Translation algorithm parity (rust/genetics.rs:13-123):
+//  - per-reading-frame start stacks; a stop codon pops ALL pending starts
+//    of its frame (nested/overlapping CDSs), emitting those >= min_cds_size
+//  - domain extraction walks each CDS; on a domain-type match it reads
+//    3 one-codon tokens + 1 two-codon token and jumps dom_size nts,
+//    otherwise advances one codon
+//  - proteins with only regulatory domains are discarded
+// Mutation parity (rust/mutations.rs:11-154): Poisson(p*len) mutation
+// counts, distinct sorted positions, indel offset tracking; recombination
+// via strand-break fragments, shuffle, random split.  RNG here is seeded
+// per sequence (seed, index) for reproducibility -- the reference uses
+// thread-local OS RNG and is not reproducible.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr int CODON = 3;
+
+// nucleotide byte -> 2-bit code, order TCGA (matches ALL_NTS); non-TCGA
+// bytes map to -1 so codons containing them match nothing (parity with
+// the Python fallback engine's sentinel handling)
+int8_t NT_CODE[256];
+struct NtCodeInit {
+  NtCodeInit() {
+    std::memset(NT_CODE, -1, sizeof(NT_CODE));
+    NT_CODE[(unsigned char)'T'] = 0;
+    NT_CODE[(unsigned char)'C'] = 1;
+    NT_CODE[(unsigned char)'G'] = 2;
+    NT_CODE[(unsigned char)'A'] = 3;
+  }
+} nt_code_init;
+
+char COMPLEMENT[256];
+struct ComplementInit {
+  ComplementInit() {
+    for (int i = 0; i < 256; ++i) COMPLEMENT[i] = (char)i;
+    COMPLEMENT[(unsigned char)'A'] = 'T';
+    COMPLEMENT[(unsigned char)'T'] = 'A';
+    COMPLEMENT[(unsigned char)'C'] = 'G';
+    COMPLEMENT[(unsigned char)'G'] = 'C';
+  }
+} complement_init;
+
+// codon code (base-4 over 3 nts) at every position i of seq
+void codon_codes(const char* seq, int64_t n, std::vector<int32_t>& out) {
+  out.clear();
+  if (n < CODON) return;
+  out.resize(n - CODON + 1);
+  for (int64_t i = 0; i + CODON <= n; ++i) {
+    int c0 = NT_CODE[(unsigned char)seq[i]];
+    int c1 = NT_CODE[(unsigned char)seq[i + 1]];
+    int c2 = NT_CODE[(unsigned char)seq[i + 2]];
+    out[i] = (c0 < 0 || c1 < 0 || c2 < 0) ? -1 : c0 * 16 + c1 * 4 + c2;
+  }
+}
+
+struct Cds {
+  int64_t start;
+  int64_t stop;
+  uint8_t is_fwd;
+};
+
+// per-frame start stacks; stop pops all pending starts of its frame
+void coding_regions(const std::vector<int32_t>& codes,
+                    const uint8_t* codon_flags, int min_cds, uint8_t is_fwd,
+                    std::vector<Cds>& out) {
+  std::vector<int64_t> starts[3];
+  for (int f = 0; f < 3; ++f) starts[f].reserve(12);
+  const int64_t n = (int64_t)codes.size();
+  for (int64_t i = 0; i < n; ++i) {
+    if (codes[i] < 0) continue;
+    uint8_t flag = codon_flags[codes[i]];
+    if (flag == 0) continue;
+    int frame = (int)(i % CODON);
+    if (flag == 1) {
+      starts[frame].push_back(i);
+    } else {
+      int64_t j = i + CODON;
+      while (!starts[frame].empty()) {
+        int64_t d = starts[frame].back();
+        starts[frame].pop_back();
+        if (j - d >= min_cds) out.push_back({d, j, is_fwd});
+      }
+    }
+  }
+}
+
+// per-genome result buffers
+struct GenomeResult {
+  std::vector<int32_t> prots;  // rows of 4: cds_start, cds_end, is_fwd, n_doms
+  std::vector<int32_t> doms;   // rows of 7: dt, i0, i1, i2, i3, start, end
+  int32_t n_prots = 0;
+};
+
+void extract_domains(const std::vector<int32_t>& codes,
+                     const std::vector<Cds>& cdss, int dom_size,
+                     int dom_type_size, const uint8_t* dom_type_lut,
+                     const int32_t* one_codon_lut,
+                     const int32_t* two_codon_lut, GenomeResult& res) {
+  const int64_t n_codes = (int64_t)codes.size();
+  std::vector<int32_t> my_doms;
+  for (const Cds& cds : cdss) {
+    int64_t n = cds.stop - cds.start;
+    int64_t i = 0;
+    bool useful = false;
+    my_doms.clear();
+    while (i + dom_size <= n) {
+      int64_t dom_start = cds.start + i;
+      int32_t type_code = 0;
+      bool in_range = true;
+      for (int k = 0; k < dom_type_size; k += CODON) {
+        int64_t p = dom_start + k;
+        if (p >= n_codes || codes[p] < 0) {
+          in_range = false;
+          break;
+        }
+        type_code = type_code * 64 + codes[p];
+      }
+      uint8_t dom_type = in_range ? dom_type_lut[type_code] : 0;
+      if (dom_type != 0) {
+        if (dom_type != 3) useful = true;
+        int64_t spec = dom_start + dom_type_size;
+        auto tok1 = [&](int64_t p) -> int32_t {
+          return codes[p] >= 0 ? one_codon_lut[codes[p]] : 0;
+        };
+        int32_t i0 = tok1(spec);
+        int32_t i1 = tok1(spec + CODON);
+        int32_t i2 = tok1(spec + 2 * CODON);
+        int32_t c3a = codes[spec + 3 * CODON];
+        int32_t c3b = codes[spec + 4 * CODON];
+        int32_t i3 = (c3a >= 0 && c3b >= 0) ? two_codon_lut[c3a * 64 + c3b] : 0;
+        int32_t row[7] = {(int32_t)dom_type, i0,
+                          i1,                i2,
+                          i3,                (int32_t)i,
+                          (int32_t)(i + dom_size)};
+        my_doms.insert(my_doms.end(), row, row + 7);
+        i += dom_size;
+      } else {
+        i += CODON;
+      }
+    }
+    if (useful) {
+      int32_t prow[4] = {(int32_t)cds.start, (int32_t)cds.stop,
+                         (int32_t)cds.is_fwd,
+                         (int32_t)(my_doms.size() / 7)};
+      res.prots.insert(res.prots.end(), prow, prow + 4);
+      res.doms.insert(res.doms.end(), my_doms.begin(), my_doms.end());
+      res.n_prots += 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void ms_free(void* ptr) { std::free(ptr); }
+
+// Translate n genomes (concatenated bytes + n+1 offsets).  Writes per-genome
+// protein counts to prot_counts (caller-allocated, n entries) and allocates
+// *out_prots (rows of 4) and *out_doms (rows of 7); row counts via
+// *out_n_prots / *out_n_doms.  Caller frees with ms_free.
+void ms_translate_genomes(const char* data, const int64_t* offsets, int64_t n,
+                          const uint8_t* codon_flags,
+                          const uint8_t* dom_type_lut,
+                          const int32_t* one_codon_lut,
+                          const int32_t* two_codon_lut, int dom_size,
+                          int dom_type_size, int n_threads,
+                          int32_t* prot_counts, int32_t** out_prots,
+                          int64_t* out_n_prots, int32_t** out_doms,
+                          int64_t* out_n_doms) {
+  std::vector<GenomeResult> results((size_t)n);
+
+#if defined(_OPENMP)
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#pragma omp parallel
+#endif
+  {
+    std::vector<int32_t> codes;
+    std::vector<Cds> cdss;
+    std::string revcomp;
+#if defined(_OPENMP)
+#pragma omp for schedule(dynamic, 8)
+#endif
+    for (int64_t gi = 0; gi < n; ++gi) {
+      const char* seq = data + offsets[gi];
+      int64_t len = offsets[gi + 1] - offsets[gi];
+      GenomeResult& res = results[gi];
+
+      cdss.clear();
+      codon_codes(seq, len, codes);
+      coding_regions(codes, codon_flags, dom_size, 1, cdss);
+      extract_domains(codes, cdss, dom_size, dom_type_size, dom_type_lut,
+                      one_codon_lut, two_codon_lut, res);
+
+      revcomp.resize((size_t)len);
+      for (int64_t i = 0; i < len; ++i)
+        revcomp[len - 1 - i] = COMPLEMENT[(unsigned char)seq[i]];
+      cdss.clear();
+      codon_codes(revcomp.data(), len, codes);
+      coding_regions(codes, codon_flags, dom_size, 0, cdss);
+      extract_domains(codes, cdss, dom_size, dom_type_size, dom_type_lut,
+                      one_codon_lut, two_codon_lut, res);
+    }
+  }
+
+  int64_t total_prots = 0, total_doms = 0;
+  for (int64_t gi = 0; gi < n; ++gi) {
+    prot_counts[gi] = results[gi].n_prots;
+    total_prots += (int64_t)(results[gi].prots.size() / 4);
+    total_doms += (int64_t)(results[gi].doms.size() / 7);
+  }
+
+  int32_t* prots =
+      (int32_t*)std::malloc(sizeof(int32_t) * std::max<int64_t>(1, total_prots * 4));
+  int32_t* doms =
+      (int32_t*)std::malloc(sizeof(int32_t) * std::max<int64_t>(1, total_doms * 7));
+  int64_t pi = 0, di = 0;
+  for (int64_t gi = 0; gi < n; ++gi) {
+    const GenomeResult& res = results[gi];
+    std::memcpy(prots + pi, res.prots.data(), res.prots.size() * sizeof(int32_t));
+    std::memcpy(doms + di, res.doms.data(), res.doms.size() * sizeof(int32_t));
+    pi += (int64_t)res.prots.size();
+    di += (int64_t)res.doms.size();
+  }
+  *out_prots = prots;
+  *out_n_prots = total_prots;
+  *out_doms = doms;
+  *out_n_doms = total_doms;
+}
+
+namespace {
+
+const char MUT_NTS[4] = {'A', 'C', 'T', 'G'};
+
+// distinct sorted positions in [0, len)
+void sample_positions(std::mt19937_64& rng, int64_t len, int64_t k,
+                      std::vector<int64_t>& out) {
+  out.clear();
+  if (k * 3 >= len) {
+    // dense case: partial Fisher-Yates
+    std::vector<int64_t> idx((size_t)len);
+    for (int64_t i = 0; i < len; ++i) idx[i] = i;
+    for (int64_t i = 0; i < k; ++i) {
+      std::uniform_int_distribution<int64_t> d(i, len - 1);
+      std::swap(idx[i], idx[d(rng)]);
+    }
+    out.assign(idx.begin(), idx.begin() + k);
+  } else {
+    // sparse case: rejection
+    out.reserve((size_t)k);
+    std::uniform_int_distribution<int64_t> d(0, len - 1);
+    while ((int64_t)out.size() < k) {
+      int64_t cand = d(rng);
+      if (std::find(out.begin(), out.end(), cand) == out.end())
+        out.push_back(cand);
+    }
+  }
+  std::sort(out.begin(), out.end());
+}
+
+struct MutResult {
+  std::string seq0;
+  std::string seq1;  // only used by recombinations
+  int64_t idx = -1;  // -1 = unchanged
+};
+
+}  // namespace
+
+// Point mutations over n sequences.  Returns only mutated sequences:
+// *out_data is the concatenation of the mutated sequences, *out_offsets has
+// *out_n + 1 entries, *out_idxs maps each to its input index.
+void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
+                        float p, float p_indel, float p_del, uint64_t seed,
+                        int n_threads, char** out_data, int64_t** out_offsets,
+                        int64_t** out_idxs, int64_t* out_n) {
+  std::vector<MutResult> results((size_t)n);
+
+#if defined(_OPENMP)
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#pragma omp parallel
+#endif
+  {
+    std::vector<int64_t> positions;
+#if defined(_OPENMP)
+#pragma omp for schedule(dynamic, 64)
+#endif
+    for (int64_t si = 0; si < n; ++si) {
+      const char* seq = data + offsets[si];
+      int64_t len = offsets[si + 1] - offsets[si];
+      if (len < 1) continue;
+      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)si);
+      std::poisson_distribution<int64_t> poi((double)p * (double)len);
+      int64_t n_muts = poi(rng);
+      if (n_muts < 1) continue;
+      if (n_muts > len) n_muts = len;
+      sample_positions(rng, len, n_muts, positions);
+
+      std::string s(seq, (size_t)len);
+      std::uniform_real_distribution<double> uni(0.0, 1.0);
+      std::uniform_int_distribution<int> nt(0, 3);
+      int64_t offset = 0;
+      for (int64_t pos : positions) {
+        int64_t cur = pos + offset;
+        if (cur < 0) cur = 0;
+        if (uni(rng) < (double)p_indel) {
+          if (uni(rng) < (double)p_del) {
+            if (cur >= (int64_t)s.size()) cur = (int64_t)s.size() - 1;
+            s.erase((size_t)cur, 1);
+            offset -= 1;
+          } else {
+            if (cur > (int64_t)s.size()) cur = (int64_t)s.size();
+            s.insert((size_t)cur, 1, MUT_NTS[nt(rng)]);
+            offset += 1;
+          }
+        } else {
+          if (cur >= (int64_t)s.size()) cur = (int64_t)s.size() - 1;
+          s[(size_t)cur] = MUT_NTS[nt(rng)];
+        }
+      }
+      results[si].seq0 = std::move(s);
+      results[si].idx = si;
+    }
+  }
+
+  int64_t n_out = 0, total_len = 0;
+  for (const MutResult& r : results) {
+    if (r.idx >= 0) {
+      n_out += 1;
+      total_len += (int64_t)r.seq0.size();
+    }
+  }
+  char* odata = (char*)std::malloc((size_t)std::max<int64_t>(1, total_len));
+  int64_t* ooffs = (int64_t*)std::malloc(sizeof(int64_t) * (size_t)(n_out + 1));
+  int64_t* oidxs =
+      (int64_t*)std::malloc(sizeof(int64_t) * (size_t)std::max<int64_t>(1, n_out));
+  int64_t w = 0, k = 0;
+  ooffs[0] = 0;
+  for (const MutResult& r : results) {
+    if (r.idx < 0) continue;
+    std::memcpy(odata + w, r.seq0.data(), r.seq0.size());
+    w += (int64_t)r.seq0.size();
+    oidxs[k] = r.idx;
+    ooffs[++k] = w;
+  }
+  *out_data = odata;
+  *out_offsets = ooffs;
+  *out_idxs = oidxs;
+  *out_n = n_out;
+}
+
+// Recombinations over n sequence pairs (2*n sequences concatenated:
+// pair i = sequences 2i and 2i+1).  Output mirrors ms_point_mutations but
+// with two sequences per result (2*out_n sequences, out_n indices).
+void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
+                       float p, uint64_t seed, int n_threads, char** out_data,
+                       int64_t** out_offsets, int64_t** out_idxs,
+                       int64_t* out_n) {
+  std::vector<MutResult> results((size_t)n);
+
+#if defined(_OPENMP)
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#pragma omp parallel
+#endif
+  {
+    std::vector<int64_t> positions;
+    std::vector<std::pair<int64_t, int64_t>> parts;  // (global_start, len)
+#if defined(_OPENMP)
+#pragma omp for schedule(dynamic, 64)
+#endif
+    for (int64_t pi = 0; pi < n; ++pi) {
+      const char* s0 = data + offsets[2 * pi];
+      int64_t n0 = offsets[2 * pi + 1] - offsets[2 * pi];
+      const char* s1 = data + offsets[2 * pi + 1];
+      int64_t n1 = offsets[2 * pi + 2] - offsets[2 * pi + 1];
+      int64_t n_both = n0 + n1;
+      if (n_both < 1) continue;
+      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)pi);
+      std::poisson_distribution<int64_t> poi((double)p * (double)n_both);
+      int64_t n_muts = poi(rng);
+      if (n_muts < 1) continue;
+      if (n_muts > n_both) n_muts = n_both;
+      sample_positions(rng, n_both, n_muts, positions);
+
+      // split both strands into fragments at the cut positions
+      parts.clear();
+      int64_t i = 0;
+      for (int64_t j : positions) {
+        if (j >= n0) break;
+        parts.emplace_back(i, j - i);
+        i = j;
+      }
+      parts.emplace_back(i, n0 - i);
+      i = 0;
+      for (int64_t j : positions) {
+        if (j < n0) continue;
+        parts.emplace_back(n0 + i, j - n0 - i);
+        i = j - n0;
+      }
+      parts.emplace_back(n0 + i, n1 - i);
+
+      std::shuffle(parts.begin(), parts.end(), rng);
+      std::uniform_int_distribution<size_t> split(0, parts.size() - 1);
+      size_t s = split(rng);
+
+      MutResult& res = results[pi];
+      res.seq0.reserve((size_t)n0);
+      res.seq1.reserve((size_t)n1);
+      auto frag = [&](size_t k) {
+        int64_t g = parts[k].first;
+        const char* src = g < n0 ? s0 + g : s1 + (g - n0);
+        return std::string(src, (size_t)parts[k].second);
+      };
+      for (size_t k = 0; k < s; ++k) res.seq0 += frag(k);
+      for (size_t k = s; k < parts.size(); ++k) res.seq1 += frag(k);
+      res.idx = pi;
+    }
+  }
+
+  int64_t n_out = 0, total_len = 0;
+  for (const MutResult& r : results) {
+    if (r.idx >= 0) {
+      n_out += 1;
+      total_len += (int64_t)(r.seq0.size() + r.seq1.size());
+    }
+  }
+  char* odata = (char*)std::malloc((size_t)std::max<int64_t>(1, total_len));
+  int64_t* ooffs =
+      (int64_t*)std::malloc(sizeof(int64_t) * (size_t)(2 * n_out + 1));
+  int64_t* oidxs =
+      (int64_t*)std::malloc(sizeof(int64_t) * (size_t)std::max<int64_t>(1, n_out));
+  int64_t w = 0, k = 0;
+  ooffs[0] = 0;
+  int64_t oi = 0;
+  for (const MutResult& r : results) {
+    if (r.idx < 0) continue;
+    std::memcpy(odata + w, r.seq0.data(), r.seq0.size());
+    w += (int64_t)r.seq0.size();
+    ooffs[++k] = w;
+    std::memcpy(odata + w, r.seq1.data(), r.seq1.size());
+    w += (int64_t)r.seq1.size();
+    ooffs[++k] = w;
+    oidxs[oi++] = r.idx;
+  }
+  *out_data = odata;
+  *out_offsets = ooffs;
+  *out_idxs = oidxs;
+  *out_n = n_out;
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""
+Jit-compiled XLA kernels: the Michaelis-Menten signal integrator
+(:mod:`magicsoup_tpu.ops.integrate`), molecule-map physics
+(:mod:`magicsoup_tpu.ops.diffusion`), and cell-parameter assembly
+(:mod:`magicsoup_tpu.ops.params`).
+"""

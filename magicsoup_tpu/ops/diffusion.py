@@ -1,0 +1,97 @@
+"""
+Molecule-map physics as jit-compiled XLA kernels: diffusion (depthwise 3x3
+torus convolution), membrane permeation, and degradation.
+
+Math parity reference: `python/magicsoup/world.py:627-678,935-984` —
+diffusion kernel ``a = 1/(1/d + 8)`` off-center / ``b = 1 - 8a`` center with
+circular padding, the total-mass correction spread over all pixels, clamping
+at zero, permeation factor ``1/(1/p + 1)`` exchanging between a cell and its
+pixel, and per-species exponential decay.
+
+TPU-first deltas (SURVEY.md §7 design delta 4): one batched depthwise
+convolution over all molecule channels (the reference loops one Conv2d per
+molecule), wrap-padding via ``jnp.pad(mode="wrap")``, and all three physics
+ops exposed as pure functions over the full slot-capacity state so they fuse
+under a single jit with the gather/scatter of cell signals.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def diffusion_kernels(diffusivities: list[float]) -> np.ndarray:
+    """(n_mols, 3, 3) depthwise kernels from per-molecule diffusivities"""
+    kernels = np.zeros((len(diffusivities), 3, 3), dtype=np.float32)
+    for i, rate in enumerate(diffusivities):
+        rate = min(abs(rate), 1.0)
+        if rate == 0.0:
+            a, b = 0.0, 1.0
+        else:
+            a = 1.0 / (1.0 / rate + 8.0)
+            b = 1.0 - 8.0 * a
+        kernels[i] = a
+        kernels[i, 1, 1] = b
+    return kernels
+
+
+def permeation_factors(permeabilities: list[float]) -> np.ndarray:
+    """(n_mols,) per-step exchange ratios from permeabilities"""
+    out = np.zeros(len(permeabilities), dtype=np.float32)
+    for i, rate in enumerate(permeabilities):
+        rate = min(abs(rate), 1.0)
+        out[i] = 0.0 if rate == 0.0 else 1.0 / (1.0 / rate + 1.0)
+    return out
+
+
+def degradation_factors(half_lives: list[float]) -> np.ndarray:
+    """(n_mols,) per-step decay factors exp(-ln2 / half_life)"""
+    return np.exp(-np.log(2.0) / np.array(half_lives, dtype=np.float64)).astype(
+        np.float32
+    )
+
+
+@jax.jit
+def diffuse(molecule_map: jax.Array, kernels: jax.Array) -> jax.Array:
+    """
+    One diffusion step: depthwise 3x3 convolution on the torus for every
+    molecule channel at once, followed by the reference's mass-conservation
+    fixup (convolution rounding errors spread over all pixels) and a clamp
+    at zero.
+    """
+    n_mols, m, _ = molecule_map.shape
+    total_before = jnp.sum(molecule_map, axis=(1, 2))  # (mols,)
+
+    padded = jnp.pad(molecule_map, ((0, 0), (1, 1), (1, 1)), mode="wrap")
+    out = jax.lax.conv_general_dilated(
+        padded[None],  # (1, mols, m+2, m+2)
+        kernels[:, None],  # (mols, 1, 3, 3)
+        window_strides=(1, 1),
+        padding="VALID",
+        feature_group_count=n_mols,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )[0]
+
+    total_after = jnp.sum(out, axis=(1, 2))
+    out = out + ((total_before - total_after) / (m * m))[:, None, None]
+    return jnp.clip(out, min=0.0)
+
+
+@jax.jit
+def permeate(
+    cell_molecules: jax.Array,  # (c, n_mols) intracellular
+    ext_molecules: jax.Array,  # (c, n_mols) the cells' map pixels
+    factors: jax.Array,  # (n_mols,)
+) -> tuple[jax.Array, jax.Array]:
+    """Exchange molecules between each cell and its pixel by the per-species
+    permeation ratio (reference world.py:654-665)."""
+    d_int = cell_molecules * factors
+    d_ext = ext_molecules * factors
+    return cell_molecules + d_ext - d_int, ext_molecules + d_int - d_ext
+
+
+@jax.jit
+def degrade(
+    molecule_map: jax.Array, cell_molecules: jax.Array, factors: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Decay all molecules by one step (reference world.py:667-678)"""
+    return molecule_map * factors[:, None, None], cell_molecules * factors

@@ -1,0 +1,203 @@
+"""
+The reversible Michaelis-Menten signal integrator — the per-step numeric
+core of the simulation, as pure jit-compiled JAX functions.
+
+Math parity reference: `python/magicsoup/kinetics.py:725-918` and
+`docs/mechanics.md:168-237` of mRcSchwering/magic-soup:
+
+- three passes with Vmax trim factors (0.7, 0.2, 0.1) so equilibria overshot
+  in one pass can be re-approached in the next
+- per pass: reversible MM velocity ``(kf - kb) / (1 + kf + kb)`` with
+  ``kf = prod(X^Nf) / Kmf``, non-competitive allosteric modulation
+  ``prod(X^A / (X^A + Kmr))``, a downward adjustment so no signal goes
+  negative, and an iterative Q-vs-Ke overshoot correction with increments
+  (0.5, 0.25, 0.125, 0.0625)
+- numerical guards: EPS/MAX clamps and NaN/Inf scrubbing exactly as in the
+  reference (they are load-bearing for the no-explosion invariants)
+
+TPU-first deltas (SURVEY.md §7): the reference's data-dependent early exits
+(`torch.any` at kinetics.py:846-847) become fixed-iteration masked updates —
+mathematically identical (an all-false adjustment mask leaves X unchanged)
+but free of device->host syncs; the three trim passes are unrolled under one
+``jit``.  Everything is float32, mask-driven, and shape-static so XLA can
+fuse the whole step; dead cell slots (all-zero parameter rows) are naturally
+inert.
+"""
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from magicsoup_tpu.constants import EPS, MAX, MIN
+
+TRIM_FACTORS = (0.7, 0.2, 0.1)
+INCREMENTS = (0.5, 0.25, 0.125, 0.0625)
+UPPER_THRESH = 1.5
+LOWER_THRESH = 1 / 1.5
+
+
+class CellParams(NamedTuple):
+    """The 9 per-cell kinetic parameter tensors (c cells, p proteins,
+    s signals = 2 * n_molecules; see reference kinetics.py:323-337)."""
+
+    Ke: jax.Array  # (c,p) f32 equilibrium constants
+    Kmf: jax.Array  # (c,p) f32 forward Michaelis constants
+    Kmb: jax.Array  # (c,p) f32 backward Michaelis constants
+    Kmr: jax.Array  # (c,p,s) f32 regulatory Km^hill per signal
+    Vmax: jax.Array  # (c,p) f32 maximum velocities
+    N: jax.Array  # (c,p,s) i32 net stoichiometry
+    Nf: jax.Array  # (c,p,s) i32 forward (substrate) stoichiometry, >= 0
+    Nb: jax.Array  # (c,p,s) i32 backward (product) stoichiometry, >= 0
+    A: jax.Array  # (c,p,s) i32 allosteric hill exponents (+-)
+
+
+def _multiply_signals(X: jax.Array, N: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """
+    ``prod_s(X^N)`` per (cell, protein) with the reference's zero/NaN/Inf
+    handling (kinetics.py:894-918): signals with N<=0 are masked to 0 before
+    the power so 0^0=1 keeps them neutral; NaN/negative results are scrubbed
+    to 0, Inf to MAX.  Also returns which proteins are involved at all.
+    """
+    M = N > 0  # (c,p,s)
+    x = jnp.where(M, X[:, None, :], 0.0)
+    xx = jnp.prod(jnp.power(x, N.astype(jnp.float32)), axis=2)  # (c,p)
+    xx = jnp.where(jnp.isnan(xx), 0.0, xx)
+    xx = jnp.where(xx < 0.0, 0.0, xx)
+    xx = jnp.where(jnp.isinf(xx), MAX, xx)
+    return xx, jnp.any(M, axis=2)
+
+
+def _velocities(X: jax.Array, Vmax: jax.Array, p: CellParams) -> jax.Array:
+    """Reversible-MM velocity with allosteric modulation
+    (reference kinetics.py:771-806)."""
+    kf, f_prots = _multiply_signals(X, p.Nf)
+    kf = kf / p.Kmf
+    kf = jnp.where(f_prots, kf, 0.0)
+    kf = jnp.where(jnp.isinf(kf), MAX, kf)
+
+    kb, b_prots = _multiply_signals(X, p.Nb)
+    kb = kb / p.Kmb
+    kb = jnp.where(b_prots, kb, 0.0)
+    kb = jnp.where(jnp.isinf(kb), MAX, kb)
+
+    a_cat = (kf - kb) / (1 + kf + kb)  # (c,p)
+
+    # non-competitive regulation: X^A / (X^A + Kmr); A<0 inhibits,
+    # A<0 with X=0 gives Inf/Inf=NaN -> inhibitor absent -> fully active
+    is_reg = p.A != 0
+    x_reg = jnp.where(is_reg, X[:, None, :], 0.0)
+    a_reg_s = jnp.power(x_reg, p.A.astype(jnp.float32))
+    a_reg_s = a_reg_s / (a_reg_s + p.Kmr)
+    a_reg_s = jnp.where(jnp.isnan(a_reg_s), 1.0, a_reg_s)
+    a_reg_s = jnp.where(~is_reg, 1.0, a_reg_s)
+    a_reg = jnp.prod(a_reg_s, axis=2)  # (c,p)
+    a_reg = jnp.where(jnp.isinf(a_reg), MAX, a_reg)
+
+    V = a_cat * Vmax * a_reg
+    return jnp.clip(V, MIN, MAX)
+
+
+def _quotient(X: jax.Array, p: CellParams) -> jax.Array:
+    """Reaction quotient Q = prod(X^Nb) / prod(X^Nf)
+    (reference kinetics.py:881-892)."""
+    xx_prod, prod_prots = _multiply_signals(X, p.Nb)
+    xx_prod = jnp.where(prod_prots, xx_prod, 0.0)
+    xx_prod = jnp.where(jnp.isinf(xx_prod), MAX, xx_prod)
+
+    xx_subs, subs_prots = _multiply_signals(X, p.Nf)
+    xx_subs = jnp.where(subs_prots, xx_subs, 0.0)
+    xx_subs = jnp.where(jnp.isinf(xx_subs), MAX, xx_subs)
+
+    q = xx_prod / xx_subs
+    return jnp.nan_to_num(jnp.clip(q, EPS, MAX), nan=1.0)
+
+
+def _negative_adjusted_nv(NV: jax.Array, X: jax.Array) -> jax.Array:
+    """Slow proteins down so no signal is removed below zero
+    (reference kinetics.py:861-879)."""
+    removed = jnp.sum(jnp.clip(-NV, min=0.0), axis=1)  # (c,s)
+    F = X / removed  # may be NaN/Inf where nothing is removed
+    F = jnp.where(F > 1.0, 1.0, F)
+    M_rm = NV < 0.0  # (c,p,s)
+    F_prots = jnp.where(M_rm, F[:, None, :], 1.0)
+    F_min = jnp.min(F_prots, axis=2)  # (c,p)
+    return NV * F_min[:, :, None]
+
+
+def _equilibrium_adjusted_x(
+    X0: jax.Array, X1: jax.Array, NV: jax.Array, V: jax.Array, p: CellParams
+) -> jax.Array:
+    """
+    Iteratively adjust velocities downward (or back up) so the reaction
+    quotient does not overshoot Ke (reference kinetics.py:808-859).  The
+    reference early-exits when no protein needs adjustment; here all 4
+    increments always run with masked updates — identical fixed point,
+    no host sync.
+    """
+    has_impact = jnp.abs(V) > 0.1
+    is_fwd = V > 0.0
+    F = jnp.ones_like(V)  # (c,p)
+
+    # The reference stops iterating globally (`torch.any`, a device->host
+    # sync) once no *impactful* protein needs adjustment; F-updates
+    # themselves are applied regardless of impact.  A traced `stopped` flag
+    # reproduces that exactly without the sync.
+    stopped = jnp.asarray(False)
+
+    for increment in INCREMENTS:
+        Q1 = _quotient(X1, p)
+        QKe = Q1 / p.Ke
+
+        # fwd: Q approaches Ke from below, QKe > 1 is overshoot; bwd mirrored
+        v_too_low = jnp.where(is_fwd, QKe < LOWER_THRESH, QKe > UPPER_THRESH)
+        v_too_low = jnp.where(is_fwd & (F == 1.0), False, v_too_low)
+        v_too_high = jnp.where(is_fwd, QKe > UPPER_THRESH, QKe < LOWER_THRESH)
+        v_too_high = jnp.where(~is_fwd & (F == 0.0), False, v_too_high)
+
+        stopped = stopped | ~jnp.any((v_too_low | v_too_high) & has_impact)
+        apply = ~stopped
+
+        F = jnp.where(apply & v_too_high, F - increment, F)
+        F = jnp.where(apply & v_too_low, F + increment, F)
+        F = jnp.clip(F, 0.0, 1.0)
+
+        X_new = X0 + jnp.einsum("cps,cp->cs", NV, F)
+        X_new = jnp.where(X_new < 0.0, 0.0, X_new)
+        X1 = jnp.where(apply, X_new, X1)
+
+    return X1
+
+
+def _integrate_part(X0: jax.Array, adj_vmax: jax.Array, p: CellParams) -> jax.Array:
+    """One trim pass (reference kinetics.py:753-769)."""
+    V = _velocities(X0, adj_vmax, p)  # (c,p)
+    NV = p.N.astype(jnp.float32) * V[:, :, None]  # (c,p,s)
+    NV_adj = _negative_adjusted_nv(NV, X0)
+    X1 = X0 + jnp.sum(NV_adj, axis=1)
+    X1 = jnp.where(X1 < 0.0, 0.0, X1)  # small fp errors can give -1e-7
+    return _equilibrium_adjusted_x(X0, X1, NV_adj, V, p)
+
+
+@jax.jit
+def integrate_signals(X: jax.Array, params: CellParams) -> jax.Array:
+    """
+    Simulate protein work for one time step over signals ``X`` (c, s).
+    Returns the updated signals; all inputs must be >= 0.
+    """
+    for trim in TRIM_FACTORS:
+        X = _integrate_part(X, jnp.clip(params.Vmax * trim, min=0.0), params)
+    return X
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def integrate_signals_steps(
+    X: jax.Array, params: CellParams, n_steps: int = 1
+) -> jax.Array:
+    """Multiple integrator steps fused under one jit (scan over steps)."""
+
+    def body(x, _):
+        return integrate_signals(x, params), None
+
+    X, _ = jax.lax.scan(body, X, None, length=n_steps)
+    return X

@@ -1,0 +1,225 @@
+"""
+Jit-compiled cell-parameter assembly: dense domain-token tensors -> the 9
+kinetic parameter tensors, plus the masked scatter/gather helpers used for
+slot-based state updates (set/unset/copy/compact).
+
+Math parity reference: `python/magicsoup/kinetics.py:521-625` (set_cell_params)
+— Vmax nanmean over domains, allosteric A = sum(effector*sign*hill),
+Kmr = nanmean(Km_reg per signal)^A, stoichiometry N split into Nf/Nb to
+preserve zero-net cofactors, Ke = exp(-(N.E)/(R.T)) clamped, and the Kmf/Kmb
+split that puts the sampled Km on the smaller side of the equilibrium.
+
+TPU-first deltas: the reference builds its dense (c,p,d) index tensors in a
+nested Python loop (`_collect_proteome_idxs`, kinetics.py:920-970 — half the
+documented spawn bottleneck); here the dense tensors arrive directly from
+the genome engine's flat buffers via vectorized numpy scatter
+(:func:`flat_to_dense`), and everything downstream is one fused XLA program.
+Batch sizes are padded to powers of two and scattered with ``mode="drop"``
+so recompiles stay logarithmic in batch size.
+"""
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.constants import EPS, GAS_CONSTANT, MAX
+from magicsoup_tpu.ops.integrate import CellParams
+
+
+class TokenTables(NamedTuple):
+    """Token -> parameter lookup tables (row 0 = empty/zero token)."""
+
+    km_weights: jax.Array  # (T1+1,) f32, NaN at 0
+    vmax_weights: jax.Array  # (T1+1,) f32, NaN at 0
+    signs: jax.Array  # (T1+1,) i32, 0 at 0
+    hills: jax.Array  # (T1+1,) i32, 0 at 0
+    reactions: jax.Array  # (T2+1, s) i32 signed stoichiometry vectors
+    transports: jax.Array  # (T2+1, s) i32 in/out transport vectors
+    effectors: jax.Array  # (T2+1, s) i32 one-hot effector vectors
+    mol_energies: jax.Array  # (s,) f32 molecule energies (duplicated x2)
+
+
+def pad_pow2(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= max(n, minimum)"""
+    m = max(n, minimum)
+    return 1 << (m - 1).bit_length()
+
+
+def pad_idxs(idxs: np.ndarray, oob: int, minimum: int = 8) -> np.ndarray:
+    """Pad an int index array to a power-of-two length with an out-of-bounds
+    fill value (dropped by scatters with mode='drop')."""
+    n = pad_pow2(len(idxs), minimum)
+    out = np.full(n, oob, dtype=np.int32)
+    out[: len(idxs)] = idxs
+    return out
+
+
+def flat_to_dense(
+    prot_counts: np.ndarray,
+    prots: np.ndarray,
+    doms: np.ndarray,
+    n_prots_cap: int,
+    n_doms_cap: int | None = None,
+) -> tuple[np.ndarray, int]:
+    """
+    Vectorized scatter of the genome engine's flat buffers into one dense
+    int32 tensor (b, n_prots_cap, n_doms_cap, 5) holding
+    ``[dom_type, i0, i1, i2, i3]`` per domain (0 = padding).
+
+    Returns the dense tensor and the (possibly padded) domain capacity.
+    """
+    b = len(prot_counts)
+    n_doms_per_prot = prots[:, 3] if len(prots) else np.zeros(0, dtype=np.int32)
+    max_doms = int(n_doms_per_prot.max()) if len(prots) else 1
+    if n_doms_cap is None:
+        n_doms_cap = pad_pow2(max_doms, minimum=1)
+
+    dense = np.zeros((b, n_prots_cap, n_doms_cap, 5), dtype=np.int32)
+    if len(doms) == 0:
+        return dense, n_doms_cap
+
+    # cell index of each protein / protein index within its cell
+    prot_cell = np.repeat(np.arange(b, dtype=np.int64), prot_counts)
+    prot_starts = np.concatenate([[0], np.cumsum(prot_counts)])[:-1]
+    prot_in_cell = np.arange(len(prots), dtype=np.int64) - np.repeat(
+        prot_starts, prot_counts
+    )
+    # protein index of each domain / domain index within its protein
+    dom_prot = np.repeat(np.arange(len(prots), dtype=np.int64), n_doms_per_prot)
+    dom_starts = np.concatenate([[0], np.cumsum(n_doms_per_prot)])[:-1]
+    dom_in_prot = np.arange(len(doms), dtype=np.int64) - np.repeat(
+        dom_starts, n_doms_per_prot
+    )
+
+    dense[prot_cell[dom_prot], prot_in_cell[dom_prot], dom_in_prot] = doms[:, :5]
+    return dense, n_doms_cap
+
+
+def _nanmean0(x: jax.Array, axis: int) -> jax.Array:
+    """nanmean with all-NaN slices giving 0 (torch nanmean().nan_to_num(0))"""
+    mask = ~jnp.isnan(x)
+    total = jnp.sum(jnp.where(mask, x, 0.0), axis=axis)
+    count = jnp.sum(mask, axis=axis)
+    return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+
+
+@partial(jax.jit, static_argnames=())
+def compute_cell_params(
+    dense: jax.Array,  # (b, p, d, 5) i32 [dom_type, i0, i1, i2, i3]
+    tables: TokenTables,
+    abs_temp: jax.Array,
+) -> CellParams:
+    """
+    Map domain tokens to concrete values and aggregate them into the 9
+    per-cell parameter tensors for a batch of b cells.
+    """
+    dom_types = dense[..., 0]
+    idxs0 = dense[..., 1]
+    idxs1 = dense[..., 2]
+    idxs2 = dense[..., 3]
+    idxs3 = dense[..., 4]
+
+    # 1=catalytic, 2=transporter, 3=regulatory
+    is_catal = dom_types == 1
+    is_trnsp = dom_types == 2
+    is_reg = dom_types == 3
+    not_reg = (is_catal | is_trnsp).astype(jnp.int32)
+
+    # scalar tokens; zeroed indices hit the empty row (NaN / 0)
+    Vmaxs = tables.vmax_weights[idxs0 * not_reg]  # (b,p,d) f32
+    Hills = tables.hills[idxs0 * is_reg.astype(jnp.int32)]  # (b,p,d) i32
+    Kms = tables.km_weights[idxs1]  # (b,p,d) f32
+    signs = tables.signs[idxs2]  # (b,p,d) i32
+
+    # vector tokens
+    reacts = tables.reactions[idxs3 * is_catal.astype(jnp.int32)]  # (b,p,d,s)
+    trnspts = tables.transports[idxs3 * is_trnsp.astype(jnp.int32)]
+    effectors = tables.effectors[idxs3 * is_reg.astype(jnp.int32)]
+
+    # Vmax: average over defined domains
+    Vmax = _nanmean0(Vmaxs, axis=2)  # (b,p)
+
+    # allosteric exponents: effector vectors weighted by sign*hill
+    A = jnp.sum(effectors * (signs * Hills)[..., None], axis=2)  # (b,p,s) i32
+
+    # regulatory Kms separated per effector signal, averaged over domains
+    Kmr_d = jnp.where(is_reg, Kms, jnp.nan)  # (b,p,d)
+    Kmr_ds = effectors.astype(jnp.float32) * Kmr_d[..., None]  # (b,p,d,s)
+    Kmr_ds = jnp.where(Kmr_ds == 0.0, jnp.nan, Kmr_ds)  # effectors add 0s
+    Kmr = _nanmean0(Kmr_ds, axis=2)  # (b,p,s)
+    Kmr = jnp.power(Kmr, A.astype(jnp.float32))  # pre-exponentiated by hill
+
+    # stoichiometry; Nf/Nb split keeps zero-net cofactors alive
+    N_d = (reacts + trnspts) * signs[..., None]  # (b,p,d,s) i32
+    N = jnp.sum(N_d, axis=2)
+    Nf = jnp.sum(jnp.where(N_d < 0, -N_d, 0), axis=2)
+    Nb = jnp.sum(jnp.where(N_d > 0, N_d, 0), axis=2)
+
+    # Km of catalytic/transporter domains
+    Kmn = _nanmean0(jnp.where(~is_reg, Kms, jnp.nan), axis=2)  # (b,p)
+
+    # energies -> equilibrium constant, clamped against Inf/0
+    E = jnp.einsum("bps,s->bp", N.astype(jnp.float32), tables.mol_energies)
+    Ke = jnp.clip(jnp.exp(-E / abs_temp / GAS_CONSTANT), EPS, MAX)
+
+    # sampled Km defines the smaller side of Ke = Kmf/Kmb
+    is_fwd = Ke >= 1.0
+    Kmf = jnp.clip(jnp.where(is_fwd, Kmn, Kmn / Ke), EPS, MAX)
+    Kmb = jnp.clip(jnp.where(is_fwd, Kmn * Ke, Kmn), EPS, MAX)
+
+    return CellParams(Ke=Ke, Kmf=Kmf, Kmb=Kmb, Kmr=Kmr, Vmax=Vmax, N=N, Nf=Nf, Nb=Nb, A=A)
+
+
+@jax.jit
+def scatter_params(
+    state: CellParams, batch: CellParams, cell_idxs: jax.Array
+) -> CellParams:
+    """Write batch parameter rows into state at cell_idxs (OOB = dropped)."""
+    return CellParams(
+        *(
+            s.at[cell_idxs].set(b, mode="drop")
+            for s, b in zip(state, batch)
+        )
+    )
+
+
+@jax.jit
+def unset_params(state: CellParams, cell_idxs: jax.Array) -> CellParams:
+    """Zero parameter rows at cell_idxs (OOB = dropped)."""
+    return CellParams(
+        *(
+            s.at[cell_idxs].set(jnp.zeros((), dtype=s.dtype), mode="drop")
+            for s in state
+        )
+    )
+
+
+@jax.jit
+def copy_params(
+    state: CellParams, from_idxs: jax.Array, to_idxs: jax.Array
+) -> CellParams:
+    """Copy parameter rows from from_idxs to to_idxs (OOB = dropped).
+    Padding slots must point both indices at the same OOB value."""
+    return CellParams(
+        *(s.at[to_idxs].set(s[from_idxs], mode="drop") for s in state)
+    )
+
+
+@jax.jit
+def permute_params(state: CellParams, perm: jax.Array, n_keep: jax.Array) -> CellParams:
+    """
+    Gather rows by a full-capacity permutation and zero everything at
+    rank >= n_keep — compaction-on-kill with static shapes (SURVEY.md §7
+    design delta 1).
+    """
+    ranks = jnp.arange(perm.shape[0])
+    keep = ranks < n_keep
+
+    def gather(s: jax.Array) -> jax.Array:
+        out = s[perm]
+        mask = keep.reshape((-1,) + (1,) * (out.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros((), dtype=out.dtype))
+
+    return CellParams(*(gather(s) for s in state))

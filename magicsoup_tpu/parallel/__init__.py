@@ -1,0 +1,7 @@
+"""
+Multi-chip scaling utilities: device meshes, the tile-sharded world step
+(spatial domain decomposition of the molecule map with ICI halo exchange,
+cells sharded by the cell axis), and multi-host entry points.
+
+See :mod:`magicsoup_tpu.parallel.tiled`.
+"""

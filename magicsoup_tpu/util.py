@@ -1,0 +1,151 @@
+"""
+Host-side helper utilities: random sequence generation, codon enumeration,
+torus geometry.
+
+Parity reference: `python/magicsoup/util.py:10-125`.  Unlike the reference,
+every stochastic helper takes an optional ``rng`` (a ``random.Random``) so the
+whole framework can be seeded end-to-end; the module-level default keeps the
+reference's convenience of argument-free calls.  The torus geometry helpers
+(`dist_1d`, `moores_nghbhd`, `free_moores_nghbhd`) are implemented here in
+Python/numpy instead of delegating to a native library
+(reference: `rust/util.rs:2-64`) because they are only used on host-side
+bookkeeping paths; the hot spatial ops are vectorized in
+:mod:`magicsoup_tpu.world`.
+"""
+from typing import Iterable
+from itertools import product
+import string
+import random
+import math
+
+from magicsoup_tpu.constants import ALL_NTS, CODON_SIZE
+
+_DEFAULT_RNG = random.Random()
+
+
+def round_down(d: float, to: int = 3) -> int:
+    """Round down to declared integer multiple"""
+    return math.floor(d / to) * to
+
+
+def closest_value(values: Iterable[float], key: float) -> float:
+    """Get closest value to key in values"""
+    return min(values, key=lambda d: abs(d - key))
+
+
+def randstr(n: int = 12, rng: random.Random | None = None) -> str:
+    """
+    Generate random string of length `n`.
+
+    With `n=12` and 62 different characters there is a 50% chance of one
+    collision after 5e10 draws (birthday paradox).
+    """
+    rng = rng or _DEFAULT_RNG
+    chars = string.ascii_uppercase + string.ascii_lowercase + string.digits
+    return "".join(rng.choices(chars, k=n))
+
+
+def random_genome(
+    s: int = 500, excl: list[str] | None = None, rng: random.Random | None = None
+) -> str:
+    """
+    Generate a random nucleotide sequence string.
+
+    Parameters:
+        s: Length of genome in nucleotides
+        excl: Exclude certain sequences from the genome
+        rng: Optional seeded random generator
+
+    If `excl` is given all sequences in `excl` are removed.  They might still
+    appear in the reverse-complement; provide their reverse-complements too if
+    those should also be excluded.
+    """
+    rng = rng or _DEFAULT_RNG
+    out = "".join(rng.choices(ALL_NTS, k=s))
+    if excl is not None:
+        for seq in excl:
+            out = "".join(out.split(seq))
+        while len(out) != s:
+            n = s - len(out)
+            out += random_genome(s=n, rng=rng)
+            for seq in excl:
+                out = "".join(out.split(seq))
+    return out
+
+
+def variants(seq: str) -> list[str]:
+    """
+    Generate all possible nucleotide sequences from a template string.
+
+    Special characters: `N` any nucleotide, `R` purines (A/G),
+    `Y` pyrimidines (C/T).
+    """
+
+    def apply(s: str, char: str, nts: tuple[str, ...]) -> list[str]:
+        n = s.count(char)
+        for i in range(n):
+            idx = s.find(char)
+            s = s[:idx] + "{" + str(i) + "}" + s[idx + 1 :]
+        ns = [nts] * n
+        return [s.format(*d) for d in product(*ns)]
+
+    seqs1 = apply(seq, "N", ("T", "C", "G", "A"))
+    seqs2 = [ss for s in seqs1 for ss in apply(s, "R", ("A", "G"))]
+    seqs3 = [ss for s in seqs2 for ss in apply(s, "Y", ("C", "T"))]
+    return seqs3
+
+
+def codons(n: int, excl_codons: list[str] | None = None) -> list[str]:
+    """
+    All possible nucleotide sequences of `n` codons, optionally excluding
+    sequences that contain any codon from `excl_codons` at a codon boundary.
+    """
+    all_seqs = variants("N" * n * CODON_SIZE)
+    if excl_codons is None:
+        return all_seqs
+    seqs = []
+    for seq in all_seqs:
+        has_excl = False
+        for i in range(n):
+            a = i * CODON_SIZE
+            b = (i + 1) * CODON_SIZE
+            if seq[a:b] in excl_codons:
+                has_excl = True
+        if not has_excl:
+            seqs.append(seq)
+    return seqs
+
+
+def reverse_complement(seq: str) -> str:
+    """Reverse complement of a DNA sequence (only 'A', 'C', 'T', 'G')"""
+    return seq.translate(_COMPLEMENT)[::-1]
+
+
+_COMPLEMENT = str.maketrans("ACTG", "TGAC")
+
+
+def dist_1d(a: int, b: int, m: int) -> int:
+    """Distance between `a` and `b` on a circular 1D line of size `m`"""
+    d0 = abs(a - b)
+    return min(d0, m - d0)
+
+
+def moores_nghbhd(x: int, y: int, map_size: int) -> list[tuple[int, int]]:
+    """The 8 wrapped coordinates of the Moore neighborhood on a torus"""
+    e = (x + 1) % map_size
+    w = (x - 1) % map_size
+    s = (y + 1) % map_size
+    n = (y - 1) % map_size
+    return [(w, n), (w, y), (w, s), (x, n), (x, s), (e, n), (e, y), (e, s)]
+
+
+def free_moores_nghbhd(
+    x: int, y: int, positions: list[tuple[int, int]], map_size: int
+) -> list[tuple[int, int]]:
+    """
+    For position `(x, y)` get positions in its Moore neighborhood on a
+    circular 2D map of size `map_size` which are not occupied as indicated
+    by `positions`.
+    """
+    occupied = set(positions)
+    return [d for d in moores_nghbhd(x, y, map_size) if d not in occupied]

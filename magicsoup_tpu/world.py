@@ -1,0 +1,948 @@
+"""
+The World: the main API object holding all simulation state and the methods
+advancing it.
+
+Parity reference: `python/magicsoup/world.py:36-1004` — same surface
+(spawn/add/divide/update/kill/move/reposition cells, enzymatic_activity,
+diffuse/degrade_molecules, increment_cell_lifetimes, mutate/recombinate,
+get_cell/get_neighbors, save/load + light state checkpoints) and the same
+index semantics: cells are dense indices 0..n_cells-1, kill compacts and
+shifts indices, molecules are ordered as in :class:`Chemistry`.
+
+TPU-first architecture (SURVEY.md §7):
+
+- **capacity pools, not concatenation**: device tensors are allocated at a
+  power-of-two slot capacity and grown amortized; kill is a jitted
+  permutation-gather (stable compaction), divide/spawn are masked scatters.
+  XLA never sees a shape change except on capacity growth.
+- **host/device split**: genome strings, labels, positions, the boolean
+  cell map, lifetimes and divisions live host-side (numpy / lists);
+  the molecule map, intracellular molecules and all kinetic parameter
+  tensors live on device (HBM).  Per-step device work is a handful of
+  fused jit programs; per-event bookkeeping is cheap vectorized numpy.
+- **explicit seeding**: one ``seed`` drives placement, token maps and
+  mutations (the reference draws everything from process-global RNGs).
+"""
+import pickle
+import random
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.containers import Cell, Chemistry
+from magicsoup_tpu.genetics import Genetics
+from magicsoup_tpu.kinetics import Kinetics
+from magicsoup_tpu.native import engine as _engine
+from magicsoup_tpu.ops import diffusion as _diff
+from magicsoup_tpu.ops.integrate import integrate_signals
+from magicsoup_tpu.ops.params import pad_idxs, pad_pow2
+from magicsoup_tpu.util import randstr
+
+_MIN_CAPACITY = 64
+
+
+# --------------------------------------------------------------------- #
+# jitted state-update kernels (slot-capacity shapes, OOB idxs dropped)   #
+# --------------------------------------------------------------------- #
+
+
+@jax.jit
+def _enzymatic_activity(
+    molecule_map: jax.Array,  # (mols, m, m)
+    cell_molecules: jax.Array,  # (cap, mols)
+    positions: jax.Array,  # (cap, 2) int32; dead slots at (0, 0)
+    n_cells: jax.Array,  # scalar int
+    params,  # CellParams
+) -> tuple[jax.Array, jax.Array]:
+    """Gather signals, run the MM integrator, scatter back deltas
+    (reference world.py:610-625)."""
+    cap = cell_molecules.shape[0]
+    alive = (jnp.arange(cap) < n_cells)[:, None]  # (cap, 1)
+    xs, ys = positions[:, 0], positions[:, 1]
+    ext = molecule_map[:, xs, ys].T  # (cap, mols)
+    X0 = jnp.concatenate([cell_molecules, ext], axis=1)
+    X1 = integrate_signals(X0, params)
+    n_mols = cell_molecules.shape[1]
+    new_cm = jnp.where(alive, X1[:, :n_mols], cell_molecules)
+    delta_ext = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
+    new_map = molecule_map.at[:, xs, ys].add(delta_ext.T)
+    return new_map, new_cm
+
+
+@jax.jit
+def _diffuse_and_permeate(
+    molecule_map: jax.Array,
+    cell_molecules: jax.Array,
+    positions: jax.Array,
+    n_cells: jax.Array,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Map diffusion + membrane permeation (reference world.py:627-665)"""
+    new_map = _diff.diffuse(molecule_map, kernels)
+    cap = cell_molecules.shape[0]
+    alive = (jnp.arange(cap) < n_cells)[:, None]
+    xs, ys = positions[:, 0], positions[:, 1]
+    ext = new_map[:, xs, ys].T
+    new_cm, new_ext = _diff.permeate(cell_molecules, ext, perm_factors)
+    new_cm = jnp.where(alive, new_cm, cell_molecules)
+    delta_ext = jnp.where(alive, new_ext - ext, 0.0)
+    new_map = new_map.at[:, xs, ys].add(delta_ext.T)
+    return new_map, new_cm
+
+
+@jax.jit
+def _pickup_molecules(
+    molecule_map: jax.Array,
+    cell_molecules: jax.Array,
+    new_pos: jax.Array,  # (b_pad, 2); padding at (0, 0)
+    new_idxs: jax.Array,  # (b_pad,); padding OOB
+    valid: jax.Array,  # (b_pad,) bool
+) -> tuple[jax.Array, jax.Array]:
+    """New cells pick up half the molecules of their pixel
+    (reference world.py:336-338)."""
+    xs, ys = new_pos[:, 0], new_pos[:, 1]
+    pickup = molecule_map[:, xs, ys] * 0.5 * valid[None, :]  # (mols, b)
+    new_map = molecule_map.at[:, xs, ys].add(-pickup)
+    new_cm = cell_molecules.at[new_idxs].add(pickup.T, mode="drop")
+    return new_map, new_cm
+
+
+@jax.jit
+def _set_rows(
+    cell_molecules: jax.Array,
+    idxs: jax.Array,  # (b_pad,); padding OOB
+    values: jax.Array,  # (b_pad, mols)
+) -> jax.Array:
+    return cell_molecules.at[idxs].set(values, mode="drop")
+
+
+@jax.jit
+def _spill_molecules(
+    molecule_map: jax.Array,
+    cell_molecules: jax.Array,
+    positions: jax.Array,
+    idxs: jax.Array,  # (b_pad,); padding OOB
+    valid: jax.Array,  # (b_pad,) bool
+) -> jax.Array:
+    """Killed cells dump their contents onto their pixel
+    (reference world.py:520-525)."""
+    pos = positions[idxs]  # OOB clamps; masked below
+    spill = cell_molecules[idxs] * valid[:, None]  # (b, mols)
+    return molecule_map.at[:, pos[:, 0], pos[:, 1]].add(spill.T)
+
+
+@jax.jit
+def _divide_molecules(
+    cell_molecules: jax.Array,
+    parent_idxs: jax.Array,  # (b_pad,); padding OOB
+    child_idxs: jax.Array,  # (b_pad,); padding OOB
+) -> jax.Array:
+    """Molecules are shared evenly among both descendants
+    (reference world.py:467-470)."""
+    half = cell_molecules[parent_idxs] * 0.5
+    cm = cell_molecules.at[parent_idxs].set(half, mode="drop")
+    return cm.at[child_idxs].set(half, mode="drop")
+
+
+@jax.jit
+def _permute_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Array:
+    """Stable compaction: gather rows by permutation, zero rank >= n_keep"""
+    out = arr[perm]
+    keep = (jnp.arange(perm.shape[0]) < n_keep).reshape(
+        (-1,) + (1,) * (out.ndim - 1)
+    )
+    return jnp.where(keep, out, jnp.zeros((), dtype=out.dtype))
+
+
+class World:
+    """
+    Main API for running the simulation; holds the state and offers methods
+    to advance it.
+
+    Parameters:
+        chemistry: :class:`Chemistry` with molecules and reactions.
+        map_size: Number of pixels in x and y direction of the world torus.
+        abs_temp: Absolute temperature (K); influences reaction equilibria.
+        mol_map_init: Initial molecule map concentrations — ``"randn"``
+            (|N(10, 1)|) or ``"zeros"``.
+        start_codons: Codons starting a coding sequence.
+        stop_codons: Codons stopping a coding sequence.
+        device: Ignored placeholder for reference compatibility — tensors
+            live wherever JAX put them (TPU when available).  Use
+            ``JAX_PLATFORMS`` to pin a backend.
+        batch_size: Optional chunk size when updating cell parameters
+            (bounds memory peaks of spawn/update at many cells).
+        seed: Seed driving all randomness (placement, token maps,
+            mutations).  ``None`` draws a random seed.
+
+    State is exposed with the reference's names — ``cell_genomes``,
+    ``cell_labels``, ``cell_map``, ``cell_positions``, ``cell_lifetimes``,
+    ``cell_divisions``, ``cell_molecules``, ``molecule_map`` — with cells
+    always indexed 0..n_cells-1 (kill compacts indices, like the
+    reference).  Device-backed attributes are jax Arrays; assign through
+    the provided setters (jax arrays are immutable).
+    """
+
+    def __init__(
+        self,
+        chemistry: Chemistry,
+        map_size: int = 128,
+        abs_temp: float = 310.0,
+        mol_map_init: str = "randn",
+        start_codons: tuple[str, ...] = ("TTG", "GTG", "ATG"),
+        stop_codons: tuple[str, ...] = ("TGA", "TAG", "TAA"),
+        device: str | None = None,
+        batch_size: int | None = None,
+        seed: int | None = None,
+    ):
+        if seed is None:
+            seed = random.SystemRandom().randrange(2**63)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._nprng = np.random.default_rng(seed)
+
+        self.device = device
+        self.batch_size = batch_size
+        self.map_size = map_size
+        self.abs_temp = abs_temp
+        self.chemistry = chemistry
+
+        self.genetics = Genetics(
+            start_codons=start_codons,
+            stop_codons=stop_codons,
+            seed=self._rng.randrange(2**63),
+        )
+        self.kinetics = Kinetics(
+            chemistry=chemistry,
+            abs_temp=abs_temp,
+            scalar_enc_size=max(self.genetics.one_codon_map.values()),
+            vector_enc_size=max(self.genetics.two_codon_map.values()),
+            seed=self._rng.randrange(2**63),
+        )
+
+        mols = chemistry.molecules
+        self.n_molecules = len(mols)
+        self._diff_kernels = jnp.asarray(
+            _diff.diffusion_kernels([d.diffusivity for d in mols])
+        )
+        self._perm_factors = jnp.asarray(
+            _diff.permeation_factors([d.permeability for d in mols])
+        )
+        self._degrad_factors = jnp.asarray(
+            _diff.degradation_factors([d.half_life for d in mols])
+        )
+
+        # host-side state
+        self.n_cells = 0
+        self.cell_genomes: list[str] = []
+        self.cell_labels: list[str] = []
+        self._capacity = 0
+        self._np_cell_map = np.zeros((map_size, map_size), dtype=bool)
+        self._np_positions = np.zeros((0, 2), dtype=np.int32)
+        self._np_lifetimes = np.zeros(0, dtype=np.int32)
+        self._np_divisions = np.zeros(0, dtype=np.int32)
+
+        # device-side state
+        self._cell_molecules = jnp.zeros((0, self.n_molecules), dtype=jnp.float32)
+        self._positions_dev = jnp.zeros((0, 2), dtype=jnp.int32)
+        self._molecule_map = self._init_molecule_map(mol_map_init)
+
+        self._ensure_capacity(_MIN_CAPACITY)
+
+    # ------------------------------------------------------------------ #
+    # state views                                                        #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def molecule_map(self) -> jax.Array:
+        """(n_mols, m, m) float32 molecule concentrations on the map"""
+        return self._molecule_map
+
+    @molecule_map.setter
+    def molecule_map(self, value):
+        value = jnp.asarray(value, dtype=jnp.float32)
+        if value.shape != self._molecule_map.shape:
+            raise ValueError(f"molecule_map must have shape {self._molecule_map.shape}")
+        self._molecule_map = value
+
+    @property
+    def cell_molecules(self) -> jax.Array:
+        """(n_cells, n_mols) float32 intracellular concentrations"""
+        return self._cell_molecules[: self.n_cells]
+
+    @cell_molecules.setter
+    def cell_molecules(self, value):
+        value = jnp.asarray(value, dtype=jnp.float32)
+        if value.shape != (self.n_cells, self.n_molecules):
+            raise ValueError(
+                f"cell_molecules must have shape {(self.n_cells, self.n_molecules)}"
+            )
+        self._cell_molecules = self._cell_molecules.at[: self.n_cells].set(value)
+
+    @property
+    def cell_map(self) -> np.ndarray:
+        """(m, m) bool — which pixels are occupied by a cell (host numpy)"""
+        return self._np_cell_map
+
+    @property
+    def cell_positions(self) -> np.ndarray:
+        """(n_cells, 2) int32 cell positions (host numpy)"""
+        return self._np_positions[: self.n_cells]
+
+    @property
+    def cell_lifetimes(self) -> np.ndarray:
+        """(n_cells,) int32 — steps alive since spawn or last division"""
+        return self._np_lifetimes[: self.n_cells]
+
+    @cell_lifetimes.setter
+    def cell_lifetimes(self, value):
+        self._np_lifetimes[: self.n_cells] = np.asarray(value, dtype=np.int32)
+
+    @property
+    def cell_divisions(self) -> np.ndarray:
+        """(n_cells,) int32 — number of ancestor divisions"""
+        return self._np_divisions[: self.n_cells]
+
+    @cell_divisions.setter
+    def cell_divisions(self, value):
+        self._np_divisions[: self.n_cells] = np.asarray(value, dtype=np.int32)
+
+    # ------------------------------------------------------------------ #
+    # capacity                                                           #
+    # ------------------------------------------------------------------ #
+
+    def _ensure_capacity(self, n: int):
+        if n <= self._capacity:
+            return
+        cap = pad_pow2(n, minimum=_MIN_CAPACITY)
+        grow = cap - self._capacity
+        self._np_positions = np.concatenate(
+            [self._np_positions, np.zeros((grow, 2), dtype=np.int32)]
+        )
+        self._np_lifetimes = np.concatenate(
+            [self._np_lifetimes, np.zeros(grow, dtype=np.int32)]
+        )
+        self._np_divisions = np.concatenate(
+            [self._np_divisions, np.zeros(grow, dtype=np.int32)]
+        )
+        cm = np.zeros((cap, self.n_molecules), dtype=np.float32)
+        cm[: self._capacity] = np.asarray(self._cell_molecules)
+        self._cell_molecules = jnp.asarray(cm)
+        self._capacity = cap
+        self._sync_positions()
+        self.kinetics.ensure_capacity(n_cells=cap)
+
+    def _sync_positions(self):
+        self._positions_dev = jnp.asarray(self._np_positions)
+
+    def _n_cells_dev(self) -> jax.Array:
+        return jnp.asarray(self.n_cells, dtype=jnp.int32)
+
+    def _init_molecule_map(self, init: str) -> jax.Array:
+        shape = (self.n_molecules, self.map_size, self.map_size)
+        if init == "zeros":
+            return jnp.zeros(shape, dtype=jnp.float32)
+        if init == "randn":
+            arr = np.abs(
+                self._nprng.standard_normal(shape, dtype=np.float32) + 10.0
+            )
+            return jnp.asarray(arr)
+        raise ValueError(
+            f"Didnt recognize mol_map_init={init}. Should be one of: 'zeros', 'randn'."
+        )
+
+    # ------------------------------------------------------------------ #
+    # interpretation                                                     #
+    # ------------------------------------------------------------------ #
+
+    def get_cell(
+        self,
+        by_idx: int | None = None,
+        by_position: tuple[int, int] | None = None,
+    ) -> Cell:
+        """Get a :class:`Cell` view of one cell (analysis helper)"""
+        idx = -1
+        if by_idx is not None:
+            idx = by_idx
+        if by_position is not None:
+            pos = np.asarray(by_position, dtype=np.int32)
+            hits = np.nonzero((self.cell_positions == pos).all(axis=1))[0]
+            if len(hits) == 0:
+                raise ValueError(f"Cell at {by_position} not found")
+            idx = int(hits[0])
+
+        return Cell(
+            world=self,
+            idx=idx,
+            genome=self.cell_genomes[idx],
+            position=tuple(self._np_positions[idx].tolist()),  # type: ignore
+            label=self.cell_labels[idx],
+            n_steps_alive=int(self._np_lifetimes[idx]),
+            n_divisions=int(self._np_divisions[idx]),
+        )
+
+    def get_neighbors(
+        self, cell_idxs: list[int], nghbr_idxs: list[int] | None = None
+    ) -> list[tuple[int, int]]:
+        """
+        Unique Moore-neighborhood pairs among cells (smaller index first).
+        With ``nghbr_idxs`` given, pairs are restricted to partners from
+        that list (reference world.py:247-285; vectorized via an occupancy
+        grid instead of pairwise distances).
+        """
+        if len(cell_idxs) == 0:
+            return []
+        from_idxs = np.array(sorted(set(cell_idxs)), dtype=np.int64)
+        if nghbr_idxs is None:
+            to_member = np.zeros(self.n_cells, dtype=bool)
+            to_member[from_idxs] = True
+        else:
+            if len(nghbr_idxs) == 0:
+                return []
+            to_member = np.zeros(self.n_cells, dtype=bool)
+            to_member[list(set(nghbr_idxs))] = True
+
+        m = self.map_size
+        grid = np.full((m, m), -1, dtype=np.int64)
+        pos = self._np_positions[: self.n_cells]
+        grid[pos[:, 0], pos[:, 1]] = np.arange(self.n_cells)
+
+        fp = pos[from_idxs]  # (k, 2)
+        dx = np.array([-1, -1, -1, 0, 0, 1, 1, 1])
+        dy = np.array([-1, 0, 1, -1, 1, -1, 0, 1])
+        nx = (fp[:, 0][:, None] + dx[None, :]) % m
+        ny = (fp[:, 1][:, None] + dy[None, :]) % m
+        cand = grid[nx, ny]  # (k, 8)
+        src = np.broadcast_to(from_idxs[:, None], cand.shape)
+        valid = (cand >= 0) & to_member[np.clip(cand, 0, None)] & (cand != src)
+        a = src[valid]
+        b = cand[valid]
+        lo = np.minimum(a, b)
+        hi = np.maximum(a, b)
+        pairs = np.unique(np.stack([lo, hi], axis=1), axis=0)
+        return [tuple(d) for d in pairs.tolist()]
+
+    # ------------------------------------------------------------------ #
+    # cell lifecycle                                                     #
+    # ------------------------------------------------------------------ #
+
+    def _find_free_random_positions(self, n_cells: int) -> np.ndarray:
+        free = np.argwhere(~self._np_cell_map)
+        if n_cells > len(free):
+            n_cells = len(free)
+        chosen = self._nprng.choice(len(free), size=n_cells, replace=False)
+        return free[chosen].astype(np.int32)
+
+    def spawn_cells(self, genomes: list[str]) -> list[int]:
+        """
+        Create new cells from genome strings and place them on random free
+        pixels.  Each new cell picks up half the molecules of its pixel,
+        gets lifetime 0, 0 divisions, and a random label.  Returns the new
+        cell indexes.
+        """
+        n_new = len(genomes)
+        if n_new == 0:
+            return []
+        free_pos = self._find_free_random_positions(n_cells=n_new)
+        if len(free_pos) == 0:
+            return []
+        if len(free_pos) < n_new:
+            n_new = len(free_pos)
+            genomes = list(genomes)
+            self._rng.shuffle(genomes)
+            genomes = genomes[:n_new]
+
+        new_idxs = list(range(self.n_cells, self.n_cells + n_new))
+        self._ensure_capacity(self.n_cells + n_new)
+        self.n_cells += n_new
+        self.cell_genomes.extend(genomes)
+        self.cell_labels.extend(randstr(n=12, rng=self._rng) for _ in range(n_new))
+
+        self._np_cell_map[free_pos[:, 0], free_pos[:, 1]] = True
+        self._np_positions[new_idxs] = free_pos
+        self._np_lifetimes[new_idxs] = 0
+        self._np_divisions[new_idxs] = 0
+        self._sync_positions()
+
+        idxs_pad = pad_idxs(np.asarray(new_idxs), oob=self._capacity)
+        b_pad = len(idxs_pad)
+        pos_pad = np.zeros((b_pad, 2), dtype=np.int32)
+        pos_pad[:n_new] = free_pos
+        valid = np.zeros(b_pad, dtype=bool)
+        valid[:n_new] = True
+        self._molecule_map, self._cell_molecules = _pickup_molecules(
+            self._molecule_map,
+            self._cell_molecules,
+            jnp.asarray(pos_pad),
+            jnp.asarray(idxs_pad),
+            jnp.asarray(valid),
+        )
+
+        self._update_cell_params(genomes=genomes, idxs=new_idxs)
+        return new_idxs
+
+    def add_cells(self, cells: list[Cell]) -> list[int]:
+        """
+        Place :class:`Cell` objects on random free pixels, keeping their
+        genomes, molecules, lifetimes, divisions and labels.  Returns the
+        new cell indexes.
+        """
+        n_new = len(cells)
+        if n_new == 0:
+            return []
+        free_pos = self._find_free_random_positions(n_cells=n_new)
+        if len(free_pos) == 0:
+            return []
+        if len(free_pos) < n_new:
+            n_new = len(free_pos)
+            cells = list(cells)
+            self._rng.shuffle(cells)
+            cells = cells[:n_new]
+
+        new_idxs = list(range(self.n_cells, self.n_cells + n_new))
+        self._ensure_capacity(self.n_cells + n_new)
+        self.n_cells += n_new
+        for cell in cells:
+            self.cell_genomes.append(cell.genome)
+            self.cell_labels.append(cell.label)
+
+        self._np_cell_map[free_pos[:, 0], free_pos[:, 1]] = True
+        self._np_positions[new_idxs] = free_pos
+        self._np_lifetimes[new_idxs] = [d.n_steps_alive for d in cells]
+        self._np_divisions[new_idxs] = [d.n_divisions for d in cells]
+        self._sync_positions()
+
+        idxs_pad = pad_idxs(np.asarray(new_idxs), oob=self._capacity)
+        vals = np.zeros((len(idxs_pad), self.n_molecules), dtype=np.float32)
+        vals[:n_new] = np.stack([np.asarray(d.int_molecules) for d in cells])
+        self._cell_molecules = _set_rows(
+            self._cell_molecules, jnp.asarray(idxs_pad), jnp.asarray(vals)
+        )
+
+        self._update_cell_params(genomes=[d.genome for d in cells], idxs=new_idxs)
+        return new_idxs
+
+    def divide_cells(self, cell_idxs: list[int]) -> list[tuple[int, int]]:
+        """
+        Divide cells that have at least one free Moore-neighborhood pixel;
+        the clone lands there.  Descendants share molecules evenly, get
+        divisions + 1 and lifetime 0.  Returns ``(parent_idx, child_idx)``
+        tuples of successful divisions.
+        """
+        if len(cell_idxs) == 0:
+            return []
+        cell_idxs = sorted(set(cell_idxs))
+
+        # sequential conflict-free child placement (reference
+        # rust/world.rs:59-97); the host cell map doubles as the conflict set
+        m = self.map_size
+        parent_idxs: list[int] = []
+        child_pos: list[tuple[int, int]] = []
+        cmap = self._np_cell_map
+        for idx in cell_idxs:
+            x, y = self._np_positions[idx]
+            opts = [
+                ((x + dx) % m, (y + dy) % m)
+                for dx in (-1, 0, 1)
+                for dy in (-1, 0, 1)
+                if not (dx == 0 and dy == 0)
+            ]
+            opts = [d for d in opts if not cmap[d[0], d[1]]]
+            if len(opts) == 0:
+                continue
+            pos = opts[self._rng.randrange(len(opts))]
+            cmap[pos[0], pos[1]] = True
+            parent_idxs.append(int(idx))
+            child_pos.append(pos)
+
+        n_new = len(parent_idxs)
+        if n_new == 0:
+            return []
+        child_idxs = list(range(self.n_cells, self.n_cells + n_new))
+        self._ensure_capacity(self.n_cells + n_new)
+        self.n_cells += n_new
+
+        self.cell_genomes.extend([self.cell_genomes[d] for d in parent_idxs])
+        self.cell_labels.extend([self.cell_labels[d] for d in parent_idxs])
+
+        child_pos_arr = np.array(child_pos, dtype=np.int32)
+        self._np_positions[child_idxs] = child_pos_arr
+        descendant_idxs = parent_idxs + child_idxs
+        self._np_divisions[child_idxs] = self._np_divisions[parent_idxs]
+        self._np_divisions[descendant_idxs] += 1
+        self._np_lifetimes[descendant_idxs] = 0
+        self._sync_positions()
+
+        p_pad = pad_idxs(np.asarray(parent_idxs), oob=self._capacity)
+        c_pad = pad_idxs(np.asarray(child_idxs), oob=self._capacity)
+        self._cell_molecules = _divide_molecules(
+            self._cell_molecules, jnp.asarray(p_pad), jnp.asarray(c_pad)
+        )
+        self.kinetics.copy_cell_params(from_idxs=parent_idxs, to_idxs=child_idxs)
+
+        return list(zip(parent_idxs, child_idxs))
+
+    def update_cells(self, genome_idx_pairs: list[tuple[str, int]]):
+        """Update existing cells with new genomes and re-derive their
+        proteomes."""
+        if len(genome_idx_pairs) == 0:
+            return
+        for genome, idx in genome_idx_pairs:
+            self.cell_genomes[idx] = genome
+        genomes, idxs = map(list, zip(*genome_idx_pairs))
+        self._update_cell_params(genomes=genomes, idxs=idxs)  # type: ignore
+
+    def kill_cells(self, cell_idxs: list[int] | None = None):
+        """
+        Remove cells; their molecule contents spill onto their pixel.
+        Cells are compacted, so surviving cells' indexes shift down
+        (reference world.py:495-540).
+        """
+        if cell_idxs is None:
+            cell_idxs = list(range(self.n_cells))
+        if len(cell_idxs) == 0:
+            return
+        kill = np.array(sorted(set(cell_idxs)), dtype=np.int32)
+
+        # spill contents, free pixels
+        idxs_pad = pad_idxs(kill, oob=self._capacity)
+        valid = np.zeros(len(idxs_pad), dtype=bool)
+        valid[: len(kill)] = True
+        self._molecule_map = _spill_molecules(
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            jnp.asarray(idxs_pad),
+            jnp.asarray(valid),
+        )
+        pos = self._np_positions[kill]
+        self._np_cell_map[pos[:, 0], pos[:, 1]] = False
+
+        # stable compaction permutation over the full capacity
+        keep_mask = np.ones(self._capacity, dtype=bool)
+        keep_mask[kill] = False
+        keep_mask[self.n_cells :] = False
+        perm = np.concatenate(
+            [np.nonzero(keep_mask)[0], np.nonzero(~keep_mask)[0]]
+        ).astype(np.int32)
+        n_keep = int(keep_mask.sum())
+
+        self._cell_molecules = _permute_rows(
+            self._cell_molecules, jnp.asarray(perm), jnp.asarray(n_keep)
+        )
+        self.kinetics.permute_cells(perm, n_keep)
+        self._np_positions = self._np_positions[perm]
+        self._np_positions[n_keep:] = 0
+        self._np_lifetimes = self._np_lifetimes[perm]
+        self._np_lifetimes[n_keep:] = 0
+        self._np_divisions = self._np_divisions[perm]
+        self._np_divisions[n_keep:] = 0
+        self._sync_positions()
+
+        kill_set = set(kill.tolist())
+        self.cell_genomes = [
+            g for i, g in enumerate(self.cell_genomes) if i not in kill_set
+        ]
+        self.cell_labels = [
+            l for i, l in enumerate(self.cell_labels) if i not in kill_set
+        ]
+        self.n_cells -= len(kill)
+
+    def move_cells(self, cell_idxs: list[int] | None = None):
+        """
+        Move cells to a random free pixel in their Moore neighborhood
+        (cells with no free neighbor stay).  Processed sequentially so a
+        pixel vacated earlier can be taken by a later cell
+        (reference rust/world.rs:102-146).
+        """
+        if cell_idxs is None:
+            cell_idxs = list(range(self.n_cells))
+        if len(cell_idxs) == 0:
+            return
+        cell_idxs = sorted(set(cell_idxs))
+        m = self.map_size
+        cmap = self._np_cell_map
+        for idx in cell_idxs:
+            x, y = self._np_positions[idx]
+            opts = [
+                ((x + dx) % m, (y + dy) % m)
+                for dx in (-1, 0, 1)
+                for dy in (-1, 0, 1)
+                if not (dx == 0 and dy == 0)
+            ]
+            opts = [d for d in opts if not cmap[d[0], d[1]]]
+            if len(opts) == 0:
+                continue
+            new = opts[self._rng.randrange(len(opts))]
+            cmap[x, y] = False
+            cmap[new[0], new[1]] = True
+            self._np_positions[idx] = new
+        self._sync_positions()
+
+    def reposition_cells(self, cell_idxs: list[int] | None = None):
+        """Teleport cells to random free pixels without changing them"""
+        if cell_idxs is None:
+            cell_idxs = list(range(self.n_cells))
+        if len(cell_idxs) == 0:
+            return
+        cell_idxs = sorted(set(cell_idxs))
+        old = self._np_positions[cell_idxs]
+        self._np_cell_map[old[:, 0], old[:, 1]] = False
+        new_pos = self._find_free_random_positions(n_cells=len(cell_idxs))
+        self._np_cell_map[new_pos[:, 0], new_pos[:, 1]] = True
+        self._np_positions[cell_idxs] = new_pos
+        self._sync_positions()
+
+    # ------------------------------------------------------------------ #
+    # physics                                                            #
+    # ------------------------------------------------------------------ #
+
+    def enzymatic_activity(self):
+        """Catalyze reactions and transport for one time step; updates
+        ``molecule_map`` and ``cell_molecules``."""
+        if self.n_cells == 0:
+            return
+        self._molecule_map, self._cell_molecules = _enzymatic_activity(
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            self._n_cells_dev(),
+            self.kinetics.params,
+        )
+
+    def diffuse_molecules(self):
+        """Let molecules diffuse over the map and permeate membranes for
+        one time step."""
+        if self.n_cells == 0:
+            self._molecule_map = _diff.diffuse(self._molecule_map, self._diff_kernels)
+            return
+        self._molecule_map, self._cell_molecules = _diffuse_and_permeate(
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            self._n_cells_dev(),
+            self._diff_kernels,
+            self._perm_factors,
+        )
+
+    def degrade_molecules(self):
+        """Degrade molecules everywhere by one time step"""
+        self._molecule_map, self._cell_molecules = _diff.degrade(
+            self._molecule_map, self._cell_molecules, self._degrad_factors
+        )
+
+    def increment_cell_lifetimes(self):
+        """Increment ``cell_lifetimes`` by 1"""
+        self._np_lifetimes[: self.n_cells] += 1
+
+    # ------------------------------------------------------------------ #
+    # evolution                                                          #
+    # ------------------------------------------------------------------ #
+
+    def mutate_cells(
+        self,
+        cell_idxs: list[int] | None = None,
+        p: float = 1e-6,
+        p_indel: float = 0.4,
+        p_del: float = 0.66,
+    ):
+        """Point-mutate cell genomes, then update changed cells"""
+        seed = int(self._nprng.integers(2**63))
+        if cell_idxs is None:
+            seqs = self.cell_genomes
+            mutated = _engine.point_mutations(
+                seqs, p=p, p_indel=p_indel, p_del=p_del, seed=seed
+            )
+            self.update_cells(genome_idx_pairs=mutated)
+        else:
+            seqs = [self.cell_genomes[d] for d in cell_idxs]
+            mutated = _engine.point_mutations(
+                seqs, p=p, p_indel=p_indel, p_del=p_del, seed=seed
+            )
+            pairs = [(d, cell_idxs[i]) for d, i in mutated]
+            self.update_cells(genome_idx_pairs=pairs)
+
+    def recombinate_cells(self, cell_idxs: list[int] | None = None, p: float = 1e-7):
+        """Recombinate genomes of neighboring cells, then update changed
+        cells."""
+        idxs = list(range(self.n_cells)) if cell_idxs is None else cell_idxs
+        nghbrs = self.get_neighbors(cell_idxs=idxs)
+        pairs = [(self.cell_genomes[a], self.cell_genomes[b]) for a, b in nghbrs]
+        seed = int(self._nprng.integers(2**63))
+        mutated = _engine.recombinations(pairs, p=p, seed=seed)
+        genome_idx_pairs = []
+        for c0, c1, idx in mutated:
+            c0_i, c1_i = nghbrs[idx]
+            genome_idx_pairs.append((c0, c0_i))
+            genome_idx_pairs.append((c1, c1_i))
+        self.update_cells(genome_idx_pairs=genome_idx_pairs)
+
+    # ------------------------------------------------------------------ #
+    # parameter updates                                                  #
+    # ------------------------------------------------------------------ #
+
+    def _update_cell_params(self, genomes: list[str], idxs: list[int]):
+        """Translate genomes and write kinetic parameters for these cells
+        (reference world.py:880-908)."""
+        prot_counts, prots, doms = self.genetics.translate_genomes_flat(genomes)
+        idxs_arr = np.asarray(idxs, dtype=np.int32)
+        has_prots = prot_counts > 0
+        unset_idxs = idxs_arr[~has_prots]
+        set_idxs = idxs_arr[has_prots]
+
+        self.kinetics.unset_cell_params(unset_idxs)
+        if len(set_idxs) == 0:
+            return
+
+        set_counts = prot_counts[has_prots]
+        batch = self.batch_size or len(set_idxs)
+        # chunk over cells to bound assembly memory peaks
+        prot_offs = np.concatenate([[0], np.cumsum(set_counts)])
+        dom_counts_per_prot = prots[:, 3]
+        dom_offs = np.concatenate([[0], np.cumsum(dom_counts_per_prot)])
+        for a in range(0, len(set_idxs), batch):
+            b = min(a + batch, len(set_idxs))
+            pa, pb = prot_offs[a], prot_offs[b]
+            da, db = dom_offs[pa], dom_offs[pb]
+            self.kinetics.set_cell_params_flat(
+                set_idxs[a:b],
+                set_counts[a:b],
+                prots[pa:pb],
+                doms[da:db],
+            )
+
+    # ------------------------------------------------------------------ #
+    # persistence                                                        #
+    # ------------------------------------------------------------------ #
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        # device arrays -> numpy for portable pickles
+        state["_cell_molecules"] = np.asarray(self._cell_molecules)
+        state["_molecule_map"] = np.asarray(self._molecule_map)
+        state["_diff_kernels"] = np.asarray(self._diff_kernels)
+        state["_perm_factors"] = np.asarray(self._perm_factors)
+        state["_degrad_factors"] = np.asarray(self._degrad_factors)
+        state.pop("_positions_dev")
+        return state
+
+    def __setstate__(self, state: dict):
+        self.__dict__.update(state)
+        self._cell_molecules = jnp.asarray(state["_cell_molecules"])
+        self._molecule_map = jnp.asarray(state["_molecule_map"])
+        self._diff_kernels = jnp.asarray(state["_diff_kernels"])
+        self._perm_factors = jnp.asarray(state["_perm_factors"])
+        self._degrad_factors = jnp.asarray(state["_degrad_factors"])
+        self._sync_positions()
+
+    def save(self, rundir: Path, name: str = "world.pkl"):
+        """
+        Write the whole world object (chemistry, genetics, kinetics, state)
+        to a pickle file; restore with :meth:`from_file`.  For small
+        per-step snapshots use :meth:`save_state`.
+        """
+        rundir = Path(rundir)
+        rundir.mkdir(parents=True, exist_ok=True)
+        with open(rundir / name, "wb") as fh:
+            pickle.dump(self, fh)
+
+    @classmethod
+    def from_file(
+        cls,
+        rundir: Path,
+        name: str = "world.pkl",
+        device: str | None = None,
+    ) -> "World":
+        """Restore a world saved with :meth:`save`"""
+        with open(Path(rundir) / name, "rb") as fh:
+            obj: "World" = pickle.load(fh)
+        if device is not None:
+            obj.device = device
+        return obj
+
+    def save_state(self, statedir: Path):
+        """
+        Lightweight per-step checkpoint: the mutable tensors as ``.npy``
+        files plus a FASTA of genomes/labels (reference world.py:795-822).
+        """
+        statedir = Path(statedir)
+        statedir.mkdir(parents=True, exist_ok=True)
+        n = self.n_cells
+        np.save(statedir / "cell_molecules.npy", np.asarray(self._cell_molecules[:n]))
+        np.save(statedir / "cell_map.npy", self._np_cell_map)
+        np.save(statedir / "molecule_map.npy", np.asarray(self._molecule_map))
+        np.save(statedir / "cell_lifetimes.npy", self._np_lifetimes[:n])
+        np.save(statedir / "cell_positions.npy", self._np_positions[:n])
+        np.save(statedir / "cell_divisions.npy", self._np_divisions[:n])
+
+        lines = [
+            f">{idx} {label}\n{genome}"
+            for idx, (genome, label) in enumerate(
+                zip(self.cell_genomes, self.cell_labels)
+            )
+        ]
+        with open(statedir / "cells.fasta", "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines))
+
+    def load_state(self, statedir: Path, ignore_cell_params: bool = False):
+        """
+        Restore a state saved with :meth:`save_state`.  Unless
+        ``ignore_cell_params`` is set, all genomes are re-translated (a
+        full parameter-update pass, reference world.py:824-878).
+        """
+        statedir = Path(statedir)
+        if not ignore_cell_params:
+            self.kill_cells(cell_idxs=list(range(self.n_cells)))
+
+        cm = np.load(statedir / "cell_molecules.npy")
+        self._np_cell_map = np.load(statedir / "cell_map.npy")
+        self._molecule_map = jnp.asarray(np.load(statedir / "molecule_map.npy"))
+        lifetimes = np.load(statedir / "cell_lifetimes.npy")
+        positions = np.load(statedir / "cell_positions.npy")
+        divisions = np.load(statedir / "cell_divisions.npy")
+
+        with open(statedir / "cells.fasta", "r", encoding="utf-8") as fh:
+            entries = [d.strip() for d in fh.read().split(">") if len(d.strip()) > 0]
+
+        self.cell_labels = []
+        self.cell_genomes = []
+        genome_idx_pairs: list[tuple[str, int]] = []
+        for idx, entry in enumerate(entries):
+            parts = entry.split("\n")
+            descr = parts[0]
+            seq = "" if len(parts) < 2 else parts[1]
+            names = descr.split()
+            label = names[1].strip() if len(names) > 1 else ""
+            self.cell_genomes.append(seq)
+            self.cell_labels.append(label)
+            genome_idx_pairs.append((seq, idx))
+
+        n = len(genome_idx_pairs)
+        self.n_cells = 0
+        self._ensure_capacity(n)
+        self.n_cells = n
+        self._np_positions[:n] = positions
+        self._np_positions[n:] = 0
+        self._np_lifetimes[:n] = lifetimes
+        self._np_lifetimes[n:] = 0
+        self._np_divisions[:n] = divisions
+        self._np_divisions[n:] = 0
+        self._sync_positions()
+        full_cm = np.zeros((self._capacity, self.n_molecules), dtype=np.float32)
+        full_cm[:n] = cm
+        self._cell_molecules = jnp.asarray(full_cm)
+
+        if not ignore_cell_params:
+            self.update_cells(genome_idx_pairs=genome_idx_pairs)
+
+    def __repr__(self) -> str:
+        kwargs = {
+            "map_size": self.map_size,
+            "abs_temp": self.abs_temp,
+            "device": self.device,
+        }
+        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
+        return f"{type(self).__name__}({','.join(args)})"

@@ -1,0 +1,47 @@
+"""
+Test configuration: pin JAX to a virtual 8-device CPU mesh (fast,
+deterministic, and lets shard_map tests run without TPU hardware — the
+reference's test strategy adapted per SURVEY.md §4) and provide the Retry
+helper for inherently flaky statistical tests
+(reference tests/conftest.py:12-29).
+"""
+import os
+import sys
+from pathlib import Path
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_compilation_cache", True)
+
+
+class Retry:
+    """
+    Context manager counting down allowed failures for statistical tests:
+
+        retry = Retry(n_allowed_fails=2)
+        for _ in range(3):
+            with retry:
+                assert might_fail()
+    """
+
+    def __init__(self, n_allowed_fails: int = 1):
+        self.n_allowed_fails = n_allowed_fails
+        self.n_fails = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        if exc_type is None:
+            return True
+        self.n_fails += 1
+        if self.n_fails > self.n_allowed_fails:
+            return False
+        return True
