@@ -1,0 +1,134 @@
+"""
+Container semantics tests (parity targets: reference
+tests/fast/test_containers.py behaviors — molecule interning, chemistry
+validation, dict round-trips).
+"""
+import pickle
+
+import pytest
+
+import magicsoup_tpu as ms
+
+
+def test_molecule_interning():
+    x = ms.Molecule("mol-interning-x", 10.0)
+    x2 = ms.Molecule("mol-interning-x", 10.0)
+    assert x is x2
+    assert ms.Molecule.from_name("mol-interning-x") is x
+
+
+def test_molecule_attribute_mismatch_raises():
+    ms.Molecule("mol-mismatch-y", 10.0)
+    with pytest.raises(ValueError):
+        ms.Molecule("mol-mismatch-y", 20.0)
+    with pytest.raises(ValueError):
+        ms.Molecule("mol-mismatch-y", 10.0, half_life=5)
+    with pytest.raises(ValueError):
+        ms.Molecule("mol-mismatch-y", 10.0, diffusivity=0.5)
+    with pytest.raises(ValueError):
+        ms.Molecule("mol-mismatch-y", 10.0, permeability=0.5)
+
+
+def test_molecule_similar_name_warns():
+    ms.Molecule("mol-warncase-Z", 1.0)
+    with pytest.warns(UserWarning):
+        ms.Molecule("mol-warncase-z", 1.0)
+
+
+def test_molecule_from_name_unknown_raises():
+    with pytest.raises(ValueError):
+        ms.Molecule.from_name("never-defined-molecule-xyz")
+
+
+def test_molecule_pickle_preserves_interning():
+    x = ms.Molecule("mol-pickle-x", 3.0, half_life=123)
+    x2 = pickle.loads(pickle.dumps(x))
+    assert x2 is x
+
+
+def test_molecule_ordering_and_equality():
+    a = ms.Molecule("mol-ord-a", 1.0)
+    b = ms.Molecule("mol-ord-b", 2.0)
+    assert a < b
+    assert a == ms.Molecule("mol-ord-a", 1.0)
+    assert hash(a) == hash("mol-ord-a") or isinstance(hash(a), int)
+
+
+def test_chemistry_dedup_and_union():
+    a = ms.Molecule("chem-dd-a", 1.0)
+    b = ms.Molecule("chem-dd-b", 2.0)
+    chem = ms.Chemistry(
+        molecules=[a, b, a], reactions=[([a], [b]), ([a], [b])]
+    )
+    assert chem.molecules == [a, b]
+    assert len(chem.reactions) == 1
+    assert chem.mol_2_idx[b] == 1
+    assert chem.molname_2_idx["chem-dd-b"] == 1
+
+    c = ms.Molecule("chem-dd-c", 3.0)
+    other = ms.Chemistry(molecules=[c], reactions=[])
+    both = chem & other
+    assert both.molecules == [a, b, c]
+    assert len(both.reactions) == 1
+
+
+def test_chemistry_undefined_molecule_raises():
+    a = ms.Molecule("chem-undef-a", 1.0)
+    b = ms.Molecule("chem-undef-b", 2.0)
+    with pytest.raises(ValueError):
+        ms.Chemistry(molecules=[a], reactions=[([a], [b])])
+
+
+def test_domain_dict_roundtrips():
+    a = ms.Molecule("dom-rt-a", 1.0)
+    b = ms.Molecule("dom-rt-b", 2.0)
+
+    cat = ms.CatalyticDomain(
+        reaction=([a, a], [b]), km=1.5, vmax=2.5, start=3, end=24
+    )
+    d = cat.to_dict()
+    assert d["type"] == "C"
+    cat2 = ms.CatalyticDomain.from_dict(d["spec"])
+    assert cat2.substrates == [a, a]
+    assert cat2.products == [b]
+    assert cat2.km == 1.5 and cat2.vmax == 2.5
+    assert cat2.start == 3 and cat2.end == 24
+
+    trn = ms.TransporterDomain(
+        molecule=a, km=0.5, vmax=1.0, is_exporter=True, start=0, end=21
+    )
+    d = trn.to_dict()
+    assert d["type"] == "T"
+    trn2 = ms.TransporterDomain.from_dict(d["spec"])
+    assert trn2.molecule is a and trn2.is_exporter
+
+    reg = ms.RegulatoryDomain(
+        effector=b, hill=3, km=2.0, is_inhibiting=True,
+        is_transmembrane=False, start=21, end=42,
+    )
+    d = reg.to_dict()
+    assert d["type"] == "R"
+    reg2 = ms.RegulatoryDomain.from_dict(d["spec"])
+    assert reg2.effector is b and reg2.hill == 3 and reg2.is_inhibiting
+    assert not reg2.is_transmembrane
+
+
+def test_protein_dict_roundtrip():
+    a = ms.Molecule("prot-rt-a", 1.0)
+    b = ms.Molecule("prot-rt-b", 2.0)
+    prot = ms.Protein(
+        domains=[
+            ms.CatalyticDomain(([a], [b]), km=1.0, vmax=2.0, start=0, end=21),
+            ms.RegulatoryDomain(a, hill=1, km=0.3, is_inhibiting=False,
+                                is_transmembrane=True, start=21, end=42),
+        ],
+        cds_start=5,
+        cds_end=53,
+        is_fwd=False,
+    )
+    prot2 = ms.Protein.from_dict(prot.to_dict())
+    assert prot2.cds_start == 5 and prot2.cds_end == 53 and not prot2.is_fwd
+    assert prot2.n_domains == 2
+    assert isinstance(prot2.domains[0], ms.CatalyticDomain)
+    assert isinstance(prot2.domains[1], ms.RegulatoryDomain)
+    assert str(prot2) == str(prot)

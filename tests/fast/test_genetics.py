@@ -1,0 +1,241 @@
+"""
+Genome translation tests: golden CDS coordinates (hand-annotated genomes
+including nested/overlapping CDSs — the same spec facts as reference
+tests/fast/test_genetics.py:11-127), golden domain extraction, statistical
+domain-type proportions, and C++/Python engine agreement.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.constants import CODON_SIZE
+from magicsoup_tpu.native import _pyengine, engine
+from magicsoup_tpu.native._pyengine import TranslationTables
+from magicsoup_tpu.util import random_genome, reverse_complement
+
+# (genome, [(cds_start, cds_stop)]) with default start/stop codons,
+# min_cds_size=18; hand-annotated incl. nested/overlapping CDSs
+_CDS_CASES: list[tuple[str, list[tuple[int, int]]]] = [
+    (
+        """
+        TACCGGATA GCAGCTTTT CTTGGAATA GCCAAGGGT
+        CGCCTTTAT ACCTATCTA CAACTACTA CTCGGTTGG
+        TAACAAAGG TTAAAACGC CAAACGAGT ATCGGCCAA
+        TCCTGTCAC TGTGAGAAG TTTCAATTA TAGATTCCT
+        GGGGCGATT GGCGATGGT
+        """,
+        # "TTGGAATAG" at 19 is too short
+        [(68, 122)],
+    ),
+    (
+        """
+        AACATATCC ACCATCCCT TAAGGGGCG ATGAATTAC
+        GAAAGCGGG CGTACTACT TCTGGGGAT ACGATTAGT
+        GTACTCGGT TCTCTTAAC GACTACCCT GTGTTACGT
+        TATTGAAAG AGCAAATTG CGAGCTCCC CGTGACACT
+        TGTGCGGCG CTATACACC CCTGCAGTT ATTTAAGGG
+        CTTAGGCGA GAAGTTCCG CCTGCTAAG GAGTCCCTG
+        TTGGGTGAA GTAACGCAC AGCCAGGCC TTGGCAGGA
+        CGTTTCCGT TCTCGT
+        """,
+        [
+            # "GTGTTACGTTATTGA" at 99 and "GTGAAGTAA" at 220 are too short
+            (27, 114),
+            (70, 229),
+            (110, 140),
+            (123, 177),
+            (136, 229),
+            (143, 185),
+            (145, 229),
+        ],
+    ),
+    # minimum-size CDS from start to end
+    ("TTGAAAGA GCAAATTT GA", [(0, 18)]),
+    # two overlapping starts (GTG), different stops
+    (
+        "GTGTGCTCG AAAGAGAAC GCAAATTCG TAACCTAG",
+        [(0, 30), (2, 35)],
+    ),
+]
+
+
+def test_reverse_complement():
+    assert reverse_complement("ACTGG") == "CCAGT"
+
+
+@pytest.mark.parametrize("seq, exp", _CDS_CASES)
+def test_get_coding_regions(seq: str, exp: list[tuple[int, int]]):
+    seq = "".join(seq.replace("\n", "").split())
+    res = _pyengine.get_coding_regions(
+        seq,
+        min_cds_size=18,
+        start_codons=["TTG", "GTG", "ATG"],
+        stop_codons=["TGA", "TAG", "TAA"],
+        is_fwd=False,
+    )
+    assert len(res) == len(exp)
+    assert set(d[0] for d in res) == set(d[0] for d in exp)
+    assert set(d[1] for d in res) == set(d[1] for d in exp)
+    assert all(not d[2] for d in res)
+    # every returned (start, stop) pair must be an expected pair
+    assert set((d[0], d[1]) for d in res) == set(exp)
+
+
+def _tables_from_maps(
+    dom_type_map: dict[str, int],
+    one_codon_map: dict[str, int],
+    two_codon_map: dict[str, int],
+    dom_type_size: int,
+) -> TranslationTables:
+    return TranslationTables(
+        start_codons=["TTG", "GTG", "ATG"],
+        stop_codons=["TGA", "TAG", "TAA"],
+        domain_map=dom_type_map,
+        one_codon_map=one_codon_map,
+        two_codon_map=two_codon_map,
+        dom_size=dom_type_size + 5 * CODON_SIZE,
+        dom_type_size=dom_type_size,
+    )
+
+
+def test_extract_domains_golden():
+    # hand-constructed genome with 1-codon domain types; the same spec facts
+    # as the reference's golden test: domain-type matches at arbitrary codon
+    # offsets, regulatory-only proteins dropped, greedy 21-nt domain jumps
+    dom_type_map = {"AAA": 1, "GGG": 2, "CCC": 3}
+    two_codon_map = {"ACTGAT": 1, "CTGTAT": 2, "CCGCGA": 3, "GGAATC": 4, "TGTCGA": 5}
+    one_codon_map = {"ACT": 1, "CTG": 2, "CCG": 3, "GGA": 4, "TGT": 5}
+    dom_type_size = 3
+    dom_size = dom_type_size + 5 * CODON_SIZE
+    tables = _tables_from_maps(
+        dom_type_map, one_codon_map, two_codon_map, dom_type_size
+    )
+
+    genome = (
+        "AGACAAAAACTGTGTACTCCGCGATAGACTAGACG"
+        "AGACTATAGCTAGAAGCCCCTGTACTCCGTGTCGATAGACG"
+        "AGACTAGGGCCGGGACTGCCGCGACTAGAAGCTAGACTAACG"
+        "AAACCGGGATGTCTGTAT"
+        "CCCCCGGGACTGCCGCGAGGGACTCTGCCGGGAATC"
+    )
+    cdss = [
+        (0, 35, True),  # normal domain -> (1, 2, 5, 1, 3)
+        (35, 76, False),  # only a regulatory domain -> protein dropped
+        (76, 118, True),  # 2 type-2 starts; 2nd inside the 1st domain
+        (118, 136, False),  # exactly 1 domain from start to end
+        (136, 172, True),  # exactly 2 domains, 3rd type-2 start mid-domain
+    ]
+
+    codes = _pyengine._codon_codes(genome.encode())
+    prots: list[list[int]] = []
+    doms: list[list[int]] = []
+    n = _pyengine._extract_domains_into(
+        codes, [(a, b, f) for a, b, f in cdss], tables, prots, doms
+    )
+    assert n == 4
+    # prots rows: [cds_start, cds_end, is_fwd, n_doms]
+    assert prots[0] == [0, 35, 1, 1]
+    assert prots[1] == [76, 118, 1, 1]
+    assert prots[2] == [118, 136, 0, 1]
+    assert prots[3] == [136, 172, 1, 2]
+    # doms rows: [dt, i0, i1, i2, i3, start, end]
+    assert doms[0] == [1, 2, 5, 1, 3, 6, 6 + dom_size]
+    assert doms[1] == [2, 3, 4, 2, 3, 6, 6 + dom_size]
+    assert doms[2] == [1, 3, 4, 5, 2, 0, dom_size]
+    assert doms[3] == [3, 3, 4, 2, 3, 0, dom_size]
+    assert doms[4] == [2, 1, 2, 3, 4, 18, 18 + dom_size]
+
+
+def test_translate_genomes_nested_structure():
+    genetics = ms.Genetics(seed=11)
+    random.seed(11)
+    genomes = [random_genome(s=500, rng=random.Random(i)) for i in range(20)]
+    res = genetics.translate_genomes(genomes=genomes)
+    assert len(res) == 20
+    for proteome in res:
+        for doms, cds_start, cds_end, is_fwd in proteome:
+            assert cds_end - cds_start >= genetics.dom_size
+            assert isinstance(is_fwd, bool)
+            assert len(doms) >= 1
+            # regulatory-only proteins are dropped
+            assert any(d[0][0] != 3 for d in doms)
+            for (dt, i0, i1, i2, i3), start, end in doms:
+                assert dt in (1, 2, 3)
+                assert 1 <= i0 <= 61 and 1 <= i1 <= 61 and 1 <= i2 <= 61
+                assert 1 <= i3 <= 3904
+                assert end - start == genetics.dom_size
+                assert 0 <= start < end <= cds_end - cds_start
+
+
+def test_native_and_python_engines_agree():
+    genetics = ms.Genetics(seed=3)
+    rng = random.Random(7)
+    genomes = [random_genome(s=1000, rng=rng) for _ in range(50)]
+    genomes += ["", "ATG", "ATGNNNTGA", "atgxxx"]
+    pc1, pr1, dm1 = _pyengine.translate_genomes_flat(genomes, genetics.tables)
+    if not engine.has_native():
+        pytest.skip("native engine unavailable")
+    pc2, pr2, dm2 = engine.translate_genomes_flat(genomes, genetics.tables)
+    assert np.array_equal(pc1, pc2)
+    assert np.array_equal(pr1, pr2)
+    assert np.array_equal(dm1, dm2)
+
+
+def test_domain_type_proportions():
+    # equal probabilities -> roughly equal counts (with regulatory bias
+    # from dropping regulatory-only proteins)
+    kwargs = {"p_catal_dom": 0.1, "p_transp_dom": 0.1, "p_reg_dom": 0.1}
+    genetics = ms.Genetics(seed=5, **kwargs)
+    rng = random.Random(5)
+    genomes = [random_genome(s=500, rng=rng) for _ in range(1000)]
+    data = genetics.translate_genomes(genomes=genomes)
+
+    def count(type_: int) -> int:
+        return sum(
+            1
+            for cell in data
+            for protein, *_ in cell
+            for dom, *_ in protein
+            if dom[0] == type_
+        )
+
+    n_catal, n_trnsp, n_reg = count(1), count(2), count(3)
+    n = n_catal + n_trnsp + n_reg
+    assert n > 0
+    assert abs(n_catal - n_trnsp) < 0.1 * n
+    assert abs(n_trnsp - n_reg) < 0.2 * n
+
+    # fewer catalytic domains when p_catal_dom is low
+    genetics = ms.Genetics(seed=5, p_catal_dom=0.01, p_transp_dom=0.1, p_reg_dom=0.1)
+    data = genetics.translate_genomes(genomes=genomes)
+    n_catal, n_trnsp, n_reg = count(1), count(2), count(3)
+    n = n_catal + n_trnsp + n_reg
+    assert n_trnsp - n_catal > 0.9 * n / 3
+
+
+def test_genetics_validation():
+    with pytest.raises(ValueError):
+        ms.Genetics(start_codons=("TTGA",))
+    with pytest.raises(ValueError):
+        ms.Genetics(stop_codons=("TG",))
+    with pytest.raises(ValueError):
+        ms.Genetics(start_codons=("TTG",), stop_codons=("TTG",))
+    with pytest.raises(ValueError):
+        ms.Genetics(p_catal_dom=0.5, p_transp_dom=0.4, p_reg_dom=0.2)
+
+
+def test_genetics_seed_reproducible():
+    g1 = ms.Genetics(seed=99)
+    g2 = ms.Genetics(seed=99)
+    assert g1.domain_map == g2.domain_map
+    g3 = ms.Genetics(seed=100)
+    assert g1.domain_map != g3.domain_map
+
+
+def test_same_genome_translates_identically():
+    genetics = ms.Genetics(seed=21)
+    g = random_genome(s=1000, rng=random.Random(1))
+    results = [genetics.translate_genomes(genomes=[g])[0] for _ in range(20)]
+    assert all(r == results[0] for r in results)

@@ -1,0 +1,370 @@
+"""
+Kinetics tests using deterministic injected token tables — the reference's
+main fixture pattern (tests/fast/test_kinetics.py:32-110): overwrite the
+randomly-sampled maps with hand-written tables so cell-parameter assembly
+and integrator arithmetic can be asserted against hand-computed values.
+"""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.constants import EPS, GAS_CONSTANT, MAX
+from magicsoup_tpu.kinetics import Kinetics
+from magicsoup_tpu.ops import integrate as integ
+from magicsoup_tpu.ops.params import TokenTables
+
+_TOL = 1e-4
+
+# 4 molecules with energies chosen for moderate Ke values
+_MA = ms.Molecule("kin-test-ma", 10 * 1e3)
+_MB = ms.Molecule("kin-test-mb", 8 * 1e3)
+_MC = ms.Molecule("kin-test-mc", 4 * 1e3)
+_MD = ms.Molecule("kin-test-md", 6 * 1e3)
+_MOLS = [_MA, _MB, _MC, _MD]
+# r0: a <-> b ; r1: b + c <-> d
+_REACTIONS = [([_MA], [_MB]), ([_MB, _MC], [_MD])]
+
+# scalar token tables (token 0 = empty)
+_KMS = [float("nan"), 1.0, 2.0, 4.0, 8.0, 0.5]
+_VMAXS = [float("nan"), 1.0, 2.0, 3.0, 4.0, 5.0]
+_SIGNS = [0, 1, -1, 1, -1, 1]
+_HILLS = [0, 1, 2, 3, 4, 5]
+
+# vector token tables over s = 8 signals (token 0 = zero vector)
+# reactions: token 1 = r0, token 2 = r1
+_REACT_M = np.zeros((9, 8), dtype=np.int32)
+_REACT_M[1] = [-1, 1, 0, 0, 0, 0, 0, 0]
+_REACT_M[2] = [0, -1, -1, 1, 0, 0, 0, 0]
+# transporters: token i transports molecule i-1 (i in 1..4)
+_TRNSP_M = np.zeros((9, 8), dtype=np.int32)
+for _i in range(4):
+    _TRNSP_M[_i + 1, _i] = -1
+    _TRNSP_M[_i + 1, _i + 4] = 1
+# effectors: token i = one-hot signal i-1 (i in 1..8)
+_EFF_M = np.zeros((9, 8), dtype=np.int32)
+for _i in range(8):
+    _EFF_M[_i + 1, _i] = 1
+
+_ENERGIES = np.array([d.energy for d in _MOLS] * 2, dtype=np.float32)
+
+
+def _make_kinetics() -> Kinetics:
+    chem = ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS)
+    kin = Kinetics(chemistry=chem, scalar_enc_size=5, vector_enc_size=8, seed=0)
+    kin.km_map.weights = np.array(_KMS, dtype=np.float32)
+    kin.vmax_map.weights = np.array(_VMAXS, dtype=np.float32)
+    kin.sign_map.signs = np.array(_SIGNS, dtype=np.int32)
+    kin.hill_map.numbers = np.array(_HILLS, dtype=np.int32)
+    kin.reaction_map.M = _REACT_M
+    kin.transport_map.M = _TRNSP_M
+    kin.effector_map.M = _EFF_M
+    kin.tables = TokenTables(
+        km_weights=jnp.asarray(kin.km_map.weights),
+        vmax_weights=jnp.asarray(kin.vmax_map.weights),
+        signs=jnp.asarray(kin.sign_map.signs),
+        hills=jnp.asarray(kin.hill_map.numbers),
+        reactions=jnp.asarray(_REACT_M),
+        transports=jnp.asarray(_TRNSP_M),
+        effectors=jnp.asarray(_EFF_M),
+        mol_energies=jnp.asarray(_ENERGIES),
+    )
+    kin.ensure_capacity(n_cells=4, n_proteins=4)
+    return kin
+
+
+def _dom(dt, i0, i1, i2, i3, start=0, end=21):
+    return ((dt, i0, i1, i2, i3), start, end)
+
+
+def _prot(*doms):
+    return (list(doms), 0, 100, True)
+
+
+def _ke(energy_delta: float) -> float:
+    return min(max(math.exp(-energy_delta / 310.0 / GAS_CONSTANT), EPS), MAX)
+
+
+def test_catalytic_domain_params():
+    kin = _make_kinetics()
+    # catalytic domain: Vmax token 1 (=1.0), Km token 2 (=2.0),
+    # sign token 1 (=+1), reaction token 1 (a <-> b)
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1))]])
+    p = kin.params
+    assert float(p.Vmax[0, 0]) == pytest.approx(1.0)
+    assert np.array_equal(np.asarray(p.N[0, 0]), [-1, 1, 0, 0, 0, 0, 0, 0])
+    assert np.array_equal(np.asarray(p.Nf[0, 0]), [1, 0, 0, 0, 0, 0, 0, 0])
+    assert np.array_equal(np.asarray(p.Nb[0, 0]), [0, 1, 0, 0, 0, 0, 0, 0])
+    # E = -e_a + e_b = -2000 -> Ke = exp(2000/(R*310)) > 1
+    ke = _ke(-2000.0)
+    assert float(p.Ke[0, 0]) == pytest.approx(ke, rel=_TOL)
+    # Ke >= 1 -> Kmf = Km, Kmb = Km * Ke
+    assert float(p.Kmf[0, 0]) == pytest.approx(2.0, rel=_TOL)
+    assert float(p.Kmb[0, 0]) == pytest.approx(2.0 * ke, rel=_TOL)
+    # no regulation
+    assert np.all(np.asarray(p.A[0]) == 0)
+
+
+def test_catalytic_domain_negative_sign_flips_reaction():
+    kin = _make_kinetics()
+    # sign token 2 (=-1) flips the reaction direction
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 2, 1))]])
+    p = kin.params
+    assert np.array_equal(np.asarray(p.N[0, 0]), [1, -1, 0, 0, 0, 0, 0, 0])
+    ke = _ke(2000.0)  # E = e_a - e_b = 2000 -> Ke < 1
+    assert float(p.Ke[0, 0]) == pytest.approx(ke, rel=_TOL)
+    # Ke < 1 -> Kmf = Km / Ke, Kmb = Km
+    assert float(p.Kmf[0, 0]) == pytest.approx(2.0 / ke, rel=_TOL)
+    assert float(p.Kmb[0, 0]) == pytest.approx(2.0, rel=_TOL)
+
+
+def test_multi_domain_aggregation():
+    kin = _make_kinetics()
+    # two catalytic domains: r0 (+1) and r1 (+1); Vmax tokens 1, 3 -> mean 2
+    # Km tokens 2, 4 -> mean of (2, 8) = 5
+    kin.set_cell_params(
+        cell_idxs=[1],
+        proteomes=[[_prot(_dom(1, 1, 2, 1, 1), _dom(1, 3, 4, 1, 2))]],
+    )
+    p = kin.params
+    assert float(p.Vmax[1, 0]) == pytest.approx(2.0)
+    # N = r0 + r1 = [-1, 0, -1, 1, ...]
+    assert np.array_equal(np.asarray(p.N[1, 0]), [-1, 0, -1, 1, 0, 0, 0, 0])
+    # b is consumed by r1 and produced by r0: cofactor split keeps both
+    assert np.array_equal(np.asarray(p.Nf[1, 0]), [1, 1, 1, 0, 0, 0, 0, 0])
+    assert np.array_equal(np.asarray(p.Nb[1, 0]), [0, 1, 0, 1, 0, 0, 0, 0])
+    # E = N . energies = -10k + 0 - 4k + 6k = -8k
+    ke = _ke(-8000.0)
+    assert float(p.Ke[1, 0]) == pytest.approx(ke, rel=1e-3)
+    assert float(p.Kmf[1, 0]) == pytest.approx(5.0, rel=_TOL)
+    assert float(p.Kmb[1, 0]) == pytest.approx(5.0 * ke, rel=1e-3)
+
+
+def test_transporter_domain_params():
+    kin = _make_kinetics()
+    # transporter of molecule a (token 1), sign +1
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(2, 1, 1, 1, 1))]])
+    p = kin.params
+    assert np.array_equal(np.asarray(p.N[0, 0]), [-1, 0, 0, 0, 1, 0, 0, 0])
+    # transport has zero energy balance -> Ke = 1
+    assert float(p.Ke[0, 0]) == pytest.approx(1.0, rel=_TOL)
+    assert float(p.Kmf[0, 0]) == pytest.approx(1.0, rel=_TOL)
+    assert float(p.Kmb[0, 0]) == pytest.approx(1.0, rel=_TOL)
+
+
+def test_regulatory_domain_params():
+    kin = _make_kinetics()
+    # protein: catalytic r0 + inhibiting regulatory domain
+    # reg: hill token 3 (=3), Km token 1 (=1.0), sign token 2 (=-1),
+    # effector token 2 (= signal 1, intracellular b)
+    kin.set_cell_params(
+        cell_idxs=[0],
+        proteomes=[[_prot(_dom(1, 1, 2, 1, 1), _dom(3, 3, 1, 2, 2))]],
+    )
+    p = kin.params
+    # regulatory domain does not contribute to Vmax / Km / N
+    assert float(p.Vmax[0, 0]) == pytest.approx(1.0)
+    assert float(p.Kmf[0, 0]) == pytest.approx(2.0, rel=_TOL)
+    assert np.array_equal(np.asarray(p.N[0, 0]), [-1, 1, 0, 0, 0, 0, 0, 0])
+    # A = effector * sign * hill = -3 at signal 1
+    assert np.array_equal(np.asarray(p.A[0, 0]), [0, -3, 0, 0, 0, 0, 0, 0])
+    # Kmr = Km^A = 1^-3 = 1 at signal 1; elsewhere 0^0 = 1
+    assert float(p.Kmr[0, 0, 1]) == pytest.approx(1.0, rel=_TOL)
+
+
+def test_regulatory_only_protein_is_inert():
+    kin = _make_kinetics()
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(3, 1, 1, 1, 1))]])
+    p = kin.params
+    assert float(p.Vmax[0, 0]) == 0.0
+    assert np.all(np.asarray(p.N[0, 0]) == 0)
+    X = jnp.full((4, 8), 2.0)
+    X1 = kin.integrate_signals(X)
+    np.testing.assert_allclose(np.asarray(X1), np.asarray(X), rtol=1e-6)
+
+
+def test_unset_copy_remove_cell_params():
+    kin = _make_kinetics()
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1))]])
+    kin.copy_cell_params(from_idxs=[0], to_idxs=[2])
+    p = kin.params
+    assert float(p.Vmax[2, 0]) == pytest.approx(1.0)
+    assert np.array_equal(np.asarray(p.N[2, 0]), np.asarray(p.N[0, 0]))
+
+    kin.unset_cell_params(cell_idxs=[0])
+    assert float(kin.params.Vmax[0, 0]) == 0.0
+    assert np.all(np.asarray(kin.params.N[0]) == 0)
+
+    # removing cell 0 shifts cell 2 -> cell 1
+    keep = np.ones(kin.max_cells, dtype=bool)
+    keep[0] = False
+    kin.remove_cell_params(keep=keep)
+    assert float(kin.params.Vmax[1, 0]) == pytest.approx(1.0)
+
+
+def _np_velocities(X, Vmax, N, Nf, Nb, Kmf, Kmb, Kmr, A):
+    """Independent numpy recomputation of the reference velocity math"""
+    c, p, s = Nf.shape
+    V = np.zeros((c, p))
+    for ci in range(c):
+        for pi in range(p):
+            if (Nf[ci, pi] > 0).any():
+                kf = np.prod(
+                    [X[ci, si] ** Nf[ci, pi, si] for si in range(s) if Nf[ci, pi, si] > 0]
+                ) / Kmf[ci, pi]
+            else:
+                kf = 0.0
+            if (Nb[ci, pi] > 0).any():
+                kb = np.prod(
+                    [X[ci, si] ** Nb[ci, pi, si] for si in range(s) if Nb[ci, pi, si] > 0]
+                ) / Kmb[ci, pi]
+            else:
+                kb = 0.0
+            a_cat = (kf - kb) / (1 + kf + kb)
+            a_reg = 1.0
+            for si in range(s):
+                a = A[ci, pi, si]
+                if a != 0:
+                    xa = X[ci, si] ** a
+                    if np.isinf(xa) and np.isinf(Kmr[ci, pi, si]):
+                        term = 1.0  # inhibitor absent
+                    else:
+                        term = xa / (xa + Kmr[ci, pi, si])
+                        if np.isnan(term):
+                            term = 1.0
+                    a_reg *= term
+            V[ci, pi] = a_cat * Vmax[ci, pi] * a_reg
+    return V
+
+
+def test_simple_mm_kinetic():
+    kin = _make_kinetics()
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1))]])
+    X = np.zeros((4, 8), dtype=np.float32)
+    X[0, 0] = 2.0  # a
+    X[0, 1] = 1.0  # b
+    p = kin.params
+    V = integ._velocities(jnp.asarray(X), p.Vmax, p)
+    expected = _np_velocities(
+        X,
+        np.asarray(p.Vmax),
+        np.asarray(p.N),
+        np.asarray(p.Nf),
+        np.asarray(p.Nb),
+        np.asarray(p.Kmf),
+        np.asarray(p.Kmb),
+        np.asarray(p.Kmr),
+        np.asarray(p.A),
+    )
+    np.testing.assert_allclose(np.asarray(V), expected, rtol=1e-4)
+    # hand-check: kf = 2/2 = 1, kb = 1/(2*Ke); v = (kf-kb)/(1+kf+kb)
+    ke = _ke(-2000.0)
+    kf = 1.0
+    kb = 1.0 / (2.0 * ke)
+    v = (kf - kb) / (1 + kf + kb) * 1.0
+    assert float(V[0, 0]) == pytest.approx(v, rel=1e-3)
+
+
+def test_inhibiting_regulation_reduces_velocity():
+    kin = _make_kinetics()
+    prot_plain = [_prot(_dom(1, 1, 2, 1, 1))]
+    prot_inhib = [_prot(_dom(1, 1, 2, 1, 1), _dom(3, 3, 1, 2, 2))]
+    kin.set_cell_params(cell_idxs=[0, 1], proteomes=[prot_plain, prot_inhib])
+    X = np.zeros((4, 8), dtype=np.float32)
+    X[:, 0] = 4.0
+    X[:, 1] = 2.0  # inhibitor (b) present in both cells
+    p = kin.params
+    V = np.asarray(integ._velocities(jnp.asarray(X), p.Vmax, p))
+    assert V[1, 0] < V[0, 0]
+    # a_reg = x^A/(x^A + Kmr) with A=-3, Km=1: 2^-3/(2^-3 + 1^-3)
+    a_reg = (2.0**-3) / (2.0**-3 + 1.0)
+    assert V[1, 0] == pytest.approx(V[0, 0] * a_reg, rel=1e-3)
+
+
+def test_absent_inhibitor_leaves_protein_active():
+    kin = _make_kinetics()
+    kin.set_cell_params(
+        cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1), _dom(3, 3, 1, 2, 2))]]
+    )
+    X = np.zeros((4, 8), dtype=np.float32)
+    X[0, 0] = 4.0  # substrate present, inhibitor absent (b = 0)
+    p = kin.params
+    V = np.asarray(integ._velocities(jnp.asarray(X), p.Vmax, p))
+    # 0^-3 = inf -> NaN in the regulation term -> treated as fully active
+    kf = 4.0 / 2.0
+    v = kf / (1 + kf)
+    assert V[0, 0] == pytest.approx(v, rel=1e-3)
+
+
+def test_negative_concentration_guard():
+    kin = _make_kinetics()
+    # high-Vmax transporter of a: token 5 (=5.0), Km token 5 (=0.5)
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(2, 5, 5, 1, 1))]])
+    X = jnp.zeros((4, 8), dtype=jnp.float32).at[0, 0].set(0.1)
+    X1 = np.asarray(kin.integrate_signals(X))
+    assert (X1 >= 0).all()
+    # mass conserved: intracellular + extracellular a unchanged
+    assert X1[0, 0] + X1[0, 4] == pytest.approx(0.1, rel=1e-4)
+
+
+def test_zeros_stay_zero():
+    kin = _make_kinetics()
+    kin.set_cell_params(
+        cell_idxs=[0, 1],
+        proteomes=[[_prot(_dom(1, 1, 2, 1, 1))], [_prot(_dom(1, 3, 4, 1, 2))]],
+    )
+    X = jnp.zeros((4, 8), dtype=jnp.float32)
+    X1 = np.asarray(kin.integrate_signals(X))
+    assert np.all(X1 == 0.0)
+
+
+def test_integrate_signals_approaches_equilibrium():
+    kin = _make_kinetics()
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 5, 5, 1, 1))]])
+    X = jnp.zeros((4, 8), dtype=jnp.float32).at[0, 0].set(20.0).at[0, 1].set(0.0)
+    ke = _ke(-2000.0)
+    for _ in range(50):
+        X = kin.integrate_signals(X)
+    x = np.asarray(X)
+    q = x[0, 1] / max(x[0, 0], 1e-12)
+    # Q converges towards Ke without huge overshoot
+    assert q == pytest.approx(ke, rel=0.5)
+    assert x[0, 0] + x[0, 1] == pytest.approx(20.0, rel=1e-3)
+
+
+def test_integrate_signals_masks_dead_slots():
+    kin = _make_kinetics()
+    kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1))]])
+    X = jnp.full((4, 8), 3.0)
+    X1 = np.asarray(kin.integrate_signals(X))
+    # slots 1..3 have zero params -> unchanged
+    np.testing.assert_allclose(X1[1:], 3.0, rtol=1e-6)
+    assert X1[0, 0] != 3.0
+
+
+def test_get_proteome_interpretation():
+    kin = _make_kinetics()
+    proteome = [
+        _prot(_dom(1, 1, 2, 1, 1), _dom(2, 1, 1, 2, 2), _dom(3, 3, 1, 2, 6))
+    ]
+    prots = kin.get_proteome(proteome=proteome)
+    assert len(prots) == 1
+    doms = prots[0].domains
+    assert len(doms) == 3
+    cat, trn, reg = doms
+    assert isinstance(cat, ms.CatalyticDomain)
+    assert [d.name for d in cat.substrates] == ["kin-test-ma"]
+    assert [d.name for d in cat.products] == ["kin-test-mb"]
+    assert cat.km == pytest.approx(2.0)
+    assert cat.vmax == pytest.approx(1.0)
+    assert isinstance(trn, ms.TransporterDomain)
+    assert trn.molecule.name == "kin-test-mb"
+    # transport vec has -1 intracellular; sign -1 -> signed +1 -> importer
+    assert not trn.is_exporter
+    assert isinstance(reg, ms.RegulatoryDomain)
+    assert reg.effector.name == "kin-test-mb"
+    assert reg.hill == 3
+    assert reg.is_inhibiting
+    assert reg.is_transmembrane  # effector token 6 = signal 5 = ext b

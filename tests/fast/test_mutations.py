@@ -1,0 +1,106 @@
+"""
+Mutation tests: statistical rates within likely bounds, recombination
+length conservation, engine determinism under explicit seeds (the
+reference's statistical-assert strategy, tests/fast/test_mutations.py:4-46,
+plus seeding the reference does not support).
+"""
+import random
+
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.native import _pyengine, engine
+from magicsoup_tpu.util import random_genome
+
+
+def _genomes(n: int, s: int, seed: int) -> list[str]:
+    rng = random.Random(seed)
+    return [random_genome(s=s, rng=rng) for _ in range(n)]
+
+
+def test_point_mutation_rate():
+    seqs = _genomes(1000, 1000, 1)
+    res = ms.point_mutations(seqs=seqs, p=1e-3, seed=42)
+    # lambda = 1 per genome -> ~63% of genomes mutated; loose bounds
+    assert 450 < len(res) < 800
+    assert all(0 <= idx < 1000 for _, idx in res)
+    # substitutions may redraw the same nucleotide, but most sequences differ
+    n_diff = sum(1 for seq, idx in res if seq != seqs[idx])
+    assert n_diff > 0.5 * len(res)
+
+
+def test_point_mutation_no_mutations_for_p0():
+    seqs = _genomes(50, 500, 2)
+    assert ms.point_mutations(seqs=seqs, p=0.0, seed=1) == []
+
+
+def test_point_mutation_indel_changes_length():
+    seqs = _genomes(300, 1000, 3)
+    res = ms.point_mutations(seqs=seqs, p=1e-2, p_indel=1.0, p_del=1.0, seed=7)
+    assert len(res) > 250
+    # all mutations are deletions -> lengths strictly shrink
+    assert all(len(seq) < 1000 for seq, _ in res)
+    res = ms.point_mutations(seqs=seqs, p=1e-2, p_indel=1.0, p_del=0.0, seed=7)
+    assert all(len(seq) > 1000 for seq, _ in res)
+
+
+def test_point_mutation_substitutions_keep_length():
+    seqs = _genomes(300, 1000, 4)
+    res = ms.point_mutations(seqs=seqs, p=1e-2, p_indel=0.0, seed=9)
+    assert all(len(seq) == 1000 for seq, _ in res)
+
+
+def test_point_mutation_seed_determinism():
+    seqs = _genomes(100, 500, 5)
+    r1 = ms.point_mutations(seqs=seqs, p=1e-3, seed=123)
+    r2 = ms.point_mutations(seqs=seqs, p=1e-3, seed=123)
+    r3 = ms.point_mutations(seqs=seqs, p=1e-3, seed=124)
+    assert r1 == r2
+    assert r1 != r3
+
+
+def test_recombination_length_conservation():
+    seqs = _genomes(400, 1000, 6)
+    pairs = list(zip(seqs[:200], seqs[200:]))
+    res = ms.recombinations(seq_pairs=pairs, p=1e-2, seed=11)
+    assert len(res) > 150
+    for a, b, idx in res:
+        s0, s1 = pairs[idx]
+        assert len(a) + len(b) == len(s0) + len(s1)
+        # multiset of characters conserved
+        assert sorted(a + b) == sorted(s0 + s1)
+
+
+def test_recombination_rate_scales_with_p():
+    seqs = _genomes(400, 500, 7)
+    pairs = list(zip(seqs[:200], seqs[200:]))
+    few = ms.recombinations(seq_pairs=pairs, p=1e-5, seed=1)
+    many = ms.recombinations(seq_pairs=pairs, p=1e-2, seed=1)
+    assert len(few) < len(many)
+
+
+def test_recombination_empty_input():
+    assert ms.recombinations(seq_pairs=[], p=1.0) == []
+
+
+def test_python_engine_mutation_semantics():
+    # the fallback engine honors the same contract
+    seqs = _genomes(200, 500, 8)
+    res = _pyengine.point_mutations_flat(seqs, p=1e-2, p_indel=0.4, p_del=0.66, seed=3)
+    assert len(res) > 150
+    n_diff = sum(1 for seq, idx in res if seq != seqs[idx])
+    assert n_diff > 0.5 * len(res)
+    pairs = list(zip(seqs[:100], seqs[100:]))
+    rec = _pyengine.recombinations_flat(pairs, p=1e-2, seed=3)
+    for a, b, idx in rec:
+        s0, s1 = pairs[idx]
+        assert len(a) + len(b) == len(s0) + len(s1)
+
+
+@pytest.mark.skipif(not engine.has_native(), reason="native engine unavailable")
+def test_native_mutation_rates_match_python_statistically():
+    seqs = _genomes(2000, 500, 9)
+    n_native = len(engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5))
+    n_py = len(_pyengine.point_mutations_flat(seqs, 2e-3, 0.4, 0.66, seed=5))
+    # same Poisson(1.0) hit distribution -> counts within loose bounds
+    assert abs(n_native - n_py) < 0.15 * 2000

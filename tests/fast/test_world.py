@@ -1,0 +1,444 @@
+"""
+World orchestration tests: lifecycle index integrity across kill/divide
+churn, molecule conservation laws (the reference's de-facto integration
+suite, tests/fast/test_world.py:253-507), physics semantics, and
+persistence round-trips.
+"""
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.util import random_genome
+
+_MA = ms.Molecule("world-test-a", 10 * 1e3, diffusivity=0.5, permeability=0.2)
+_MB = ms.Molecule("world-test-b", 8 * 1e3)
+_MC = ms.Molecule("world-test-c", 4 * 1e3, diffusivity=0.0, half_life=10)
+_MOLS = [_MA, _MB, _MC]
+_REACTIONS = [([_MA], [_MB])]
+
+
+def _chem() -> ms.Chemistry:
+    return ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS)
+
+
+def _world(**kwargs) -> ms.World:
+    defaults = {"chemistry": _chem(), "map_size": 32, "seed": 42}
+    defaults.update(kwargs)
+    return ms.World(**defaults)
+
+
+def _total_mass(world: ms.World) -> np.ndarray:
+    """Per-molecule total across map and all cells"""
+    mm = np.asarray(world.molecule_map).sum(axis=(1, 2))
+    cm = np.asarray(world._cell_molecules).sum(axis=0)
+    return mm + cm
+
+
+def _genomes(n: int, s: int = 300, seed: int = 0) -> list[str]:
+    rng = random.Random(seed)
+    return [random_genome(s=s, rng=rng) for _ in range(n)]
+
+
+def test_spawn_cells_basic():
+    world = _world()
+    idxs = world.spawn_cells(_genomes(20))
+    assert idxs == list(range(20))
+    assert world.n_cells == 20
+    assert len(world.cell_genomes) == 20
+    assert len(world.cell_labels) == 20
+    assert len(set(world.cell_labels)) == 20
+    assert world.cell_map.sum() == 20
+    pos = world.cell_positions
+    assert len(np.unique(pos[:, 0] * 32 + pos[:, 1])) == 20
+    assert world.cell_map[pos[:, 0], pos[:, 1]].all()
+    assert (world.cell_lifetimes == 0).all()
+    assert (world.cell_divisions == 0).all()
+
+
+def test_spawn_picks_up_half_pixel_molecules():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 4.0)
+    idxs = world.spawn_cells(_genomes(5))
+    cm = np.asarray(world.cell_molecules)
+    np.testing.assert_allclose(cm, 2.0)
+    pos = world.cell_positions
+    mm = np.asarray(world.molecule_map)
+    np.testing.assert_allclose(mm[:, pos[:, 0], pos[:, 1]], 2.0)
+
+
+def test_spawn_conserves_mass():
+    world = _world()
+    before = _total_mass(world)
+    world.spawn_cells(_genomes(50))
+    np.testing.assert_allclose(_total_mass(world), before, rtol=1e-5)
+
+
+def test_kill_cells_compacts_and_spills():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 2.0)
+    world.spawn_cells(_genomes(10))
+    genomes_before = list(world.cell_genomes)
+    positions_before = world.cell_positions.copy()
+    mass_before = _total_mass(world)
+
+    world.kill_cells(cell_idxs=[2, 5])
+    assert world.n_cells == 8
+    # index shift semantics: survivors keep order
+    expected = [g for i, g in enumerate(genomes_before) if i not in (2, 5)]
+    assert world.cell_genomes == expected
+    kept = [i for i in range(10) if i not in (2, 5)]
+    np.testing.assert_array_equal(world.cell_positions, positions_before[kept])
+    assert world.cell_map.sum() == 8
+    # spilled molecules stay in the world
+    np.testing.assert_allclose(_total_mass(world), mass_before, rtol=1e-5)
+    # params of survivors moved along: tail slots are zero
+    assert np.all(np.asarray(world.kinetics.params.Vmax[8:]) == 0)
+
+
+def test_kill_all_cells():
+    world = _world()
+    world.spawn_cells(_genomes(10))
+    world.kill_cells()
+    assert world.n_cells == 0
+    assert world.cell_genomes == []
+    assert world.cell_map.sum() == 0
+    # stepping with no cells is a no-op
+    world.enzymatic_activity()
+    world.increment_cell_lifetimes()
+
+
+def test_divide_cells():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 4.0)
+    world.spawn_cells(_genomes(5))
+    world.cell_lifetimes = np.full(5, 7)
+    cm_before = np.asarray(world.cell_molecules).copy()
+    res = world.divide_cells(cell_idxs=[0, 1, 2])
+    assert len(res) == 3
+    assert world.n_cells == 8
+    for parent, child in res:
+        assert parent in (0, 1, 2)
+        assert child >= 5
+        assert world.cell_genomes[parent] == world.cell_genomes[child]
+        assert world.cell_labels[parent] == world.cell_labels[child]
+        # molecules halved and copied
+        np.testing.assert_allclose(
+            np.asarray(world.cell_molecules)[child],
+            cm_before[parent] * 0.5,
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(world.cell_molecules)[parent],
+            cm_before[parent] * 0.5,
+            rtol=1e-6,
+        )
+        # descendants: divisions + 1, lifetime 0
+        assert world.cell_divisions[parent] == 1
+        assert world.cell_divisions[child] == 1
+        assert world.cell_lifetimes[parent] == 0
+        assert world.cell_lifetimes[child] == 0
+        # child is in parent's Moore neighborhood
+        dp = np.abs(world.cell_positions[parent] - world.cell_positions[child])
+        dp = np.minimum(dp, 32 - dp)
+        assert dp.max() <= 1
+    # untouched cells unchanged
+    assert world.cell_lifetimes[3] == 7
+    assert world.cell_map.sum() == 8
+
+
+def test_divide_requires_free_neighborhood():
+    world = _world(map_size=8, mol_map_init="zeros")
+    world.spawn_cells(_genomes(64, s=50))
+    assert world.n_cells == 64  # map full
+    res = world.divide_cells(cell_idxs=list(range(64)))
+    assert res == []
+
+
+def test_update_cells_changes_proteome():
+    world = _world()
+    world.spawn_cells(_genomes(3, s=0))  # empty genomes -> no proteins
+    assert np.all(np.asarray(world.kinetics.params.N[:3]) == 0)
+    genome = _genomes(1, s=2000, seed=3)[0]
+    world.update_cells(genome_idx_pairs=[(genome, 1)])
+    assert world.cell_genomes[1] == genome
+    # with 2000 bp the cell almost surely got at least one protein
+    assert np.any(np.asarray(world.kinetics.params.N[1]) != 0)
+
+
+def test_move_cells():
+    world = _world()
+    world.spawn_cells(_genomes(10))
+    before = world.cell_positions.copy()
+    world.move_cells()
+    after = world.cell_positions
+    # all cells still on distinct pixels, map consistent
+    assert world.cell_map.sum() == 10
+    assert world.cell_map[after[:, 0], after[:, 1]].all()
+    # moves are within the Moore neighborhood
+    d = np.abs(after - before)
+    d = np.minimum(d, 32 - d)
+    assert d.max() <= 1
+
+
+def test_reposition_cells():
+    world = _world()
+    world.spawn_cells(_genomes(10))
+    cm_before = np.asarray(world.cell_molecules).copy()
+    world.reposition_cells(cell_idxs=[0, 1])
+    assert world.cell_map.sum() == 10
+    np.testing.assert_allclose(np.asarray(world.cell_molecules), cm_before)
+
+
+def test_enzymatic_activity_conserves_involved_molecules():
+    world = _world()
+    world.spawn_cells(_genomes(30, s=1000, seed=2))
+    before = _total_mass(world)
+    for _ in range(5):
+        world.enzymatic_activity()
+    after = _total_mass(world)
+    # a <-> b conversion conserves a + b; c may be transported only
+    assert before[0] + before[1] == pytest.approx(after[0] + after[1], rel=1e-3)
+    assert before[2] == pytest.approx(after[2], rel=1e-3)
+    mm = np.asarray(world.molecule_map)
+    cm = np.asarray(world.cell_molecules)
+    assert (mm >= 0).all() and (cm >= 0).all()
+    assert np.isfinite(mm).all() and np.isfinite(cm).all()
+
+
+def test_diffuse_molecules_conserves_mass():
+    world = _world()
+    world.spawn_cells(_genomes(10))
+    before = _total_mass(world)
+    for _ in range(10):
+        world.diffuse_molecules()
+    np.testing.assert_allclose(_total_mass(world), before, rtol=1e-4)
+    # diffusivity 0 molecule does not spread on the map
+    world2 = _world(mol_map_init="zeros")
+    mm = np.zeros((3, 32, 32), dtype=np.float32)
+    mm[:, 5, 5] = 9.0
+    world2.molecule_map = mm
+    world2.diffuse_molecules()
+    out = np.asarray(world2.molecule_map)
+    assert out[2, 5, 5] == pytest.approx(9.0, rel=1e-5)
+    # diffusivity 0.5 spreads into the Moore neighborhood
+    assert out[0, 5, 5] < 9.0
+    assert out[0, 4, 5] > 0.0
+
+
+def test_diffusion_wraps_around_torus():
+    world = _world(mol_map_init="zeros")
+    mm = np.zeros((3, 32, 32), dtype=np.float32)
+    mm[0, 0, 0] = 8.0
+    world.molecule_map = mm
+    world.diffuse_molecules()
+    out = np.asarray(world.molecule_map)
+    assert out[0, 31, 31] > 0.0  # wrapped corner neighbor
+
+
+def test_permeation_exchanges_with_cells():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 10.0)
+    world.spawn_cells(_genomes(5, s=0))
+    world.cell_molecules = np.zeros((5, 3), dtype=np.float32)
+    world.diffuse_molecules()
+    cm = np.asarray(world.cell_molecules)
+    # molecule a (permeability 0.2) permeates in; b and c do not
+    assert (cm[:, 0] > 0).all()
+    np.testing.assert_allclose(cm[:, 1], 0.0)
+    np.testing.assert_allclose(cm[:, 2], 0.0)
+
+
+def test_degrade_molecules():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 1.0)
+    world.spawn_cells(_genomes(4, s=0))
+    world.cell_molecules = np.full((4, 3), 1.0, dtype=np.float32)
+    world.degrade_molecules()
+    mm = np.asarray(world.molecule_map)
+    cm = np.asarray(world.cell_molecules)
+    # molecule c has half-life 10 -> factor exp(-ln2/10)
+    f = np.exp(-np.log(2) / 10)
+    assert mm[2, 0, 0] == pytest.approx(f, rel=1e-5)
+    assert cm[0, 2] == pytest.approx(f, rel=1e-5)
+    # half_life 100_000 -> barely degrades
+    assert mm[0, 0, 0] == pytest.approx(1.0, rel=1e-4)
+
+
+def test_increment_cell_lifetimes():
+    world = _world()
+    world.spawn_cells(_genomes(5))
+    world.increment_cell_lifetimes()
+    world.increment_cell_lifetimes()
+    assert (world.cell_lifetimes == 2).all()
+
+
+def test_get_neighbors():
+    world = _world(map_size=16, mol_map_init="zeros")
+    world.spawn_cells(_genomes(3, s=10))
+    # place cells deterministically: 2 adjacent, 1 far away
+    world._np_cell_map[:] = False
+    world._np_positions[0] = (2, 2)
+    world._np_positions[1] = (2, 3)
+    world._np_positions[2] = (10, 10)
+    world._np_cell_map[2, 2] = world._np_cell_map[2, 3] = True
+    world._np_cell_map[10, 10] = True
+    world._sync_positions()
+    assert world.get_neighbors(cell_idxs=[0, 1, 2]) == [(0, 1)]
+    assert world.get_neighbors(cell_idxs=[0]) == []
+    assert world.get_neighbors(cell_idxs=[0], nghbr_idxs=[1]) == [(0, 1)]
+    assert world.get_neighbors(cell_idxs=[0], nghbr_idxs=[2]) == []
+    # wrap-around adjacency
+    world._np_positions[2] = (15, 2)
+    world._np_cell_map[10, 10] = False
+    world._np_cell_map[15, 2] = True
+    world._sync_positions()
+    world._np_positions[0] = (0, 2)
+    world._np_cell_map[2, 2] = False
+    world._np_cell_map[0, 2] = True
+    assert (0, 2) in world.get_neighbors(cell_idxs=[0, 2])
+
+
+def test_mutate_and_recombinate_cells():
+    world = _world()
+    world.spawn_cells(_genomes(30, s=500, seed=4))
+    genomes_before = list(world.cell_genomes)
+    world.mutate_cells(p=1e-2)
+    changed = sum(
+        1 for a, b in zip(genomes_before, world.cell_genomes) if a != b
+    )
+    assert changed > 10
+    world.recombinate_cells(p=1e-3)  # smoke: includes neighbor detection
+
+
+def test_spawn_more_cells_than_free_pixels():
+    world = _world(map_size=8, mol_map_init="zeros")
+    idxs = world.spawn_cells(_genomes(100, s=20))
+    assert len(idxs) == 64
+    assert world.n_cells == 64
+    assert world.spawn_cells(_genomes(3, s=20)) == []
+
+
+def test_capacity_growth_preserves_state():
+    world = _world(mol_map_init="zeros")
+    world.molecule_map = np.full((3, 32, 32), 2.0)
+    world.spawn_cells(_genomes(10, seed=1))
+    cm_before = np.asarray(world.cell_molecules).copy()
+    vmax_before = np.asarray(world.kinetics.params.Vmax[:10]).copy()
+    world.spawn_cells(_genomes(200, seed=2))  # forces capacity growth
+    np.testing.assert_allclose(
+        np.asarray(world.cell_molecules)[:10], cm_before, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(world.kinetics.params.Vmax[:10]), vmax_before, rtol=1e-6
+    )
+
+
+def test_save_and_load_state(tmp_path: Path):
+    world = _world()
+    world.spawn_cells(_genomes(20, s=400, seed=5))
+    for _ in range(3):
+        world.enzymatic_activity()
+        world.diffuse_molecules()
+    world.increment_cell_lifetimes()
+    statedir = tmp_path / "state"
+    world.save_state(statedir)
+
+    genomes = list(world.cell_genomes)
+    labels = list(world.cell_labels)
+    cm = np.asarray(world.cell_molecules).copy()
+    mm = np.asarray(world.molecule_map).copy()
+    pos = world.cell_positions.copy()
+    n_before = world.n_cells
+
+    world.kill_cells()
+    world.load_state(statedir)
+    assert world.n_cells == n_before
+    assert world.cell_genomes == genomes
+    assert world.cell_labels == labels
+    np.testing.assert_allclose(np.asarray(world.cell_molecules), cm, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(world.molecule_map), mm, rtol=1e-6)
+    np.testing.assert_array_equal(world.cell_positions, pos)
+    assert (world.cell_lifetimes == 1).all()
+    # cell params were rebuilt: stepping works
+    world.enzymatic_activity()
+
+
+def test_save_and_from_file_roundtrip(tmp_path: Path):
+    world = _world()
+    world.spawn_cells(_genomes(10, s=400, seed=6))
+    world.enzymatic_activity()
+    world.save(tmp_path)
+    w2 = ms.World.from_file(tmp_path)
+    assert w2.n_cells == world.n_cells
+    assert w2.cell_genomes == world.cell_genomes
+    np.testing.assert_allclose(
+        np.asarray(w2.cell_molecules), np.asarray(world.cell_molecules)
+    )
+    # genotype->phenotype maps survive: same proteome interpretation
+    p1 = [str(d) for d in world.get_cell(by_idx=0).proteome]
+    p2 = [str(d) for d in w2.get_cell(by_idx=0).proteome]
+    assert p1 == p2
+    # and the same kinetic parameters
+    np.testing.assert_allclose(
+        np.asarray(w2.kinetics.params.Kmf), np.asarray(world.kinetics.params.Kmf)
+    )
+    w2.enzymatic_activity()
+
+
+def test_seeded_worlds_reproduce():
+    w1 = _world(seed=123)
+    w2 = _world(seed=123)
+    g = _genomes(10, s=300, seed=9)
+    i1 = w1.spawn_cells(g)
+    i2 = w2.spawn_cells(g)
+    assert i1 == i2
+    np.testing.assert_array_equal(w1.cell_positions, w2.cell_positions)
+    np.testing.assert_allclose(
+        np.asarray(w1.molecule_map), np.asarray(w2.molecule_map)
+    )
+    w1.enzymatic_activity()
+    w2.enzymatic_activity()
+    np.testing.assert_allclose(
+        np.asarray(w1.cell_molecules), np.asarray(w2.cell_molecules)
+    )
+    w1.mutate_cells(p=1e-3)
+    w2.mutate_cells(p=1e-3)
+    assert w1.cell_genomes == w2.cell_genomes
+
+
+def test_get_cell(tmp_path: Path):
+    world = _world()
+    world.spawn_cells(_genomes(5, s=500, seed=8))
+    cell = world.get_cell(by_idx=3)
+    assert cell.idx == 3
+    assert cell.genome == world.cell_genomes[3]
+    assert cell.label == world.cell_labels[3]
+    cell2 = world.get_cell(by_position=cell.position)
+    assert cell2.idx == 3
+    with pytest.raises(ValueError):
+        free = np.argwhere(~world.cell_map)[0]
+        world.get_cell(by_position=(int(free[0]), int(free[1])))
+    assert isinstance(cell.int_molecules, np.ndarray)
+    assert isinstance(cell.ext_molecules, np.ndarray)
+    assert isinstance(cell.proteome, list)
+
+
+def test_add_cells():
+    world = _world()
+    world.spawn_cells(_genomes(5, s=400, seed=10))
+    world.increment_cell_lifetimes()
+    cells = [world.get_cell(by_idx=i) for i in range(3)]
+    world2 = _world(seed=77)
+    idxs = world2.add_cells(cells)
+    assert len(idxs) == 3
+    assert world2.cell_genomes == [d.genome for d in cells]
+    assert world2.cell_labels == [d.label for d in cells]
+    assert (world2.cell_lifetimes == 1).all()
+    np.testing.assert_allclose(
+        np.asarray(world2.cell_molecules),
+        np.stack([np.asarray(d.int_molecules) for d in cells]),
+        rtol=1e-6,
+    )
