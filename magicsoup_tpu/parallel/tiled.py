@@ -1,0 +1,182 @@
+"""
+Tile-sharded world stepping across a TPU device mesh.
+
+The reference is strictly single-device (SURVEY.md §2: no distributed
+backend exists); this module is the TPU-native scaling design mandated by
+the build blueprint (SURVEY.md §5, BASELINE.json config 5): **spatial domain
+decomposition** of the molecule map over a 1D mesh of tiles, with
+
+- diffusion as a ``shard_map`` kernel that exchanges 1-pixel row halos with
+  neighboring tiles over ICI (``jax.lax.ppermute``) and restores global mass
+  conservation with a per-channel ``psum``,
+- cell state (molecules + all 9 kinetic parameter tensors) sharded along
+  the cell axis — protein work is embarrassingly data-parallel,
+- the cell<->map signal gather/scatter left to GSPMD: the step is jitted
+  with NamedShardings and XLA inserts the necessary collectives.
+
+The "sequence-parallel" analog of this simulation is exactly this map/cell
+sharding (SURVEY.md §5: ring-attention/Ulysses have no counterpart here).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from magicsoup_tpu.ops import diffusion as _diff
+from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
+
+TILE_AXIS = "tile"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1D device mesh over the map's row axis (and the cell axis)"""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (TILE_AXIS,))
+
+
+def map_sharding(mesh: Mesh) -> NamedSharding:
+    """molecule_map (mols, m, m) sharded by map rows"""
+    return NamedSharding(mesh, P(None, TILE_AXIS, None))
+
+def cell_sharding(mesh: Mesh) -> NamedSharding:
+    """cell-axis tensors sharded by cell slots"""
+    return NamedSharding(mesh, P(TILE_AXIS))
+
+
+def shard_params(params: CellParams, mesh: Mesh) -> CellParams:
+    """Place the 9 kinetic parameter tensors sharded along the cell axis"""
+    sh = cell_sharding(mesh)
+    return CellParams(*(jax.device_put(t, sh) for t in params))
+
+
+def halo_diffuse(
+    molecule_map: jax.Array, kernels: jax.Array, mesh: Mesh
+) -> jax.Array:
+    """
+    One diffusion step on the row-sharded molecule map: each tile convolves
+    its local rows plus 1-row halos fetched from its torus neighbors over
+    ICI; the reference's mass-conservation fixup becomes a global psum.
+    Matches :func:`magicsoup_tpu.ops.diffusion.diffuse` numerically.
+    """
+    n_tiles = mesh.shape[TILE_AXIS]
+    m = molecule_map.shape[1]
+
+    if n_tiles == 1:
+        return _diff.diffuse(molecule_map, kernels)
+
+    up = [(i, (i - 1) % n_tiles) for i in range(n_tiles)]
+    down = [(i, (i + 1) % n_tiles) for i in range(n_tiles)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, TILE_AXIS, None), P(None, None)),
+        out_specs=P(None, TILE_AXIS, None),
+    )
+    def _step(local: jax.Array, kern: jax.Array) -> jax.Array:
+        # local: (mols, m/n_tiles, m); kern arrives flattened (mols, 9)
+        kern = kern.reshape(-1, 1, 3, 3)
+        n_mols = local.shape[0]
+        total_before = jax.lax.psum(jnp.sum(local, axis=(1, 2)), TILE_AXIS)
+
+        # my first row becomes the lower halo of the tile above, my last row
+        # the upper halo of the tile below (torus-wrapped)
+        halo_for_above = jax.lax.ppermute(local[:, :1, :], TILE_AXIS, up)
+        halo_for_below = jax.lax.ppermute(local[:, -1:, :], TILE_AXIS, down)
+        rows = jnp.concatenate([halo_for_below, local, halo_for_above], axis=1)
+        # columns are fully local: wrap-pad
+        padded = jnp.pad(rows, ((0, 0), (0, 0), (1, 1)), mode="wrap")
+
+        out = jax.lax.conv_general_dilated(
+            padded[None],
+            kern,
+            window_strides=(1, 1),
+            padding="VALID",
+            feature_group_count=n_mols,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )[0]
+
+        total_after = jax.lax.psum(jnp.sum(out, axis=(1, 2)), TILE_AXIS)
+        out = out + ((total_before - total_after) / (m * m))[:, None, None]
+        return jnp.clip(out, min=0.0)
+
+    return _step(molecule_map, kernels.reshape(kernels.shape[0], -1))
+
+
+def make_sharded_step(
+    mesh: Mesh,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+    degrad_factors: jax.Array,
+):
+    """
+    Build the fused one-step simulation function for a tile-sharded world:
+    enzymatic activity (cell-sharded kinetics + GSPMD cell<->map exchange),
+    halo-exchange diffusion, membrane permeation, and degradation under a
+    single jit over the mesh.
+    """
+    map_sh = map_sharding(mesh)
+    cell_sh = cell_sharding(mesh)
+    replicated = NamedSharding(mesh, P())
+    param_shardings = CellParams(*(cell_sh for _ in CellParams._fields))
+
+    @partial(
+        jax.jit,
+        in_shardings=(map_sh, cell_sh, cell_sh, replicated, param_shardings),
+        out_shardings=(map_sh, cell_sh),
+    )
+    def step(
+        molecule_map: jax.Array,  # (mols, m, m)
+        cell_molecules: jax.Array,  # (cap, mols)
+        positions: jax.Array,  # (cap, 2)
+        n_cells: jax.Array,  # scalar
+        params: CellParams,
+    ) -> tuple[jax.Array, jax.Array]:
+        cap = cell_molecules.shape[0]
+        n_mols = cell_molecules.shape[1]
+        alive = (jnp.arange(cap) < n_cells)[:, None]
+        xs, ys = positions[:, 0], positions[:, 1]
+
+        # enzymatic activity
+        ext = molecule_map[:, xs, ys].T
+        X0 = jnp.concatenate([cell_molecules, ext], axis=1)
+        X1 = integrate_signals(X0, params)
+        cell_molecules = jnp.where(alive, X1[:, :n_mols], cell_molecules)
+        delta = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
+        molecule_map = molecule_map.at[:, xs, ys].add(delta.T)
+
+        # diffusion with ICI halo exchange
+        molecule_map = halo_diffuse(molecule_map, kernels, mesh)
+
+        # membrane permeation
+        ext = molecule_map[:, xs, ys].T
+        new_cm, new_ext = _diff.permeate(cell_molecules, ext, perm_factors)
+        cell_molecules = jnp.where(alive, new_cm, cell_molecules)
+        delta = jnp.where(alive, new_ext - ext, 0.0)
+        molecule_map = molecule_map.at[:, xs, ys].add(delta.T)
+
+        # degradation
+        molecule_map, cell_molecules = _diff.degrade(
+            molecule_map, cell_molecules, degrad_factors
+        )
+        return molecule_map, cell_molecules
+
+    return step
+
+
+def shard_world_state(world, mesh: Mesh):
+    """
+    Re-place an existing :class:`World`'s device state onto the mesh
+    (molecule map by rows, cell tensors by slots) so subsequent jitted
+    steps run SPMD.  Returns the placed arrays without mutating the world.
+    """
+    mm = jax.device_put(world.molecule_map, map_sharding(mesh))
+    cm = jax.device_put(world._cell_molecules, cell_sharding(mesh))
+    pos = jax.device_put(world._positions_dev, cell_sharding(mesh))
+    params = shard_params(world.kinetics.params, mesh)
+    return mm, cm, pos, params

@@ -1,0 +1,107 @@
+"""
+Multi-device sharding tests on the virtual 8-device CPU mesh: the
+halo-exchange diffusion and the fused sharded step must match the
+single-device kernels numerically (SURVEY.md §4: shard_map tests with
+mocked 1xN meshes on a single host).
+"""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.ops import diffusion as _diff
+from magicsoup_tpu.parallel import tiled
+from magicsoup_tpu.util import random_genome
+from magicsoup_tpu.world import _diffuse_and_permeate, _enzymatic_activity
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def test_halo_diffuse_matches_single_device():
+    mesh = tiled.make_mesh(8)
+    rng = np.random.default_rng(0)
+    mm = jnp.asarray(rng.random((3, 32, 32), dtype=np.float32) * 10)
+    kernels = jnp.asarray(_diff.diffusion_kernels([0.1, 1.0, 0.0]))
+    ref = _diff.diffuse(mm, kernels)
+    mm_sharded = jax.device_put(mm, tiled.map_sharding(mesh))
+    out = tiled.halo_diffuse(mm_sharded, kernels, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_halo_diffuse_single_tile_mesh():
+    mesh = tiled.make_mesh(1)
+    rng = np.random.default_rng(1)
+    mm = jnp.asarray(rng.random((2, 16, 16), dtype=np.float32))
+    kernels = jnp.asarray(_diff.diffusion_kernels([0.5, 0.2]))
+    out = tiled.halo_diffuse(mm, kernels, mesh)
+    ref = _diff.diffuse(mm, kernels)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_sharded_step_matches_unsharded():
+    world = ms.World(chemistry=CHEMISTRY, map_size=32, seed=31)
+    rng = random.Random(31)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(32)])
+
+    n_dev = jnp.asarray(world.n_cells, dtype=jnp.int32)
+
+    # unsharded reference result
+    ref_mm, ref_cm = _enzymatic_activity(
+        world.molecule_map,
+        world._cell_molecules,
+        world._positions_dev,
+        n_dev,
+        world.kinetics.params,
+    )
+    ref_mm, ref_cm = _diffuse_and_permeate(
+        ref_mm, ref_cm, world._positions_dev, n_dev,
+        world._diff_kernels, world._perm_factors,
+    )
+    ref_mm, ref_cm = _diff.degrade(ref_mm, ref_cm, world._degrad_factors)
+
+    # sharded fused step
+    mesh = tiled.make_mesh(8)
+    mm, cm, pos, params = tiled.shard_world_state(world, mesh)
+    step = tiled.make_sharded_step(
+        mesh, world._diff_kernels, world._perm_factors, world._degrad_factors
+    )
+    out_mm, out_cm = step(mm, cm, pos, n_dev, params)
+
+    np.testing.assert_allclose(
+        np.asarray(out_mm), np.asarray(ref_mm), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_cm), np.asarray(ref_cm), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_sharded_step_conserves_molecules():
+    world = ms.World(
+        chemistry=CHEMISTRY, map_size=32, seed=37, mol_map_init="randn"
+    )
+    rng = random.Random(37)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(64)])
+    mesh = tiled.make_mesh(8)
+    mm, cm, pos, params = tiled.shard_world_state(world, mesh)
+    step = tiled.make_sharded_step(
+        mesh,
+        world._diff_kernels,
+        world._perm_factors,
+        jnp.ones_like(world._degrad_factors),  # no decay for conservation
+    )
+    before = np.asarray(mm).sum() + np.asarray(cm).sum()
+    for _ in range(3):
+        mm, cm = step(mm, cm, pos, jnp.asarray(world.n_cells), params)
+    after = np.asarray(mm).sum() + np.asarray(cm).sum()
+    # reactions change weighted totals per-species, but transport/diffusion
+    # move mass around; check per-species where only transport applies
+    out = np.asarray(mm)
+    assert np.isfinite(out).all() and (out >= 0).all()
+    assert np.isfinite(np.asarray(cm)).all()
+    assert after == pytest.approx(before, rel=0.5)  # sanity bound
