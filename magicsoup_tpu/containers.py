@@ -543,7 +543,9 @@ class Cell:
     def ext_molecules(self) -> np.ndarray:
         if self._ext_molecules is None:
             x, y = self.position
-            self._ext_molecules = np.asarray(self.world.molecule_map[:, x, y])
+            # fetch-then-index: eager device indexing at Python-int coords
+            # would compile a fresh XLA slice program per coordinate
+            self._ext_molecules = np.asarray(self.world.molecule_map)[:, x, y]
         return self._ext_molecules
 
     @property

@@ -355,6 +355,7 @@ class Kinetics:
 
         self.max_cells = 0
         self.max_proteins = 0
+        self.max_doms = 1
         self.params = self._alloc(0, 0)
 
     # ------------------------------------------------------------------ #
@@ -429,8 +430,13 @@ class Kinetics:
         max_prots = int(prot_counts.max()) if len(prot_counts) else 0
         if max_prots > self.max_proteins:
             self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
+        # grow-only domain capacity: a per-batch capacity would recompile
+        # `compute_cell_params` for every distinct batch shape
+        max_doms = int(prots[:, 3].max()) if len(prots) else 1
+        self.max_doms = max(self.max_doms, pad_pow2(max_doms, minimum=1))
         dense, _ = flat_to_dense(
-            prot_counts, prots, doms, n_prots_cap=self.max_proteins
+            prot_counts, prots, doms, n_prots_cap=self.max_proteins,
+            n_doms_cap=self.max_doms,
         )
         b_pad = pad_pow2(b)
         dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=np.int32)

@@ -251,15 +251,17 @@ _NTS = "ACTG"  # reference mutation alphabet order (rust/mutations.rs:6)
 
 def point_mutations_flat(
     seqs: list[str],
-    p: float,
+    n_muts_per_seq: np.ndarray,
     p_indel: float,
     p_del: float,
     seed: int,
 ) -> list[tuple[str, int]]:
     """
-    Apply point mutations (substitutions and indels) to each sequence.
-    Per-sequence deterministic RNG stream derived from ``seed`` and the
-    sequence index.  Returns only mutated sequences with their input index.
+    Apply the given number of point mutations (substitutions and indels)
+    to each sequence.  Mutation counts are pre-drawn by the caller
+    (vectorized Poisson); per-sequence deterministic RNG stream derived
+    from ``seed`` and the sequence index.  Returns only mutated sequences
+    with their input index.
     """
     out: list[tuple[str, int]] = []
     for idx, seq in enumerate(seqs):
@@ -267,7 +269,7 @@ def point_mutations_flat(
         if n < 1:
             continue
         rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + idx))
-        n_muts = int(rng.poisson(p * n))
+        n_muts = int(n_muts_per_seq[idx])
         if n_muts < 1:
             continue
         n_muts = min(n_muts, n)
@@ -291,13 +293,14 @@ def point_mutations_flat(
 
 def recombinations_flat(
     seq_pairs: list[tuple[str, str]],
-    p: float,
+    n_breaks_per_pair: np.ndarray,
     seed: int,
 ) -> list[tuple[str, str, int]]:
     """
-    Recombine sequence pairs by Poisson-distributed strand breaks: both
+    Recombine sequence pairs by the given numbers of strand breaks: both
     sequences are cut at random positions, all fragments shuffled, and a
     random split point reassembles two new sequences (length-conserving).
+    Break counts are pre-drawn by the caller (vectorized Poisson).
     Returns only recombined pairs with their input index.
     """
     out: list[tuple[str, str, int]] = []
@@ -308,7 +311,7 @@ def recombinations_flat(
         if n_both < 1:
             continue
         rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + idx))
-        n_muts = int(rng.poisson(p * n_both))
+        n_muts = int(n_breaks_per_pair[idx])
         if n_muts < 1:
             continue
         n_muts = min(n_muts, n_both)

@@ -78,7 +78,8 @@ def _load_lib():
     lib.ms_translate_genomes.restype = None
     lib.ms_point_mutations.argtypes = [
         _charp, _i64p, ctypes.c_int64,
-        ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        _i64p,  # pre-drawn per-seq mutation counts
+        ctypes.c_float, ctypes.c_float,
         ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
         ctypes.POINTER(_i64p), _i64p,
@@ -86,7 +87,8 @@ def _load_lib():
     lib.ms_point_mutations.restype = None
     lib.ms_recombinations.argtypes = [
         _charp, _i64p, ctypes.c_int64,
-        ctypes.c_float, ctypes.c_uint64, ctypes.c_int,
+        _i64p,  # pre-drawn per-pair strand-break counts
+        ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
         ctypes.POINTER(_i64p), _i64p,
     ]
@@ -199,30 +201,49 @@ def point_mutations(
     seed: int,
     n_threads: int = 0,
 ) -> list[tuple[str, int]]:
-    """Point mutations; returns only mutated sequences with input indices"""
+    """
+    Point mutations; returns only mutated sequences with input indices.
+
+    The Poisson(p*len) mutation count per sequence is drawn vectorized on
+    the host first, and only the (typically very few) sequences with a
+    nonzero count are handed to the string engine — per-call work scales
+    with the number of mutated genomes, not the population
+    (reference rust/mutations.rs:11-73 iterates all genomes per call).
+    """
     if len(seqs) == 0:
         return []
+    lens = np.fromiter((len(s) for s in seqs), dtype=np.int64, count=len(seqs))
+    nprng = np.random.default_rng(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
+    n_muts = nprng.poisson(p * lens)
+    sel = np.nonzero(n_muts > 0)[0]
+    if len(sel) == 0:
+        return []
+    sub = [seqs[int(i)] for i in sel]
+    counts = n_muts[sel].astype(np.int64)
     lib = get_lib()
     if lib is None:
-        return _pyengine.point_mutations_flat(seqs, p, p_indel, p_del, seed)
-    data, offsets = _concat(seqs)
-    out_data = _charp()
-    out_offsets = _i64p()
-    out_idxs = _i64p()
-    out_n = ctypes.c_int64()
-    lib.ms_point_mutations(
-        ctypes.cast(data, _charp),
-        offsets.ctypes.data_as(_i64p),
-        len(seqs),
-        p, p_indel, p_del,
-        seed & 0xFFFFFFFFFFFFFFFF,
-        n_threads,
-        ctypes.byref(out_data),
-        ctypes.byref(out_offsets),
-        ctypes.byref(out_idxs),
-        ctypes.byref(out_n),
-    )
-    return _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 1)
+        out = _pyengine.point_mutations_flat(sub, counts, p_indel, p_del, seed)
+    else:
+        data, offsets = _concat(sub)
+        out_data = _charp()
+        out_offsets = _i64p()
+        out_idxs = _i64p()
+        out_n = ctypes.c_int64()
+        lib.ms_point_mutations(
+            ctypes.cast(data, _charp),
+            offsets.ctypes.data_as(_i64p),
+            len(sub),
+            counts.ctypes.data_as(_i64p),
+            p_indel, p_del,
+            seed & 0xFFFFFFFFFFFFFFFF,
+            n_threads,
+            ctypes.byref(out_data),
+            ctypes.byref(out_offsets),
+            ctypes.byref(out_idxs),
+            ctypes.byref(out_n),
+        )
+        out = _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 1)
+    return [(s, int(sel[i])) for s, i in out]
 
 
 def recombinations(
@@ -231,28 +252,46 @@ def recombinations(
     seed: int,
     n_threads: int = 0,
 ) -> list[tuple[str, str, int]]:
-    """Strand-break recombinations; returns only recombined pairs"""
+    """
+    Strand-break recombinations; returns only recombined pairs.
+
+    Like :func:`point_mutations`, the Poisson(p*(len0+len1)) break count
+    per pair is pre-drawn vectorized on the host so only pairs with a
+    break reach the string engine.
+    """
     if len(seq_pairs) == 0:
         return []
+    lens = np.fromiter(
+        (len(a) + len(b) for a, b in seq_pairs), dtype=np.int64, count=len(seq_pairs)
+    )
+    nprng = np.random.default_rng(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
+    n_breaks = nprng.poisson(p * lens)
+    sel = np.nonzero(n_breaks > 0)[0]
+    if len(sel) == 0:
+        return []
+    sub = [seq_pairs[int(i)] for i in sel]
+    counts = n_breaks[sel].astype(np.int64)
     lib = get_lib()
     if lib is None:
-        return _pyengine.recombinations_flat(seq_pairs, p, seed)
-    flat = [s for pair in seq_pairs for s in pair]
-    data, offsets = _concat(flat)
-    out_data = _charp()
-    out_offsets = _i64p()
-    out_idxs = _i64p()
-    out_n = ctypes.c_int64()
-    lib.ms_recombinations(
-        ctypes.cast(data, _charp),
-        offsets.ctypes.data_as(_i64p),
-        len(seq_pairs),
-        p,
-        seed & 0xFFFFFFFFFFFFFFFF,
-        n_threads,
-        ctypes.byref(out_data),
-        ctypes.byref(out_offsets),
-        ctypes.byref(out_idxs),
-        ctypes.byref(out_n),
-    )
-    return _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 2)
+        out = _pyengine.recombinations_flat(sub, counts, seed)
+    else:
+        flat = [s for pair in sub for s in pair]
+        data, offsets = _concat(flat)
+        out_data = _charp()
+        out_offsets = _i64p()
+        out_idxs = _i64p()
+        out_n = ctypes.c_int64()
+        lib.ms_recombinations(
+            ctypes.cast(data, _charp),
+            offsets.ctypes.data_as(_i64p),
+            len(sub),
+            counts.ctypes.data_as(_i64p),
+            seed & 0xFFFFFFFFFFFFFFFF,
+            n_threads,
+            ctypes.byref(out_data),
+            ctypes.byref(out_offsets),
+            ctypes.byref(out_idxs),
+            ctypes.byref(out_n),
+        )
+        out = _unpack_seqs(lib, out_data, out_offsets, out_idxs, out_n.value, 2)
+    return [(s0, s1, int(sel[i])) for s0, s1, i in out]

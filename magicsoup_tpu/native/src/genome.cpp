@@ -292,10 +292,15 @@ struct MutResult {
 // Point mutations over n sequences.  Returns only mutated sequences:
 // *out_data is the concatenation of the mutated sequences, *out_offsets has
 // *out_n + 1 entries, *out_idxs maps each to its input index.
+// The caller pre-draws the Poisson(p*len) mutation count per sequence
+// (vectorized numpy on the host) and passes only sequences with >= 1
+// mutation — this keeps the per-call work proportional to the number of
+// actually-mutated sequences instead of the population size.
 void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
-                        float p, float p_indel, float p_del, uint64_t seed,
-                        int n_threads, char** out_data, int64_t** out_offsets,
-                        int64_t** out_idxs, int64_t* out_n) {
+                        const int64_t* n_muts_in, float p_indel, float p_del,
+                        uint64_t seed, int n_threads, char** out_data,
+                        int64_t** out_offsets, int64_t** out_idxs,
+                        int64_t* out_n) {
   std::vector<MutResult> results((size_t)n);
 
 #if defined(_OPENMP)
@@ -312,8 +317,7 @@ void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
       int64_t len = offsets[si + 1] - offsets[si];
       if (len < 1) continue;
       std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)si);
-      std::poisson_distribution<int64_t> poi((double)p * (double)len);
-      int64_t n_muts = poi(rng);
+      int64_t n_muts = n_muts_in[si];
       if (n_muts < 1) continue;
       if (n_muts > len) n_muts = len;
       sample_positions(rng, len, n_muts, positions);
@@ -375,9 +379,9 @@ void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
 // pair i = sequences 2i and 2i+1).  Output mirrors ms_point_mutations but
 // with two sequences per result (2*out_n sequences, out_n indices).
 void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
-                       float p, uint64_t seed, int n_threads, char** out_data,
-                       int64_t** out_offsets, int64_t** out_idxs,
-                       int64_t* out_n) {
+                       const int64_t* n_breaks_in, uint64_t seed,
+                       int n_threads, char** out_data, int64_t** out_offsets,
+                       int64_t** out_idxs, int64_t* out_n) {
   std::vector<MutResult> results((size_t)n);
 
 #if defined(_OPENMP)
@@ -398,8 +402,7 @@ void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
       int64_t n_both = n0 + n1;
       if (n_both < 1) continue;
       std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)pi);
-      std::poisson_distribution<int64_t> poi((double)p * (double)n_both);
-      int64_t n_muts = poi(rng);
+      int64_t n_muts = n_breaks_in[pi];
       if (n_muts < 1) continue;
       if (n_muts > n_both) n_muts = n_both;
       sample_positions(rng, n_both, n_muts, positions);

@@ -148,6 +148,17 @@ def _divide_molecules(
 
 
 @jax.jit
+def _set_prefix(
+    cell_molecules: jax.Array,  # (cap, mols)
+    values: jax.Array,  # (cap, mols) — rows >= n ignored
+    n: jax.Array,  # scalar int
+) -> jax.Array:
+    """Overwrite rows 0..n-1 with static shapes (no per-n recompiles)"""
+    keep = (jnp.arange(cell_molecules.shape[0]) < n)[:, None]
+    return jnp.where(keep, values, cell_molecules)
+
+
+@jax.jit
 def _permute_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Array:
     """Stable compaction: gather rows by permutation, zero rank >= n_keep"""
     out = arr[perm]
@@ -269,18 +280,31 @@ class World:
         self._molecule_map = value
 
     @property
-    def cell_molecules(self) -> jax.Array:
-        """(n_cells, n_mols) float32 intracellular concentrations"""
-        return self._cell_molecules[: self.n_cells]
+    def cell_molecules(self) -> np.ndarray:
+        """
+        (n_cells, n_mols) float32 intracellular concentrations as a host
+        numpy copy.  Mutations do not write through — assign the modified
+        array back (``world.cell_molecules = cm``).  The full-capacity
+        device buffer is ``world._cell_molecules``.
+
+        Returned host-side on purpose: slicing the device buffer to the
+        current (dynamic) cell count would compile a fresh XLA program for
+        every population size.
+        """
+        return np.asarray(self._cell_molecules)[: self.n_cells].copy()
 
     @cell_molecules.setter
     def cell_molecules(self, value):
-        value = jnp.asarray(value, dtype=jnp.float32)
+        value = np.asarray(value, dtype=np.float32)
         if value.shape != (self.n_cells, self.n_molecules):
             raise ValueError(
                 f"cell_molecules must have shape {(self.n_cells, self.n_molecules)}"
             )
-        self._cell_molecules = self._cell_molecules.at[: self.n_cells].set(value)
+        vals = np.zeros((self._capacity, self.n_molecules), dtype=np.float32)
+        vals[: self.n_cells] = value
+        self._cell_molecules = _set_prefix(
+            self._cell_molecules, jnp.asarray(vals), self._n_cells_dev()
+        )
 
     @property
     def cell_map(self) -> np.ndarray:
@@ -871,7 +895,7 @@ class World:
         statedir = Path(statedir)
         statedir.mkdir(parents=True, exist_ok=True)
         n = self.n_cells
-        np.save(statedir / "cell_molecules.npy", np.asarray(self._cell_molecules[:n]))
+        np.save(statedir / "cell_molecules.npy", np.asarray(self._cell_molecules)[:n])
         np.save(statedir / "cell_map.npy", self._np_cell_map)
         np.save(statedir / "molecule_map.npy", np.asarray(self._molecule_map))
         np.save(statedir / "cell_lifetimes.npy", self._np_lifetimes[:n])

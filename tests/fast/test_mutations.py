@@ -6,6 +6,8 @@ plus seeding the reference does not support).
 """
 import random
 
+import numpy as np
+
 import pytest
 
 import magicsoup_tpu as ms
@@ -84,14 +86,18 @@ def test_recombination_empty_input():
 
 
 def test_python_engine_mutation_semantics():
-    # the fallback engine honors the same contract
+    # the fallback engine honors the same contract (counts pre-drawn by
+    # the caller, as engine.point_mutations does)
     seqs = _genomes(200, 500, 8)
-    res = _pyengine.point_mutations_flat(seqs, p=1e-2, p_indel=0.4, p_del=0.66, seed=3)
+    rng = np.random.default_rng(3)
+    counts = rng.poisson(1e-2 * np.array([len(s) for s in seqs]))
+    res = _pyengine.point_mutations_flat(seqs, counts, p_indel=0.4, p_del=0.66, seed=3)
     assert len(res) > 150
     n_diff = sum(1 for seq, idx in res if seq != seqs[idx])
     assert n_diff > 0.5 * len(res)
     pairs = list(zip(seqs[:100], seqs[100:]))
-    rec = _pyengine.recombinations_flat(pairs, p=1e-2, seed=3)
+    breaks = rng.poisson(1e-2 * np.array([len(a) + len(b) for a, b in pairs]))
+    rec = _pyengine.recombinations_flat(pairs, breaks, seed=3)
     for a, b, idx in rec:
         s0, s1 = pairs[idx]
         assert len(a) + len(b) == len(s0) + len(s1)
@@ -99,8 +105,19 @@ def test_python_engine_mutation_semantics():
 
 @pytest.mark.skipif(not engine.has_native(), reason="native engine unavailable")
 def test_native_mutation_rates_match_python_statistically():
+    # both paths share the host-side Poisson pre-draw, so for the same
+    # seed the set of mutated indices is identical
     seqs = _genomes(2000, 500, 9)
-    n_native = len(engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5))
-    n_py = len(_pyengine.point_mutations_flat(seqs, 2e-3, 0.4, 0.66, seed=5))
-    # same Poisson(1.0) hit distribution -> counts within loose bounds
-    assert abs(n_native - n_py) < 0.15 * 2000
+    native = engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5)
+    import os
+
+    os.environ["MAGICSOUP_TPU_NO_NATIVE"] = "1"
+    engine._LIB_TRIED = False
+    try:
+        py = engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5)
+    finally:
+        del os.environ["MAGICSOUP_TPU_NO_NATIVE"]
+        engine._LIB_TRIED = False
+    assert [i for _, i in native] == [i for _, i in py]
+    for (sn, _), (sp, _) in zip(native, py):
+        assert abs(len(sn) - len(sp)) < 20
