@@ -1,0 +1,89 @@
+"""
+Headline benchmark: sim steps/sec at 10k cells on a 128x128 map running the
+reference's realistic workload (`performance/run_simulation.py:43-113`):
+spawn top-up, enzymatic_activity, ATP-threshold kill and divide,
+recombinate, mutate, degrade+diffuse+lifetimes.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}
+
+Baseline: the reference's CUDA numbers (EC2 GPU, 2023-12-19,
+`performance/run_simulation.py:20`) are 0.03 s/step at 1k cells and
+0.30 s/step at 40k cells; linear interpolation in cell count gives
+~0.0923 s/step at 10k cells -> 10.83 steps/s.  `vs_baseline` > 1 means
+faster than the reference on its own headline workload.
+
+Run on whatever accelerator JAX finds (the driver provides a TPU chip); do
+not pin a platform here.
+"""
+import argparse
+import json
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+BASELINE_S_PER_STEP = 0.03 + (0.30 - 0.03) * (10_000 - 1_000) / (40_000 - 1_000)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-cells", type=int, default=10_000)
+    ap.add_argument("--map-size", type=int, default=128)
+    ap.add_argument("--genome-size", type=int, default=500)
+    ap.add_argument("--warmup", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=15)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+    from magicsoup_tpu.util import random_genome
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "performance"))
+    from workload import sim_step
+
+    rng = random.Random(args.seed)
+    world = ms.World(chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed)
+    world.spawn_cells(
+        [random_genome(s=args.genome_size, rng=rng) for _ in range(args.n_cells)]
+    )
+    atp = CHEMISTRY.molname_2_idx["ATP"]
+
+    def step() -> None:
+        sim_step(
+            world,
+            rng,
+            n_cells=args.n_cells,
+            genome_size=args.genome_size,
+            atp_idx=atp,
+        )
+
+    for _ in range(args.warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    dt = (time.perf_counter() - t0) / args.steps
+
+    steps_per_s = 1.0 / dt
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"sim steps/sec ({args.n_cells} cells, "
+                    f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
+                    "run_simulation workload)"
+                ),
+                "value": round(steps_per_s, 4),
+                "unit": "steps/s",
+                "vs_baseline": round(steps_per_s * BASELINE_S_PER_STEP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

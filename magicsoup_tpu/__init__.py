@@ -31,6 +31,7 @@ from magicsoup_tpu.factories import (
 from magicsoup_tpu.genetics import Genetics
 from magicsoup_tpu.kinetics import Kinetics
 from magicsoup_tpu.mutations import point_mutations, recombinations
+from magicsoup_tpu.util import codons, random_genome, randstr, variants
 from magicsoup_tpu.world import World
 
 __version__ = "0.1.0"
@@ -51,6 +52,10 @@ __all__ = [
     "TransporterDomain",
     "TransporterDomainFact",
     "World",
+    "codons",
     "point_mutations",
+    "random_genome",
+    "randstr",
     "recombinations",
+    "variants",
 ]

@@ -534,18 +534,16 @@ class Cell:
     @property
     def int_molecules(self) -> np.ndarray:
         if self._int_molecules is None:
-            self._int_molecules = np.asarray(
-                self.world.cell_molecules[self.idx, :]
-            )
+            # the world's cached host snapshot: per-cell device fetches
+            # would transfer the full buffer for every cell
+            self._int_molecules = self.world._host_cell_molecules()[self.idx, :]
         return self._int_molecules
 
     @property
     def ext_molecules(self) -> np.ndarray:
         if self._ext_molecules is None:
             x, y = self.position
-            # fetch-then-index: eager device indexing at Python-int coords
-            # would compile a fresh XLA slice program per coordinate
-            self._ext_molecules = np.asarray(self.world.molecule_map)[:, x, y]
+            self._ext_molecules = self.world._host_molecule_map()[:, x, y]
         return self._ext_molecules
 
     @property

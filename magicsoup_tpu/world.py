@@ -256,10 +256,12 @@ class World:
         self._np_lifetimes = np.zeros(0, dtype=np.int32)
         self._np_divisions = np.zeros(0, dtype=np.int32)
 
-        # device-side state
+        # device-side state (+ identity-keyed host snapshot caches)
         self._cell_molecules = jnp.zeros((0, self.n_molecules), dtype=jnp.float32)
         self._positions_dev = jnp.zeros((0, 2), dtype=jnp.int32)
         self._molecule_map = self._init_molecule_map(mol_map_init)
+        self._mm_cache: tuple | None = None
+        self._cm_cache: tuple | None = None
 
         self._ensure_capacity(_MIN_CAPACITY)
 
@@ -279,19 +281,38 @@ class World:
             raise ValueError(f"molecule_map must have shape {self._molecule_map.shape}")
         self._molecule_map = value
 
+    def _host_molecule_map(self) -> np.ndarray:
+        """Cached host snapshot of the molecule map.  Valid exactly while
+        the device array object is unchanged (jax arrays are immutable, so
+        identity comparison is an exact invalidation test)."""
+        cache = self._mm_cache
+        if cache is None or cache[0] is not self._molecule_map:
+            cache = (self._molecule_map, np.asarray(self._molecule_map))
+            self._mm_cache = cache
+        return cache[1]
+
+    def _host_cell_molecules(self) -> np.ndarray:
+        """Cached host snapshot of the full-capacity cell molecule buffer"""
+        cache = self._cm_cache
+        if cache is None or cache[0] is not self._cell_molecules:
+            cache = (self._cell_molecules, np.asarray(self._cell_molecules))
+            self._cm_cache = cache
+        return cache[1]
+
     @property
     def cell_molecules(self) -> np.ndarray:
         """
-        (n_cells, n_mols) float32 intracellular concentrations as a host
-        numpy copy.  Mutations do not write through — assign the modified
-        array back (``world.cell_molecules = cm``).  The full-capacity
-        device buffer is ``world._cell_molecules``.
+        (n_cells, n_mols) float32 intracellular concentrations as a
+        READ-ONLY host numpy view.  In-place writes raise — copy, modify,
+        and assign back instead (``cm = world.cell_molecules.copy();
+        ...; world.cell_molecules = cm``).  The full-capacity device
+        buffer is ``world._cell_molecules``.
 
         Returned host-side on purpose: slicing the device buffer to the
         current (dynamic) cell count would compile a fresh XLA program for
         every population size.
         """
-        return np.asarray(self._cell_molecules)[: self.n_cells].copy()
+        return self._host_cell_molecules()[: self.n_cells]
 
     @cell_molecules.setter
     def cell_molecules(self, value):
@@ -851,6 +872,8 @@ class World:
         state["_perm_factors"] = np.asarray(self._perm_factors)
         state["_degrad_factors"] = np.asarray(self._degrad_factors)
         state.pop("_positions_dev")
+        state["_mm_cache"] = None
+        state["_cm_cache"] = None
         return state
 
     def __setstate__(self, state: dict):

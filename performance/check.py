@@ -1,0 +1,129 @@
+"""
+Micro-benchmarks of the expensive World methods, mirroring the reference's
+harness (`performance/check.py:48-182`): spawn_cells, update_cells,
+divide_cells (replicate), enzymatic_activity, and
+mutations+neighbors+recombinations, at 10k cells with 1k-bp genomes.
+
+    python performance/check.py [--n 10000] [--s 1000] [--r 5]
+
+Reference numbers to compare against (see BASELINE.md): on a g4dn.xlarge
+CUDA GPU the reference measured 6.64 s spawn, 5.95 s update, 0.28 s
+replicate, 0.16 s enzymatic activity, 0.46 s mutations.
+
+Runs on whatever device JAX finds; timings block on device results.
+"""
+import random
+import statistics
+import sys
+import time
+from argparse import ArgumentParser
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _summary(tds: list[float]) -> str:
+    mu = statistics.fmean(tds)
+    sd = statistics.pstdev(tds)
+    return f"({mu:.2f}+-{sd:.2f})s"
+
+
+def main() -> None:
+    ap = ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000, help="number of cells")
+    ap.add_argument("--s", type=int, default=1_000, help="genome size")
+    ap.add_argument("--r", type=int, default=5, help="repeats")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+
+    rng = random.Random(args.seed)
+
+    def gen_genomes(n: int, s: int, d: float = 0.1) -> list[str]:
+        pop = [s - int(s * d), s, s + int(s * d)]
+        return [ms.random_genome(s=rng.choice(pop), rng=rng) for _ in range(n)]
+
+    def sync(world) -> None:
+        jax.block_until_ready((world._molecule_map, world._cell_molecules))
+        jax.block_until_ready(world.kinetics.params.Vmax)
+
+    print(
+        f"Benchmarking spawn_cells, update_cells, divide_cells, "
+        f"enzymatic_activity, mutations\n"
+        f"{args.n:,} cells, {args.s:,} genome size, "
+        f"on {jax.devices()[0].platform}"
+    )
+
+    # -- spawn
+    tds = []
+    for _ in range(args.r):
+        world = ms.World(chemistry=CHEMISTRY, seed=rng.randrange(2**31))
+        genomes = gen_genomes(args.n, args.s)
+        t0 = time.perf_counter()
+        world.spawn_cells(genomes=genomes)
+        sync(world)
+        tds.append(time.perf_counter() - t0)
+    print(f"{_summary(tds)} - spawn cells")
+
+    # -- update
+    tds = []
+    for _ in range(args.r):
+        world = ms.World(chemistry=CHEMISTRY, seed=rng.randrange(2**31))
+        world.spawn_cells(genomes=gen_genomes(args.n, args.s))
+        pairs = list(zip(gen_genomes(args.n, args.s), range(world.n_cells)))
+        sync(world)
+        t0 = time.perf_counter()
+        world.update_cells(genome_idx_pairs=pairs)
+        sync(world)
+        tds.append(time.perf_counter() - t0)
+    print(f"{_summary(tds)} - update cells")
+
+    # -- replicate (divide)
+    tds = []
+    for _ in range(args.r):
+        world = ms.World(chemistry=CHEMISTRY, seed=rng.randrange(2**31))
+        world.spawn_cells(genomes=gen_genomes(args.n, args.s))
+        sync(world)
+        t0 = time.perf_counter()
+        world.divide_cells(cell_idxs=list(range(world.n_cells)))
+        sync(world)
+        tds.append(time.perf_counter() - t0)
+    print(f"{_summary(tds)} - replicate cells")
+
+    # -- enzymatic activity (steady-state timing: warm the jit first)
+    world = ms.World(chemistry=CHEMISTRY, seed=rng.randrange(2**31))
+    world.spawn_cells(genomes=gen_genomes(args.n, args.s))
+    world.enzymatic_activity()
+    sync(world)
+    tds = []
+    for _ in range(args.r):
+        t0 = time.perf_counter()
+        world.enzymatic_activity()
+        sync(world)
+        tds.append(time.perf_counter() - t0)
+    print(f"{_summary(tds)} - enzymatic activity")
+
+    # -- mutations + neighbors + recombinations
+    tds = []
+    for _ in range(args.r):
+        t0 = time.perf_counter()
+        world.mutate_cells()
+        nghbrs = world.get_neighbors(cell_idxs=list(range(world.n_cells)))
+        pairs = [
+            (world.cell_genomes[a], world.cell_genomes[b]) for a, b in nghbrs
+        ]
+        ms.recombinations(seq_pairs=pairs)
+        sync(world)
+        tds.append(time.perf_counter() - t0)
+    print(f"{_summary(tds)} - mutations")
+
+    _ = np.asarray(world.cell_molecules)  # keep linters honest about use
+
+
+if __name__ == "__main__":
+    main()

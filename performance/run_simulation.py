@@ -1,0 +1,133 @@
+"""
+Realistic-environment simulation run with per-phase timers, mirroring the
+reference harness (`performance/run_simulation.py:43-127`): maintain a
+population on a torus map under the Wood-Ljungdahl chemistry; each step is
+spawn top-up, enzymatic_activity, ATP-threshold kill and divide,
+recombinate, mutate, degrade+diffuse+lifetimes.
+
+    python performance/run_simulation.py --map-size 256 --n-steps 200
+
+Writes per-phase timings to TensorBoard when available
+(``--logdir performance/runs``), and always prints a per-phase summary to
+stdout.  Monitor with ``tensorboard --logdir performance/runs``.
+"""
+import datetime as dt
+import json
+import random
+import sys
+import time
+from argparse import ArgumentParser, Namespace
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+_THIS_DIR = Path(__file__).parent
+_NOW = dt.datetime.now().strftime("%Y-%m-%d_%H-%M")
+
+
+class _Writer:
+    """TensorBoard writer when torch is importable, else JSONL."""
+
+    def __init__(self, logdir: Path):
+        self._tb = None
+        self._fh = None
+        logdir.mkdir(parents=True, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=logdir)
+        except Exception:
+            self._fh = open(logdir / "scalars.jsonl", "w")
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        else:
+            self._fh.write(json.dumps({"tag": tag, "value": value, "step": step}) + "\n")
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        else:
+            self._fh.close()
+
+
+def main(args: Namespace) -> None:
+    import numpy as np
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+
+    sys.path.insert(0, str(_THIS_DIR))
+    from workload import sim_step
+
+    logdir = _THIS_DIR / "runs" / _NOW
+    writer = _Writer(logdir)
+    totals: dict[str, float] = defaultdict(float)
+
+    @contextmanager
+    def timeit(label: str, step: int):
+        t0 = time.perf_counter()
+        yield
+        d = time.perf_counter() - t0
+        totals[label] += d
+        writer.add_scalar(f"Time[s]/{label}", d, step)
+
+    rng = random.Random(args.seed)
+    world = ms.World(
+        chemistry=CHEMISTRY,
+        map_size=args.map_size,
+        mol_map_init=args.init_molmap,
+        seed=args.seed,
+    )
+    world.save(rundir=logdir)
+
+    atp = CHEMISTRY.molname_2_idx["ATP"]
+
+    for step_i in range(args.n_steps):
+        if step_i % 100 == 0:
+            world.save_state(statedir=logdir / f"step={step_i}")
+
+        with timeit("perStep", step_i):
+            sim_step(
+                world,
+                rng,
+                n_cells=args.n_cells,
+                genome_size=args.init_genome_size,
+                atp_idx=atp,
+                timeit=lambda label: timeit(label, step_i),
+            )
+
+        writer.add_scalar("Cells/total", world.n_cells, step_i)
+
+        if step_i % args.log_every == 0:
+            molmap = np.asarray(world.molecule_map)
+            cellmols = world.cell_molecules
+            n_pxls = world.map_size**2
+            for mol_i, mol in enumerate(CHEMISTRY.molecules):
+                d = float(molmap[mol_i].sum())
+                n = n_pxls
+                if world.n_cells > 0:
+                    d += float(cellmols[:, mol_i].sum())
+                    n += world.n_cells
+                writer.add_scalar(f"Molecules/{mol.name}", d / n, step_i)
+
+    writer.close()
+    n = max(args.n_steps, 1)
+    print(f"{args.n_steps} steps, final n_cells={world.n_cells}")
+    for label, total in sorted(totals.items(), key=lambda kv: -kv[1]):
+        print(f"  {label:20s} {total / n:.4f} s/step")
+
+
+if __name__ == "__main__":
+    parser = ArgumentParser()
+    parser.add_argument("--map-size", default=256, type=int)
+    parser.add_argument("--n-cells", default=1000, type=int)
+    parser.add_argument("--n-steps", default=200, type=int)
+    parser.add_argument("--init-genome-size", default=500, type=int)
+    parser.add_argument("--init-molmap", default="randn", type=str)
+    parser.add_argument("--log-every", default=5, type=int)
+    parser.add_argument("--seed", default=42, type=int)
+    main(parser.parse_args())

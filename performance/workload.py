@@ -1,0 +1,69 @@
+"""
+The canonical benchmark workload step (reference
+`performance/run_simulation.py:61-100`): spawn top-up to the target
+population, enzymatic_activity, kill below 1.0 ATP, divide above 5.0 ATP
+(at a cost of 4.0 ATP), recombinate, mutate, degrade+diffuse+lifetimes.
+
+Shared by `bench.py` (headline metric) and
+`performance/run_simulation.py` (per-phase timing harness) so the two can
+never drift apart.
+"""
+from contextlib import nullcontext
+
+import numpy as np
+
+KILL_BELOW_ATP = 1.0
+DIVIDE_ABOVE_ATP = 5.0
+DIVIDE_COST_ATP = 4.0
+
+
+def _no_timer(label: str):
+    return nullcontext()
+
+
+def sim_step(world, rng, *, n_cells: int, genome_size: int, atp_idx: int, timeit=_no_timer) -> None:
+    """Advance the world by one canonical workload step.
+
+    ``timeit`` is an optional ``label -> context manager`` factory used by
+    the harness to time each phase; the default does nothing.
+    """
+    import magicsoup_tpu as ms
+
+    if world.n_cells < n_cells:
+        with timeit("addCells"):
+            genomes = [
+                ms.random_genome(s=genome_size, rng=rng)
+                for _ in range(n_cells - world.n_cells)
+            ]
+            world.spawn_cells(genomes=genomes)
+
+    with timeit("activity"):
+        world.enzymatic_activity()
+
+    with timeit("kill"):
+        cm = world.cell_molecules
+        kill = np.nonzero(cm[:, atp_idx] < KILL_BELOW_ATP)[0].tolist()
+        world.kill_cells(cell_idxs=kill)
+
+    with timeit("replicate"):
+        cm = world.cell_molecules
+        repl = np.nonzero(cm[:, atp_idx] > DIVIDE_ABOVE_ATP)[0]
+        if len(repl):
+            cm = cm.copy()
+            cm[repl, atp_idx] -= DIVIDE_COST_ATP
+            world.cell_molecules = cm
+            world.divide_cells(cell_idxs=repl.tolist())
+
+    with timeit("recombinateGenomes"):
+        world.recombinate_cells()
+
+    with timeit("mutateGenomes"):
+        world.mutate_cells()
+
+    with timeit("wrapUp"):
+        import jax
+
+        world.degrade_molecules()
+        world.diffuse_molecules()
+        world.increment_cell_lifetimes()
+        jax.block_until_ready((world._molecule_map, world._cell_molecules))
