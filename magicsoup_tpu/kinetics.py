@@ -24,6 +24,7 @@ TPU-first deltas:
 import math
 import random
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -356,6 +357,10 @@ class Kinetics:
         self.max_cells = 0
         self.max_proteins = 0
         self.max_doms = 1
+        # optional NamedSharding for the cell axis (set by a mesh-placed
+        # World); parameter tensors are then allocated sharded and every
+        # jitted update runs SPMD
+        self.cell_sharding = None
         self.params = self._alloc(0, 0)
 
     # ------------------------------------------------------------------ #
@@ -364,8 +369,15 @@ class Kinetics:
 
     def _alloc(self, c: int, p: int) -> CellParams:
         s = self.n_signals
-        f32 = lambda *shape: jnp.zeros(shape, dtype=jnp.float32)  # noqa: E731
-        i32 = lambda *shape: jnp.zeros(shape, dtype=jnp.int32)  # noqa: E731
+
+        def _zeros(*shape, dtype):
+            arr = jnp.zeros(shape, dtype=dtype)
+            if self.cell_sharding is not None:
+                arr = jax.device_put(arr, self.cell_sharding)
+            return arr
+
+        f32 = lambda *shape: _zeros(*shape, dtype=jnp.float32)  # noqa: E731
+        i32 = lambda *shape: _zeros(*shape, dtype=jnp.int32)  # noqa: E731
         return CellParams(
             Ke=f32(c, p),
             Kmf=f32(c, p),
@@ -509,6 +521,22 @@ class Kinetics:
     # ------------------------------------------------------------------ #
     # integration                                                        #
     # ------------------------------------------------------------------ #
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        # shardings are bound to live devices; restored instances are
+        # unsharded until a mesh-placed World re-sets cell_sharding
+        state["cell_sharding"] = None
+        state["params"] = CellParams(*(np.asarray(t) for t in self.params))
+        state["tables"] = TokenTables(*(np.asarray(t) for t in self.tables))
+        state["_abs_temp_arr"] = np.asarray(self._abs_temp_arr)
+        return state
+
+    def __setstate__(self, state: dict):
+        self.__dict__.update(state)
+        self.params = CellParams(*(jnp.asarray(t) for t in state["params"]))
+        self.tables = TokenTables(*(jnp.asarray(t) for t in state["tables"]))
+        self._abs_temp_arr = jnp.asarray(state["_abs_temp_arr"])
 
     def integrate_signals(self, X: jnp.ndarray) -> jnp.ndarray:
         """

@@ -40,12 +40,13 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 
 def map_sharding(mesh: Mesh) -> NamedSharding:
-    """molecule_map (mols, m, m) sharded by map rows"""
-    return NamedSharding(mesh, P(None, TILE_AXIS, None))
+    """molecule_map (mols, m, m) sharded by map rows (first mesh axis)"""
+    return NamedSharding(mesh, P(None, mesh.axis_names[0], None))
+
 
 def cell_sharding(mesh: Mesh) -> NamedSharding:
-    """cell-axis tensors sharded by cell slots"""
-    return NamedSharding(mesh, P(TILE_AXIS))
+    """cell-axis tensors sharded by cell slots (first mesh axis)"""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
 
 
 def shard_params(params: CellParams, mesh: Mesh) -> CellParams:
@@ -63,7 +64,8 @@ def halo_diffuse(
     ICI; the reference's mass-conservation fixup becomes a global psum.
     Matches :func:`magicsoup_tpu.ops.diffusion.diffuse` numerically.
     """
-    n_tiles = mesh.shape[TILE_AXIS]
+    axis = mesh.axis_names[0]
+    n_tiles = mesh.shape[axis]
     m = molecule_map.shape[1]
 
     if n_tiles == 1:
@@ -75,19 +77,19 @@ def halo_diffuse(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(None, TILE_AXIS, None), P(None, None)),
-        out_specs=P(None, TILE_AXIS, None),
+        in_specs=(P(None, axis, None), P(None, None)),
+        out_specs=P(None, axis, None),
     )
     def _step(local: jax.Array, kern: jax.Array) -> jax.Array:
         # local: (mols, m/n_tiles, m); kern arrives flattened (mols, 9)
         kern = kern.reshape(-1, 1, 3, 3)
         n_mols = local.shape[0]
-        total_before = jax.lax.psum(jnp.sum(local, axis=(1, 2)), TILE_AXIS)
+        total_before = jax.lax.psum(jnp.sum(local, axis=(1, 2)), axis)
 
         # my first row becomes the lower halo of the tile above, my last row
         # the upper halo of the tile below (torus-wrapped)
-        halo_for_above = jax.lax.ppermute(local[:, :1, :], TILE_AXIS, up)
-        halo_for_below = jax.lax.ppermute(local[:, -1:, :], TILE_AXIS, down)
+        halo_for_above = jax.lax.ppermute(local[:, :1, :], axis, up)
+        halo_for_below = jax.lax.ppermute(local[:, -1:, :], axis, down)
         rows = jnp.concatenate([halo_for_below, local, halo_for_above], axis=1)
         # columns are fully local: wrap-pad
         padded = jnp.pad(rows, ((0, 0), (0, 0), (1, 1)), mode="wrap")
@@ -101,7 +103,7 @@ def halo_diffuse(
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )[0]
 
-        total_after = jax.lax.psum(jnp.sum(out, axis=(1, 2)), TILE_AXIS)
+        total_after = jax.lax.psum(jnp.sum(out, axis=(1, 2)), axis)
         out = out + ((total_before - total_after) / (m * m))[:, None, None]
         return jnp.clip(out, min=0.0)
 

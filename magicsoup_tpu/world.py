@@ -208,6 +208,7 @@ class World:
         device: str | None = None,
         batch_size: int | None = None,
         seed: int | None = None,
+        mesh: "jax.sharding.Mesh | None" = None,
     ):
         if seed is None:
             seed = random.SystemRandom().randrange(2**63)
@@ -221,6 +222,27 @@ class World:
         self.abs_temp = abs_temp
         self.chemistry = chemistry
 
+        # multi-chip: place all device state sharded over the mesh (map by
+        # rows, cell-axis tensors by slots).  Every jitted step then runs
+        # SPMD — GSPMD inserts the collectives for the cell<->map signal
+        # exchange, and host bookkeeping stays global, so divide /
+        # recombination across tile boundaries need no special casing.
+        self._mesh = mesh
+        self._map_sharding = None
+        self._cell_sharding = None
+        if mesh is not None:
+            from magicsoup_tpu.parallel import tiled
+
+            # rows shard along the FIRST mesh axis only (tiled.map_sharding)
+            n_tiles = int(mesh.shape[mesh.axis_names[0]])
+            if map_size % n_tiles != 0:
+                raise ValueError(
+                    f"map_size={map_size} must be divisible by the first"
+                    f" mesh axis size {n_tiles} for row sharding"
+                )
+            self._map_sharding = tiled.map_sharding(mesh)
+            self._cell_sharding = tiled.cell_sharding(mesh)
+
         self.genetics = Genetics(
             start_codons=start_codons,
             stop_codons=stop_codons,
@@ -233,6 +255,7 @@ class World:
             vector_enc_size=max(self.genetics.two_codon_map.values()),
             seed=self._rng.randrange(2**63),
         )
+        self.kinetics.cell_sharding = self._cell_sharding
 
         mols = chemistry.molecules
         self.n_molecules = len(mols)
@@ -276,10 +299,20 @@ class World:
 
     @molecule_map.setter
     def molecule_map(self, value):
-        value = jnp.asarray(value, dtype=jnp.float32)
-        if value.shape != self._molecule_map.shape:
+        if tuple(value.shape) != self._molecule_map.shape:
             raise ValueError(f"molecule_map must have shape {self._molecule_map.shape}")
-        self._molecule_map = value
+        if isinstance(value, jax.Array):
+            # already on device: device_put reshards without a host trip
+            value = value.astype(jnp.float32)
+            self._molecule_map = (
+                jax.device_put(value, self._map_sharding)
+                if self._map_sharding is not None
+                else value
+            )
+        else:
+            self._molecule_map = self._place_map(
+                np.asarray(value, dtype=np.float32)
+            )
 
     def _host_molecule_map(self) -> np.ndarray:
         """Cached host snapshot of the molecule map.  Valid exactly while
@@ -324,7 +357,7 @@ class World:
         vals = np.zeros((self._capacity, self.n_molecules), dtype=np.float32)
         vals[: self.n_cells] = value
         self._cell_molecules = _set_prefix(
-            self._cell_molecules, jnp.asarray(vals), self._n_cells_dev()
+            self._cell_molecules, self._place_cells(vals), self._n_cells_dev()
         )
 
     @property
@@ -375,13 +408,24 @@ class World:
         )
         cm = np.zeros((cap, self.n_molecules), dtype=np.float32)
         cm[: self._capacity] = np.asarray(self._cell_molecules)
-        self._cell_molecules = jnp.asarray(cm)
+        self._cell_molecules = self._place_cells(cm)
         self._capacity = cap
         self._sync_positions()
         self.kinetics.ensure_capacity(n_cells=cap)
 
+    def _place_map(self, arr) -> jax.Array:
+        """Host array -> device, sharded over the mesh when one is set"""
+        if self._map_sharding is not None:
+            return jax.device_put(arr, self._map_sharding)
+        return jnp.asarray(arr)
+
+    def _place_cells(self, arr) -> jax.Array:
+        if self._cell_sharding is not None:
+            return jax.device_put(arr, self._cell_sharding)
+        return jnp.asarray(arr)
+
     def _sync_positions(self):
-        self._positions_dev = jnp.asarray(self._np_positions)
+        self._positions_dev = self._place_cells(self._np_positions)
 
     def _n_cells_dev(self) -> jax.Array:
         return jnp.asarray(self.n_cells, dtype=jnp.int32)
@@ -389,12 +433,12 @@ class World:
     def _init_molecule_map(self, init: str) -> jax.Array:
         shape = (self.n_molecules, self.map_size, self.map_size)
         if init == "zeros":
-            return jnp.zeros(shape, dtype=jnp.float32)
+            return self._place_map(np.zeros(shape, dtype=np.float32))
         if init == "randn":
             arr = np.abs(
                 self._nprng.standard_normal(shape, dtype=np.float32) + 10.0
             )
-            return jnp.asarray(arr)
+            return self._place_map(arr)
         raise ValueError(
             f"Didnt recognize mol_map_init={init}. Should be one of: 'zeros', 'randn'."
         )
@@ -874,6 +918,11 @@ class World:
         state.pop("_positions_dev")
         state["_mm_cache"] = None
         state["_cm_cache"] = None
+        # meshes/shardings are bound to live devices — a restored world is
+        # unsharded; pass mesh= again (or device_put) to re-place it
+        state["_mesh"] = None
+        state["_map_sharding"] = None
+        state["_cell_sharding"] = None
         return state
 
     def __setstate__(self, state: dict):
@@ -946,7 +995,7 @@ class World:
 
         cm = np.load(statedir / "cell_molecules.npy")
         self._np_cell_map = np.load(statedir / "cell_map.npy")
-        self._molecule_map = jnp.asarray(np.load(statedir / "molecule_map.npy"))
+        self._molecule_map = self._place_map(np.load(statedir / "molecule_map.npy"))
         lifetimes = np.load(statedir / "cell_lifetimes.npy")
         positions = np.load(statedir / "cell_positions.npy")
         divisions = np.load(statedir / "cell_divisions.npy")
@@ -980,7 +1029,7 @@ class World:
         self._sync_positions()
         full_cm = np.zeros((self._capacity, self.n_molecules), dtype=np.float32)
         full_cm[:n] = cm
-        self._cell_molecules = jnp.asarray(full_cm)
+        self._cell_molecules = self._place_cells(full_cm)
 
         if not ignore_cell_params:
             self.update_cells(genome_idx_pairs=genome_idx_pairs)

@@ -105,3 +105,81 @@ def test_sharded_step_conserves_molecules():
     assert np.isfinite(out).all() and (out >= 0).all()
     assert np.isfinite(np.asarray(cm)).all()
     assert after == pytest.approx(before, rel=0.5)  # sanity bound
+
+
+def test_mesh_placed_world_full_lifecycle_matches_unsharded():
+    # World(mesh=...) places all device state sharded; the full lifecycle
+    # (spawn/kill/divide/mutate/recombinate + physics) must behave exactly
+    # like the unsharded world up to sharded-reduction float drift
+    def run(mesh):
+        world = ms.World(chemistry=CHEMISTRY, map_size=64, seed=9, mesh=mesh)
+        rng = random.Random(1)
+        world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(64)])
+        for _ in range(5):
+            world.enzymatic_activity()
+            cm = world.cell_molecules
+            world.kill_cells(np.nonzero(cm[:, 2] < 0.2)[0].tolist())
+            cm = world.cell_molecules
+            world.divide_cells(np.nonzero(cm[:, 2] > 4.0)[0].tolist())
+            world.mutate_cells(p=1e-4)
+            world.recombinate_cells(p=1e-6)
+            world.degrade_molecules()
+            world.diffuse_molecules()
+            world.increment_cell_lifetimes()
+        return world
+
+    ws = run(tiled.make_mesh(8))
+    # state stayed sharded through every update
+    assert "tile" in str(ws._molecule_map.sharding)
+    assert "tile" in str(ws.kinetics.params.Vmax.sharding)
+
+    wu = run(None)
+    assert ws.n_cells == wu.n_cells
+    assert ws.cell_genomes == wu.cell_genomes
+    np.testing.assert_array_equal(ws.cell_positions, wu.cell_positions)
+    # sharded reductions reorder float sums; drift accumulates over the 5
+    # steps and amplifies near zero, hence the absolute tolerance
+    np.testing.assert_allclose(
+        ws._host_molecule_map(), wu._host_molecule_map(), rtol=1e-4, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        ws.cell_molecules, wu.cell_molecules, rtol=1e-4, atol=1e-3
+    )
+
+
+def test_mesh_placed_world_validates_map_divisibility():
+    mesh = tiled.make_mesh(8)
+    with pytest.raises(ValueError, match="divisible"):
+        ms.World(chemistry=CHEMISTRY, map_size=30, seed=1, mesh=mesh)
+
+
+def test_mesh_placed_world_load_state_keeps_sharding(tmp_path):
+    mesh = tiled.make_mesh(8)
+    world = ms.World(chemistry=CHEMISTRY, map_size=64, seed=41, mesh=mesh)
+    rng = random.Random(41)
+    world.spawn_cells([random_genome(s=300, rng=rng) for _ in range(16)])
+    world.save_state(statedir=tmp_path / "s0")
+    world.load_state(statedir=tmp_path / "s0")
+    assert "tile" in str(world._molecule_map.sharding)
+    assert "tile" in str(world._cell_molecules.sharding)
+    assert world.n_cells == 16
+
+
+def test_custom_axis_name_mesh_works():
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(_jax.devices()[:8]), ("rows",))
+    world = ms.World(chemistry=CHEMISTRY, map_size=32, seed=43, mesh=mesh)
+    rng = random.Random(43)
+    world.spawn_cells([random_genome(s=300, rng=rng) for _ in range(8)])
+    world.enzymatic_activity()
+    world.diffuse_molecules()
+    assert "rows" in str(world._molecule_map.sharding)
+    # the explicit sharded step also honors the custom axis
+    mm, cm, pos, params = tiled.shard_world_state(world, mesh)
+    step = tiled.make_sharded_step(
+        mesh, world._diff_kernels, world._perm_factors, world._degrad_factors
+    )
+    out_mm, out_cm = step(mm, cm, pos, jnp.asarray(world.n_cells), params)
+    assert np.isfinite(np.asarray(out_mm)).all()

@@ -1,0 +1,93 @@
+"""
+Conservation-law tests over long horizons (the reference's de-facto
+integration suite, tests/slow/test_world.py:7-88): molecule totals
+conserved under diffusion; weighted totals conserved under reactions;
+bounded concentrations with the full physics loop.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.examples.wood_ljungdahl import MOLECULES, REACTIONS
+from magicsoup_tpu.util import random_genome
+
+
+def test_molecule_amount_integrity_during_diffusion():
+    chemistry = ms.Chemistry(molecules=MOLECULES, reactions=[])
+    world = ms.World(chemistry=chemistry, map_size=128, seed=5)
+
+    exp = np.asarray(world.molecule_map).sum(axis=(1, 2))
+    for step_i in range(100):
+        world.diffuse_molecules()
+        res = np.asarray(world.molecule_map).sum(axis=(1, 2))
+        assert abs(res.sum() - exp.sum()) < 10.0, step_i
+        assert np.all(np.abs(res - exp) < 1.0), step_i
+
+
+def test_molecule_amount_integrity_during_reactions():
+    # mx and my react back and forth, mx + my <-> mz; counting mz as 2
+    # molecules the weighted total must stay constant
+    mx = ms.Molecule("cons-mx", 10 * 1e3)
+    my = ms.Molecule("cons-my", 20 * 1e3)
+    mz = ms.Molecule("cons-mz", 30 * 1e3)
+    chemistry = ms.Chemistry(
+        molecules=[mx, my, mz], reactions=[([mx], [my]), ([mx, my], [mz])]
+    )
+    world = ms.World(chemistry=chemistry, map_size=64, seed=6)
+    rng = random.Random(6)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(300)])
+
+    def count() -> float:
+        mm = np.asarray(world.molecule_map)
+        cm = world.cell_molecules
+        total = mm[[0, 1]].sum() + 2 * mm[2].sum()
+        total += cm[:, [0, 1]].sum() + 2 * cm[:, 2].sum()
+        return float(total)
+
+    n0 = count()
+    for step_i in range(100):
+        world.enzymatic_activity()
+        assert count() == pytest.approx(n0, abs=1.0), step_i
+
+
+def test_run_world_without_reactions():
+    chemistry = ms.Chemistry(molecules=MOLECULES[:2], reactions=[])
+    world = ms.World(chemistry=chemistry, seed=7)
+    rng = random.Random(7)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(300)])
+    for _ in range(100):
+        world.enzymatic_activity()
+    cm = world.cell_molecules
+    assert np.isfinite(cm).all()
+
+
+def test_no_exploding_molecules_full_physics():
+    # an unfair velocity adjustment (e.g. clamping only one side) lets
+    # cells create molecules from nothing; bounds catch that
+    chemistry = ms.Chemistry(molecules=MOLECULES, reactions=REACTIONS)
+    world = ms.World(chemistry=chemistry, map_size=128, seed=8)
+    rng = random.Random(8)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(1000)])
+
+    for i in range(100):
+        world.degrade_molecules()
+        world.diffuse_molecules()
+        world.enzymatic_activity()
+
+        mm = np.asarray(world.molecule_map)
+        cm = world.cell_molecules
+        assert mm.min() >= 0.0, i
+        assert 0.0 < mm.mean() < 50.0, i
+        assert mm.max() < 500.0, i
+        assert cm.min() >= 0.0, i
+        assert 0.0 < cm.mean() < 50.0, i
+        assert cm.max() < 500.0, i
+
+    assert np.asarray(world.molecule_map).dtype == np.float32
+    assert world.cell_molecules.dtype == np.float32
+    assert world.cell_divisions.dtype == np.int32
+    assert world.cell_positions.dtype == np.int32
+    assert world.cell_lifetimes.dtype == np.int32
+    assert world.cell_map.dtype == bool

@@ -1,0 +1,78 @@
+"""
+Genome-generation consistency (reference tests/slow/test_factories.py:5-113):
+factory-generated genomes, spawned into a world, translate back into the
+requested proteome with parameter values near the requested ones.
+Inherently flaky (the reverse complement can encode extra proteins), so
+failures are tolerated with Retry.
+"""
+import numpy as np
+
+import magicsoup_tpu as ms
+from tests.conftest import Retry
+
+_N_TRIES = 6
+_KM_TOL = 5.0
+_VMAX_TOL = 1.0
+
+
+def _chemistry():
+    mi = ms.Molecule("factory-mi", 10 * 1e3)
+    mj = ms.Molecule("factory-mj", 10 * 1e3)
+    mk = ms.Molecule("factory-mk", 10 * 1e3)
+    return ms.Chemistry(
+        molecules=[mi, mj, mk], reactions=[([mi], [mj]), ([mi, mj], [mk])]
+    )
+
+
+def test_transporter_genome_generation_consistency():
+    chemistry = _chemistry()
+    mi = chemistry.molecules[0]
+    world = ms.World(chemistry=chemistry, seed=31)
+    retry = Retry(n_allowed_fails=3)
+
+    dt = ms.TransporterDomainFact(molecule=mi, is_exporter=False, km=1.0, vmax=1.0)
+    ggen = ms.GenomeFact(world=world, proteome=[[dt]])
+    for i in range(_N_TRIES):
+        with retry:
+            idxs = world.spawn_cells(genomes=[ggen.generate()])
+            assert len(idxs) == 1
+            ci = idxs[0]
+            cell = world.get_cell(by_idx=ci)
+            assert len(cell.proteome) == 1, cell.proteome
+            (d0,) = cell.proteome[0].domains
+            assert isinstance(d0, ms.TransporterDomain)
+            assert d0.molecule is mi
+            assert abs(d0.vmax - 1.0) < _VMAX_TOL
+            assert abs(d0.km - 1.0) < _KM_TOL
+            assert not d0.is_exporter
+
+            N = np.asarray(world.kinetics.params.N)
+            # importer: +1 intracellular, -1 extracellular for molecule 0
+            assert N[ci][0][0] == 1, N[ci]
+            assert N[ci][0][3] == -1, N[ci]
+            assert abs(np.asarray(world.kinetics.params.Vmax)[ci][0] - 1.0) < _VMAX_TOL
+            assert abs(np.asarray(world.kinetics.params.Kmf)[ci][0] - 1.0) < _KM_TOL
+            world.kill_cells(cell_idxs=list(range(world.n_cells)))
+
+
+def test_catalytic_genome_generation_consistency():
+    chemistry = _chemistry()
+    mi, mj, _ = chemistry.molecules
+    world = ms.World(chemistry=chemistry, seed=37)
+    retry = Retry(n_allowed_fails=3)
+
+    dc = ms.CatalyticDomainFact(reaction=([mj], [mi]), km=1.0, vmax=1.0)
+    ggen = ms.GenomeFact(world=world, proteome=[[dc]])
+    for i in range(_N_TRIES):
+        with retry:
+            idxs = world.spawn_cells(genomes=[ggen.generate()])
+            assert len(idxs) == 1
+            ci = idxs[0]
+            cell = world.get_cell(by_idx=ci)
+            assert len(cell.proteome) == 1, cell.proteome
+            (d0,) = cell.proteome[0].domains
+            assert isinstance(d0, ms.CatalyticDomain)
+            assert d0.substrates == [mj] and d0.products == [mi]
+            assert abs(d0.vmax - 1.0) < _VMAX_TOL
+            assert abs(d0.km - 1.0) < _KM_TOL
+            world.kill_cells(cell_idxs=list(range(world.n_cells)))

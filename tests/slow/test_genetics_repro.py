@@ -1,0 +1,40 @@
+"""
+Translation reproducibility (reference tests/slow/test_genetics.py:4-12):
+the same genome must translate to the identical proteome every time, in
+batches or alone, through both the native and the Python engine.
+"""
+import random
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.util import random_genome
+
+
+def test_genomes_are_always_translated_reproducibly():
+    genetics = ms.Genetics(seed=11)
+    rng = random.Random(11)
+    for i in range(100):
+        g = random_genome(s=500, rng=rng)
+        original, *_ = genetics.translate_genomes(genomes=[g])
+        proteomes = genetics.translate_genomes(genomes=[g] * 100)
+        for proteome in proteomes:
+            assert proteome == original, i
+
+
+def test_native_and_python_engine_translate_identically():
+    import os
+
+    from magicsoup_tpu.native import engine
+
+    genetics = ms.Genetics(seed=12)
+    rng = random.Random(12)
+    genomes = [random_genome(s=1000, rng=rng) for _ in range(200)]
+    native = genetics.translate_genomes(genomes=genomes)
+
+    os.environ["MAGICSOUP_TPU_NO_NATIVE"] = "1"
+    engine._LIB_TRIED = False
+    try:
+        python = genetics.translate_genomes(genomes=genomes)
+    finally:
+        del os.environ["MAGICSOUP_TPU_NO_NATIVE"]
+        engine._LIB_TRIED = False
+    assert native == python
