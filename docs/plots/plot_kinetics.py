@@ -1,0 +1,100 @@
+"""
+Sanity figures for the kinetics integrator (the reference's figure-based
+check strategy, DEV_README.md:34-41): velocity of a single catalytic
+protein against substrate concentration vs. the analytic reversible-MM
+curve, and approach to equilibrium over steps.
+
+    python docs/plots/plot_kinetics.py   # writes docs/img/kinetics.png
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+from magicsoup_tpu.kinetics import Kinetics
+from magicsoup_tpu.containers import Chemistry, Molecule
+from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+
+def _single_protein_params(
+    n_signals: int, ke: float, kmf: float, vmax: float
+) -> CellParams:
+    """One cell, one protein: S (signal 0) -> P (signal 1)"""
+    f = lambda v: np.full((1, 1), v, dtype=np.float32)  # noqa: E731
+    N = np.zeros((1, 1, n_signals), dtype=np.int32)
+    N[0, 0, 0] = -1
+    N[0, 0, 1] = 1
+    Nf = np.where(N < 0, -N, 0).astype(np.int32)
+    Nb = np.where(N > 0, N, 0).astype(np.int32)
+    return CellParams(
+        Ke=f(ke),
+        Kmf=f(kmf),
+        Kmb=f(kmf * ke),
+        Kmr=np.zeros((1, 1, n_signals), np.float32),
+        Vmax=f(vmax),
+        N=N,
+        Nf=Nf,
+        Nb=Nb,
+        A=np.zeros((1, 1, n_signals), np.int32),
+    )
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    mols = [Molecule("figS", 10e3), Molecule("figP", 5e3)]
+    chem = Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    _ = Kinetics(chemistry=chem, scalar_enc_size=61, vector_enc_size=3904, seed=1)
+
+    n_signals = 4
+    ke, km, vmax = 4.0, 1.0, 1.0
+    params = CellParams(*(np.asarray(t) for t in _single_protein_params(n_signals, ke, km, vmax)))
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+
+    # one-step velocity vs [S] (single un-trimmed pass equivalent: measure
+    # the realized dx of signal 1 after one integrate_signals call)
+    s_range = np.linspace(0.01, 10, 60)
+    dxs = []
+    for s in s_range:
+        X = np.zeros((1, n_signals), dtype=np.float32)
+        X[0, 0] = s
+        X1 = np.asarray(integrate_signals(X, params))
+        dxs.append(float(X1[0, 1]))
+    ax1.plot(s_range, dxs, label="integrator, 1 step")
+    analytic = vmax * (s_range / km) / (1 + s_range / km)
+    ax1.plot(s_range, analytic, "--", label="analytic MM (no product)")
+    ax1.set_xlabel("[S] (mM)")
+    ax1.set_ylabel("product formed in 1 step (mM)")
+    ax1.legend()
+    ax1.set_title("velocity vs substrate")
+
+    # approach to equilibrium: Q -> Ke
+    X = np.zeros((1, n_signals), dtype=np.float32)
+    X[0, 0] = 5.0
+    qs = []
+    for _ in range(60):
+        X = np.asarray(integrate_signals(X, params))
+        qs.append(float(X[0, 1] / max(X[0, 0], 1e-9)))
+    ax2.plot(qs, label="Q = [P]/[S]")
+    ax2.axhline(ke, ls="--", c="k", label=f"Ke = {ke}")
+    ax2.set_xlabel("step")
+    ax2.set_ylabel("reaction quotient")
+    ax2.legend()
+    ax2.set_title("approach to equilibrium")
+
+    fig.tight_layout()
+    fig.savefig(OUT / "kinetics.png", dpi=120)
+    print(f"wrote {OUT / 'kinetics.png'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""
+Sanity figures for world physics: diffusion spread of a point source,
+degradation half-life, and proteins-per-genome-size statistics.
+
+    python docs/plots/plot_world.py   # writes docs/img/world.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.containers import Chemistry, Molecule
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+
+    # diffusion of a point source
+    mol = Molecule("figD", 10e3, diffusivity=1.0, half_life=100)
+    chem = Chemistry(molecules=[mol], reactions=[])
+    world = ms.World(chemistry=chem, map_size=64, mol_map_init="zeros", seed=1)
+    mm = np.zeros((1, 64, 64), dtype=np.float32)
+    mm[0, 32, 32] = 100.0
+    world.molecule_map = mm
+    for _ in range(30):
+        world.diffuse_molecules()
+    axes[0].imshow(np.asarray(world.molecule_map)[0])
+    axes[0].set_title("point source after 30 diffusion steps")
+
+    # degradation half-life
+    world.molecule_map = np.full((1, 64, 64), 10.0, dtype=np.float32)
+    means = []
+    for _ in range(300):
+        world.degrade_molecules()
+        means.append(float(np.asarray(world.molecule_map).mean()))
+    axes[1].plot(means, label="mean concentration")
+    axes[1].axvline(100, ls="--", c="k", label="half_life=100")
+    axes[1].axhline(5.0, ls=":", c="gray")
+    axes[1].set_xlabel("step")
+    axes[1].legend()
+    axes[1].set_title("degradation")
+
+    # proteome statistics vs genome size
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+
+    world = ms.World(chemistry=CHEMISTRY, map_size=128, seed=2)
+    rng = random.Random(2)
+    sizes = [200, 500, 1000, 2000]
+    counts = []
+    for s in sizes:
+        genomes = [random_genome(s=s, rng=rng) for _ in range(200)]
+        proteomes = world.genetics.translate_genomes(genomes=genomes)
+        counts.append([len(p) for p in proteomes])
+    axes[2].boxplot(counts, tick_labels=[str(s) for s in sizes])
+    axes[2].set_xlabel("genome size (nt)")
+    axes[2].set_ylabel("proteins per genome")
+    axes[2].set_title("coding density")
+
+    fig.tight_layout()
+    fig.savefig(OUT / "world.png", dpi=120)
+    print(f"wrote {OUT / 'world.png'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -371,10 +371,11 @@ class Kinetics:
         s = self.n_signals
 
         def _zeros(*shape, dtype):
-            arr = jnp.zeros(shape, dtype=dtype)
             if self.cell_sharding is not None:
-                arr = jax.device_put(arr, self.cell_sharding)
-            return arr
+                # allocate sharded directly — materializing unsharded first
+                # would peak device-0 HBM at the full unsharded size
+                return jnp.zeros(shape, dtype=dtype, device=self.cell_sharding)
+            return jnp.zeros(shape, dtype=dtype)
 
         f32 = lambda *shape: _zeros(*shape, dtype=jnp.float32)  # noqa: E731
         i32 = lambda *shape: _zeros(*shape, dtype=jnp.int32)  # noqa: E731

@@ -299,6 +299,8 @@ class World:
 
     @molecule_map.setter
     def molecule_map(self, value):
+        if not isinstance(value, jax.Array):
+            value = np.asarray(value, dtype=np.float32)
         if tuple(value.shape) != self._molecule_map.shape:
             raise ValueError(f"molecule_map must have shape {self._molecule_map.shape}")
         if isinstance(value, jax.Array):
@@ -310,9 +312,7 @@ class World:
                 else value
             )
         else:
-            self._molecule_map = self._place_map(
-                np.asarray(value, dtype=np.float32)
-            )
+            self._molecule_map = self._place_map(value)
 
     def _host_molecule_map(self) -> np.ndarray:
         """Cached host snapshot of the molecule map.  Valid exactly while
@@ -511,8 +511,10 @@ class World:
         b = cand[valid]
         lo = np.minimum(a, b)
         hi = np.maximum(a, b)
-        pairs = np.unique(np.stack([lo, hi], axis=1), axis=0)
-        return [tuple(d) for d in pairs.tolist()]
+        # 1D-encoded unique (np.unique(axis=0) goes through a slow
+        # void-dtype view; this is ~100x faster at 10k cells)
+        enc = np.unique(lo * np.int64(self.n_cells) + hi)
+        return [(int(e // self.n_cells), int(e % self.n_cells)) for e in enc.tolist()]
 
     # ------------------------------------------------------------------ #
     # cell lifecycle                                                     #

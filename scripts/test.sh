@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Run the test suite: `bash scripts/test.sh` (fast tier) or
+# `bash scripts/test.sh tests/` (everything, incl. slow invariants).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+TARGET="${1:-tests/fast}"
+python -m pytest "$TARGET" -q

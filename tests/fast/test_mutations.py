@@ -111,12 +111,16 @@ def test_native_mutation_rates_match_python_statistically():
     native = engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5)
     import os
 
+    prior = os.environ.get("MAGICSOUP_TPU_NO_NATIVE")
     os.environ["MAGICSOUP_TPU_NO_NATIVE"] = "1"
     engine._LIB_TRIED = False
     try:
         py = engine.point_mutations(seqs, 2e-3, 0.4, 0.66, seed=5)
     finally:
-        del os.environ["MAGICSOUP_TPU_NO_NATIVE"]
+        if prior is None:
+            os.environ.pop("MAGICSOUP_TPU_NO_NATIVE", None)
+        else:
+            os.environ["MAGICSOUP_TPU_NO_NATIVE"] = prior
         engine._LIB_TRIED = False
     assert [i for _, i in native] == [i for _, i in py]
     for (sn, _), (sp, _) in zip(native, py):

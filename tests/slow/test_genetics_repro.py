@@ -30,11 +30,15 @@ def test_native_and_python_engine_translate_identically():
     genomes = [random_genome(s=1000, rng=rng) for _ in range(200)]
     native = genetics.translate_genomes(genomes=genomes)
 
+    prior = os.environ.get("MAGICSOUP_TPU_NO_NATIVE")
     os.environ["MAGICSOUP_TPU_NO_NATIVE"] = "1"
     engine._LIB_TRIED = False
     try:
         python = genetics.translate_genomes(genomes=genomes)
     finally:
-        del os.environ["MAGICSOUP_TPU_NO_NATIVE"]
+        if prior is None:
+            os.environ.pop("MAGICSOUP_TPU_NO_NATIVE", None)
+        else:
+            os.environ["MAGICSOUP_TPU_NO_NATIVE"] = prior
         engine._LIB_TRIED = False
     assert native == python
