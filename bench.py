@@ -52,20 +52,25 @@ def main() -> None:
     )
     atp = CHEMISTRY.molname_2_idx["ATP"]
 
-    def step() -> None:
+    def step(sync: bool) -> None:
         sim_step(
             world,
             rng,
             n_cells=args.n_cells,
             genome_size=args.genome_size,
             atp_idx=atp,
+            sync=sync,
         )
 
     for _ in range(args.warmup):
-        step()
+        step(sync=True)
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        step()
+        # async steps: each step's selection fetch syncs the prior one
+        step(sync=False)
+    import jax
+
+    jax.block_until_ready((world._molecule_map, world._cell_molecules))
     dt = (time.perf_counter() - t0) / args.steps
 
     steps_per_s = 1.0 / dt

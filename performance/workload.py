@@ -21,11 +21,24 @@ def _no_timer(label: str):
     return nullcontext()
 
 
-def sim_step(world, rng, *, n_cells: int, genome_size: int, atp_idx: int, timeit=_no_timer) -> None:
+def sim_step(
+    world,
+    rng,
+    *,
+    n_cells: int,
+    genome_size: int,
+    atp_idx: int,
+    timeit=_no_timer,
+    sync: bool = True,
+) -> None:
     """Advance the world by one canonical workload step.
 
     ``timeit`` is an optional ``label -> context manager`` factory used by
-    the harness to time each phase; the default does nothing.
+    the harness to time each phase; the default does nothing.  With
+    ``sync=False`` the final device barrier is skipped — the next step's
+    selection fetch synchronizes anyway, saving one round trip per step on
+    remote accelerators (use for throughput loops; keep ``sync=True`` when
+    per-phase times matter).
     """
     import magicsoup_tpu as ms
 
@@ -40,18 +53,23 @@ def sim_step(world, rng, *, n_cells: int, genome_size: int, atp_idx: int, timeit
     with timeit("activity"):
         world.enzymatic_activity()
 
+    # ONE device fetch drives both selections: killing only compacts rows
+    # (it does not change survivors' contents), so the post-kill state is
+    # host-computable from the pre-kill snapshot — on a remote accelerator
+    # every fetch costs a round trip
     with timeit("kill"):
         cm = world.cell_molecules
-        kill = np.nonzero(cm[:, atp_idx] < KILL_BELOW_ATP)[0].tolist()
-        world.kill_cells(cell_idxs=kill)
+        atp = cm[:, atp_idx]
+        kill_mask = atp < KILL_BELOW_ATP
+        world.kill_cells(cell_idxs=np.nonzero(kill_mask)[0].tolist())
 
     with timeit("replicate"):
-        cm = world.cell_molecules
-        repl = np.nonzero(cm[:, atp_idx] > DIVIDE_ABOVE_ATP)[0]
+        keep = ~kill_mask
+        cm_after = cm[keep]  # advanced indexing: already a fresh array
+        repl = np.nonzero(cm_after[:, atp_idx] > DIVIDE_ABOVE_ATP)[0]
         if len(repl):
-            cm = cm.copy()
-            cm[repl, atp_idx] -= DIVIDE_COST_ATP
-            world.cell_molecules = cm
+            cm_after[repl, atp_idx] -= DIVIDE_COST_ATP
+            world.cell_molecules = cm_after
             world.divide_cells(cell_idxs=repl.tolist())
 
     with timeit("recombinateGenomes"):
@@ -61,9 +79,10 @@ def sim_step(world, rng, *, n_cells: int, genome_size: int, atp_idx: int, timeit
         world.mutate_cells()
 
     with timeit("wrapUp"):
-        import jax
-
         world.degrade_molecules()
         world.diffuse_molecules()
         world.increment_cell_lifetimes()
-        jax.block_until_ready((world._molecule_map, world._cell_molecules))
+        if sync:
+            import jax
+
+            jax.block_until_ready((world._molecule_map, world._cell_molecules))
