@@ -36,6 +36,11 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--steps", type=int, default=15)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--pallas",
+        action="store_true",
+        help="use the VMEM-tiled Pallas integrator kernel",
+    )
     args = ap.parse_args()
 
     import magicsoup_tpu as ms
@@ -46,7 +51,12 @@ def main() -> None:
     from workload import sim_step
 
     rng = random.Random(args.seed)
-    world = ms.World(chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed)
+    world = ms.World(
+        chemistry=CHEMISTRY,
+        map_size=args.map_size,
+        seed=args.seed,
+        use_pallas=args.pallas,
+    )
     world.spawn_cells(
         [random_genome(s=args.genome_size, rng=rng) for _ in range(args.n_cells)]
     )

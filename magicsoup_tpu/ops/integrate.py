@@ -184,6 +184,13 @@ def integrate_signals(X: jax.Array, params: CellParams) -> jax.Array:
     """
     Simulate protein work for one time step over signals ``X`` (c, s).
     Returns the updated signals; all inputs must be >= 0.
+
+    This is the pure-XLA implementation (exact reference parity including
+    the batch-global equilibrium early-stop).  The VMEM-tiled Pallas
+    variant lives in :mod:`magicsoup_tpu.ops.pallas_integrate` and is
+    selected per :class:`World` via ``use_pallas`` — never implicitly, so
+    sharded steps (where ``pallas_call`` has no partitioning rule) always
+    use this path.
     """
     for trim in TRIM_FACTORS:
         X = _integrate_part(X, jnp.clip(params.Vmax * trim, min=0.0), params)

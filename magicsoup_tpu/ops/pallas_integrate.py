@@ -1,0 +1,131 @@
+"""
+Pallas TPU kernel for the reversible-MM signal integrator.
+
+The jitted XLA integrator (:mod:`magicsoup_tpu.ops.integrate`) re-reads the
+five (cells, proteins, signals) parameter tensors from HBM for every one of
+the ~30 signal-product reductions in a step (3 trim passes x (velocities +
+4 equilibrium-correction iterations)).  This kernel tiles the cell axis and
+keeps one tile's parameters resident in VMEM for the WHOLE step, so HBM
+traffic drops from ~30x to ~1x the parameter bytes — the classic
+memory-bound fusion case from the Pallas playbook
+(`/opt/skills/guides/pallas_guide.md`, Memory Hierarchy).
+
+Math parity is by construction: the kernel body loads the tile into values
+and calls the exact same `_integrate_part` used by the XLA path.  One
+deliberate semantic delta: the equilibrium correction's early-stop flag
+(reference kinetics.py:846-847, a GLOBAL `torch.any` over the whole batch —
+i.e. in the reference a cell's result depends on which other cells are in
+the batch) is evaluated per cell TILE here, decoupling cells in different
+tiles.  That is strictly closer to the per-cell ideal the heuristic
+approximates; the XLA path keeps the batch-global flag for exact reference
+parity.
+
+Enable with ``MAGICSOUP_TPU_PALLAS=1`` (or call
+:func:`integrate_signals_pallas` directly).  Off by default until
+benchmarked on hardware; `interpret=True` runs the kernel on CPU for
+tests.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from magicsoup_tpu.ops.integrate import TRIM_FACTORS, CellParams, _integrate_part
+
+
+def _kernel(
+    x_ref,
+    ke_ref,
+    kmf_ref,
+    kmb_ref,
+    kmr_ref,
+    vmax_ref,
+    n_ref,
+    nf_ref,
+    nb_ref,
+    a_ref,
+    out_ref,
+):
+    params = CellParams(
+        Ke=ke_ref[:],
+        Kmf=kmf_ref[:],
+        Kmb=kmb_ref[:],
+        Kmr=kmr_ref[:],
+        Vmax=vmax_ref[:],
+        N=n_ref[:],
+        Nf=nf_ref[:],
+        Nb=nb_ref[:],
+        A=a_ref[:],
+    )
+    X = x_ref[:]
+    for trim in TRIM_FACTORS:
+        X = _integrate_part(X, jnp.clip(params.Vmax * trim, min=0.0), params)
+    out_ref[:] = X
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_c", "interpret")
+)
+def integrate_signals_pallas(
+    X: jax.Array,
+    params: CellParams,
+    *,
+    tile_c: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """
+    Pallas-tiled equivalent of
+    :func:`magicsoup_tpu.ops.integrate.integrate_signals`.
+
+    ``tile_c`` is the number of cells per grid step (must divide the cell
+    capacity; defaults to 128 or the whole batch if smaller).  VMEM per
+    tile is ~tile_c * proteins * signals * 4 B * ~10 live tensors — with
+    the default 128 cells, 64 proteins, 12 signals that is ~4 MB.
+    """
+    c, s = X.shape
+    if tile_c is None:
+        # largest power-of-two tile <= 128 that divides c (any batch size
+        # works; capacity pools are pow2 so they get the full 128)
+        tile_c = math.gcd(c, 128)
+    if c % tile_c != 0:
+        raise ValueError(f"cell count {c} not divisible by tile_c={tile_c}")
+    p = params.Ke.shape[1]
+
+    cp = lambda i: (i, 0)  # noqa: E731
+    cps = lambda i: (i, 0, 0)  # noqa: E731
+    bs_cs = pl.BlockSpec((tile_c, s), cp)
+    bs_cp = pl.BlockSpec((tile_c, p), cp)
+    bs_cps = pl.BlockSpec((tile_c, p, s), cps)
+
+    return pl.pallas_call(
+        _kernel,
+        grid=(c // tile_c,),
+        in_specs=[
+            bs_cs,  # X
+            bs_cp,  # Ke
+            bs_cp,  # Kmf
+            bs_cp,  # Kmb
+            bs_cps,  # Kmr
+            bs_cp,  # Vmax
+            bs_cps,  # N
+            bs_cps,  # Nf
+            bs_cps,  # Nb
+            bs_cps,  # A
+        ],
+        out_specs=bs_cs,
+        out_shape=jax.ShapeDtypeStruct((c, s), X.dtype),
+        interpret=interpret,
+    )(
+        X,
+        params.Ke,
+        params.Kmf,
+        params.Kmb,
+        params.Kmr,
+        params.Vmax,
+        params.N,
+        params.Nf,
+        params.Nb,
+        params.A,
+    )

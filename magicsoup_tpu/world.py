@@ -48,27 +48,37 @@ _MIN_CAPACITY = 64
 # --------------------------------------------------------------------- #
 
 
-@jax.jit
-def _enzymatic_activity(
-    molecule_map: jax.Array,  # (mols, m, m)
-    cell_molecules: jax.Array,  # (cap, mols)
-    positions: jax.Array,  # (cap, 2) int32; dead slots at (0, 0)
-    n_cells: jax.Array,  # scalar int
-    params,  # CellParams
-) -> tuple[jax.Array, jax.Array]:
-    """Gather signals, run the MM integrator, scatter back deltas
-    (reference world.py:610-625)."""
-    cap = cell_molecules.shape[0]
-    alive = (jnp.arange(cap) < n_cells)[:, None]  # (cap, 1)
-    xs, ys = positions[:, 0], positions[:, 1]
-    ext = molecule_map[:, xs, ys].T  # (cap, mols)
-    X0 = jnp.concatenate([cell_molecules, ext], axis=1)
-    X1 = integrate_signals(X0, params)
-    n_mols = cell_molecules.shape[1]
-    new_cm = jnp.where(alive, X1[:, :n_mols], cell_molecules)
-    delta_ext = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
-    new_map = molecule_map.at[:, xs, ys].add(delta_ext.T)
-    return new_map, new_cm
+def _make_enzymatic_activity(integrator):
+    """Build the jitted activity step around a signal integrator
+    (the XLA one, or the Pallas kernel in interpret/compiled mode)."""
+
+    @jax.jit
+    def _enzymatic_activity(
+        molecule_map: jax.Array,  # (mols, m, m)
+        cell_molecules: jax.Array,  # (cap, mols)
+        positions: jax.Array,  # (cap, 2) int32; dead slots at (0, 0)
+        n_cells: jax.Array,  # scalar int
+        params,  # CellParams
+    ) -> tuple[jax.Array, jax.Array]:
+        """Gather signals, run the MM integrator, scatter back deltas
+        (reference world.py:610-625)."""
+        cap = cell_molecules.shape[0]
+        alive = (jnp.arange(cap) < n_cells)[:, None]  # (cap, 1)
+        xs, ys = positions[:, 0], positions[:, 1]
+        ext = molecule_map[:, xs, ys].T  # (cap, mols)
+        X0 = jnp.concatenate([cell_molecules, ext], axis=1)
+        X1 = integrator(X0, params)
+        n_mols = cell_molecules.shape[1]
+        new_cm = jnp.where(alive, X1[:, :n_mols], cell_molecules)
+        delta_ext = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
+        new_map = molecule_map.at[:, xs, ys].add(delta_ext.T)
+        return new_map, new_cm
+
+    return _enzymatic_activity
+
+
+_enzymatic_activity = _make_enzymatic_activity(integrate_signals)
+_enzymatic_activity_pallas = None  # built lazily on first use
 
 
 @jax.jit
@@ -209,6 +219,7 @@ class World:
         batch_size: int | None = None,
         seed: int | None = None,
         mesh: "jax.sharding.Mesh | None" = None,
+        use_pallas: bool | None = None,
     ):
         if seed is None:
             seed = random.SystemRandom().randrange(2**63)
@@ -242,6 +253,22 @@ class World:
                 )
             self._map_sharding = tiled.map_sharding(mesh)
             self._cell_sharding = tiled.cell_sharding(mesh)
+
+        # Pallas integrator: explicit opt-in (default from the env var at
+        # construction time, so the choice is fixed per instance).  The
+        # kernel has no SPMD partitioning rule, so mesh-placed worlds
+        # always use the XLA integrator.
+        if use_pallas is None:
+            import os
+
+            use_pallas = os.environ.get("MAGICSOUP_TPU_PALLAS") == "1" and mesh is None
+        if use_pallas and mesh is not None:
+            raise ValueError(
+                "use_pallas is not supported with a mesh: pallas_call has"
+                " no partitioning rule; the sharded step uses the XLA"
+                " integrator"
+            )
+        self.use_pallas = bool(use_pallas)
 
         self.genetics = Genetics(
             start_codons=start_codons,
@@ -791,12 +818,27 @@ class World:
     # physics                                                            #
     # ------------------------------------------------------------------ #
 
+    def _activity_fn(self):
+        if not self.use_pallas:
+            return _enzymatic_activity
+        global _enzymatic_activity_pallas
+        if _enzymatic_activity_pallas is None:
+            import functools
+
+            from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+            interpret = jax.default_backend() != "tpu"
+            _enzymatic_activity_pallas = _make_enzymatic_activity(
+                functools.partial(integrate_signals_pallas, interpret=interpret)
+            )
+        return _enzymatic_activity_pallas
+
     def enzymatic_activity(self):
         """Catalyze reactions and transport for one time step; updates
         ``molecule_map`` and ``cell_molecules``."""
         if self.n_cells == 0:
             return
-        self._molecule_map, self._cell_molecules = _enzymatic_activity(
+        self._molecule_map, self._cell_molecules = self._activity_fn()(
             self._molecule_map,
             self._cell_molecules,
             self._positions_dev,
@@ -929,6 +971,7 @@ class World:
 
     def __setstate__(self, state: dict):
         self.__dict__.update(state)
+        self.__dict__.setdefault("use_pallas", False)
         self._cell_molecules = jnp.asarray(state["_cell_molecules"])
         self._molecule_map = jnp.asarray(state["_molecule_map"])
         self._diff_kernels = jnp.asarray(state["_diff_kernels"])
