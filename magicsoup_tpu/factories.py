@@ -19,7 +19,7 @@ from typing import TYPE_CHECKING, Protocol
 
 from magicsoup_tpu.constants import CODON_SIZE
 from magicsoup_tpu.containers import Molecule
-from magicsoup_tpu.util import closest_value, random_genome, round_down
+from magicsoup_tpu.util import closest_value, random_genome
 
 if TYPE_CHECKING:
     from magicsoup_tpu.world import World
@@ -53,6 +53,14 @@ def _scalar_codon(
     val = closest_value(values=inverse_map, key=target)
     idx = rng.choice(inverse_map[val])
     return genetics.idx_2_one_codon[idx]
+
+
+def _domain_seq(world: "World", dom_type: int, tok_seqs: list[str]) -> str:
+    """Assemble a full domain coding sequence: a random type codon-pair of
+    ``dom_type`` followed by the 4 token sequences (Genetics layout:
+    2 type codons + 3 scalar codons + 1 two-codon vector token)."""
+    type_seq = world._rng.choice(world.genetics.domain_types[dom_type])
+    return type_seq + "".join(tok_seqs)
 
 
 class CatalyticDomainFact:
@@ -96,25 +104,23 @@ class CatalyticDomainFact:
 
     def gen_coding_sequence(self, world: "World") -> str:
         """Generate a nucleotide sequence for this domain"""
-        # layout: type codons | Vmax codon | Km codon | direction codon |
-        # reaction 2-codon token
+        # token layout: Vmax | Km | direction | reaction
         kinetics = world.kinetics
         genetics = world.genetics
         rng = world._rng
-        dom_seq = rng.choice(genetics.domain_types[1])
-        i0_seq = _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng)
-        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
 
         react = (tuple(self.substrates), tuple(self.products))
-        is_fwd = True
-        if react not in kinetics.catal_2_idxs:
-            react = (tuple(self.products), tuple(self.substrates))
-            is_fwd = False
-        i2 = rng.choice(kinetics.sign_2_idxs[is_fwd])
-        i2_seq = genetics.idx_2_one_codon[i2]
-        i3 = rng.choice(kinetics.catal_2_idxs[react])
-        i3_seq = genetics.idx_2_two_codon[i3]
-        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+        is_fwd = react in kinetics.catal_2_idxs
+        if not is_fwd:
+            react = react[::-1]
+
+        toks = [
+            _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng),
+            _scalar_codon(world, kinetics.km_2_idxs, self.km, rng),
+            genetics.idx_2_one_codon[rng.choice(kinetics.sign_2_idxs[is_fwd])],
+            genetics.idx_2_two_codon[rng.choice(kinetics.catal_2_idxs[react])],
+        ]
+        return _domain_seq(world, dom_type=1, tok_seqs=toks)
 
     @classmethod
     def from_dict(cls, dct: dict) -> "CatalyticDomainFact":
@@ -184,22 +190,25 @@ class TransporterDomainFact:
 
     def gen_coding_sequence(self, world: "World") -> str:
         """Generate a nucleotide sequence for this domain"""
+        # token layout: Vmax | Km | export direction | molecule
         kinetics = world.kinetics
         genetics = world.genetics
         rng = world._rng
-        dom_seq = rng.choice(genetics.domain_types[2])
-        i0_seq = _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng)
-        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
 
-        if self.is_exporter is not None:
-            i2 = rng.choice(kinetics.sign_2_idxs[self.is_exporter])
-            i2_seq = genetics.idx_2_one_codon[i2]
+        if self.is_exporter is None:
+            dir_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
         else:
-            i2_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+            dir_seq = genetics.idx_2_one_codon[
+                rng.choice(kinetics.sign_2_idxs[self.is_exporter])
+            ]
 
-        i3 = rng.choice(kinetics.trnsp_2_idxs[self.molecule])
-        i3_seq = genetics.idx_2_two_codon[i3]
-        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+        toks = [
+            _scalar_codon(world, kinetics.vmax_2_idxs, self.vmax, rng),
+            _scalar_codon(world, kinetics.km_2_idxs, self.km, rng),
+            dir_seq,
+            genetics.idx_2_two_codon[rng.choice(kinetics.trnsp_2_idxs[self.molecule])],
+        ]
+        return _domain_seq(world, dom_type=2, tok_seqs=toks)
 
     @classmethod
     def from_dict(cls, dct: dict) -> "TransporterDomainFact":
@@ -274,29 +283,32 @@ class RegulatoryDomainFact:
 
     def gen_coding_sequence(self, world: "World") -> str:
         """Generate a nucleotide sequence for this domain"""
+        # token layout: hill | Km | sign (activating=+) | effector
         kinetics = world.kinetics
         genetics = world.genetics
         rng = world._rng
-        dom_seq = rng.choice(genetics.domain_types[3])
 
-        if self.hill is not None:
-            val = closest_value(values=kinetics.hill_2_idxs, key=self.hill)
-            i0 = rng.choice(kinetics.hill_2_idxs[int(val)])
-            i0_seq = genetics.idx_2_one_codon[i0]
+        if self.hill is None:
+            hill_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
         else:
-            i0_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+            val = int(closest_value(values=kinetics.hill_2_idxs, key=self.hill))
+            hill_seq = genetics.idx_2_one_codon[rng.choice(kinetics.hill_2_idxs[val])]
 
-        i1_seq = _scalar_codon(world, kinetics.km_2_idxs, self.km, rng)
-
-        if self.is_inhibiting is not None:
-            i2 = rng.choice(kinetics.sign_2_idxs[not self.is_inhibiting])
-            i2_seq = genetics.idx_2_one_codon[i2]
+        if self.is_inhibiting is None:
+            sign_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
         else:
-            i2_seq = random_genome(s=CODON_SIZE, excl=genetics.stop_codons, rng=rng)
+            sign_seq = genetics.idx_2_one_codon[
+                rng.choice(kinetics.sign_2_idxs[not self.is_inhibiting])
+            ]
 
-        i3 = rng.choice(kinetics.regul_2_idxs[(self.effector, self.is_transmembrane)])
-        i3_seq = genetics.idx_2_two_codon[i3]
-        return dom_seq + i0_seq + i1_seq + i2_seq + i3_seq
+        effector_key = (self.effector, self.is_transmembrane)
+        toks = [
+            hill_seq,
+            _scalar_codon(world, kinetics.km_2_idxs, self.km, rng),
+            sign_seq,
+            genetics.idx_2_two_codon[rng.choice(kinetics.regul_2_idxs[effector_key])],
+        ]
+        return _domain_seq(world, dom_type=3, tok_seqs=toks)
 
     @classmethod
     def from_dict(cls, dct: dict) -> "RegulatoryDomainFact":
@@ -358,64 +370,62 @@ class GenomeFact:
         target_size: int | None = None,
     ):
         self.world = world
-        self.proteome = proteome
+        self.proteome = self._checked(world, proteome)
 
-        try:
-            _ = iter(proteome)
-        except TypeError as err:
+        per_prot_nts = [
+            world.genetics.dom_size * len(doms) + 2 * CODON_SIZE
+            for doms in self.proteome
+        ]
+        self.req_nts = sum(per_prot_nts)
+        self.target_size = target_size if target_size is not None else self.req_nts
+        if self.target_size < self.req_nts:
             raise ValueError(
-                "Proteome must be a list of lists representing domains in proteins."
-            ) from err
-        for pi, prot in enumerate(proteome):
-            try:
-                _ = iter(prot)
-            except TypeError as err:
-                raise ValueError(
-                    "Proteome must be a list of lists representing domains in proteins."
-                    f" Element {pi} of proteome is not iterable."
-                ) from err
-        for prot in proteome:
-            for dom in prot:
-                dom.validate(world=world)
-
-        self.req_nts = sum(
-            self.world.genetics.dom_size * len(d) + 2 * CODON_SIZE
-            for d in self.proteome
-        )
-        self.target_size = self.req_nts if target_size is None else target_size
-        if self.req_nts > self.target_size:
-            raise ValueError(
-                "Genome size too small."
-                f" The given proteome would require at least {self.req_nts} nucleotides."
-                f" But the given genome target size is target_size={self.target_size}."
+                f"target_size={self.target_size} is too small for this"
+                f" proteome: its CDSs alone need {self.req_nts} nucleotides"
             )
+
+    @staticmethod
+    def _checked(
+        world: "World", proteome: list[list[DomainFactType]]
+    ) -> list[list[DomainFactType]]:
+        if isinstance(proteome, str) or not hasattr(proteome, "__iter__"):
+            raise ValueError(
+                f"proteome must be a list of proteins, each a list of domain"
+                f" factories; got {type(proteome).__name__}"
+            )
+        for pi, doms in enumerate(proteome):
+            if isinstance(doms, str) or not hasattr(doms, "__iter__"):
+                raise ValueError(
+                    f"proteome must be a list of proteins, each a list of"
+                    f" domain factories; protein {pi} is"
+                    f" {type(doms).__name__}, not a list"
+                )
+            for dom in doms:
+                dom.validate(world=world)
+        return proteome
 
     def generate(self) -> str:
         """Generate a genome with the desired proteome"""
-        rng = self.world._rng
-        cdss = [
-            [d.gen_coding_sequence(world=self.world) for d in p] for p in self.proteome
-        ]
-        n_pads = len(cdss) + 1
-        n_pad_nts = self.target_size - self.req_nts
-        pad_size = round_down(n_pad_nts / n_pads, to=1)
-        remaining_nts = n_pad_nts - n_pads * pad_size
+        world = self.world
+        rng = world._rng
+        genetics = world.genetics
+        # spacers must not open or close reading frames of their own
+        non_coding = genetics.start_codons + genetics.stop_codons
 
-        start_codons = self.world.genetics.start_codons
-        stop_codons = self.world.genetics.stop_codons
-        excl_cdss = start_codons + stop_codons
-        pads = [random_genome(s=pad_size, excl=excl_cdss, rng=rng) for _ in range(n_pads)]
-        tail = random_genome(s=remaining_nts, excl=excl_cdss, rng=rng)
+        # one spacer before each CDS plus one trailing; spare nts are
+        # spread as evenly as integer sizes allow
+        n_gaps = len(self.proteome) + 1
+        base, extra = divmod(self.target_size - self.req_nts, n_gaps)
+        gap_sizes = [base + (1 if i < extra else 0) for i in range(n_gaps)]
 
-        parts: list[str] = []
-        for cds in cdss:
-            parts.append(pads.pop())
-            parts.append(rng.choice(start_codons))
-            parts.extend(cds)
-            parts.append(rng.choice(stop_codons))
-        parts.append(pads.pop())
-        parts.append(tail)
-        return "".join(parts)
+        chunks: list[str] = []
+        for doms, gap in zip(self.proteome, gap_sizes):
+            chunks.append(random_genome(s=gap, excl=non_coding, rng=rng))
+            chunks.append(rng.choice(genetics.start_codons))
+            chunks.extend(d.gen_coding_sequence(world=world) for d in doms)
+            chunks.append(rng.choice(genetics.stop_codons))
+        chunks.append(random_genome(s=gap_sizes[-1], excl=non_coding, rng=rng))
+        return "".join(chunks)
 
     @classmethod
     def from_dicts(cls, dcts: list[dict], world: "World") -> "GenomeFact":

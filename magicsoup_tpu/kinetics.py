@@ -44,14 +44,22 @@ from magicsoup_tpu.ops.params import (
 )
 
 
+def _token_rng(rng: random.Random) -> np.random.Generator:
+    """Derive a numpy Generator for vectorized table sampling from the
+    instance's seeded ``random.Random``."""
+    return np.random.default_rng(rng.randrange(2**63))
+
+
 class _HillMapFact:
     """Token -> 1,2,3,4,5 with chances 52/26/13/6/3% respectively"""
 
+    _HILL_P = np.array([16.0, 8.0, 4.0, 2.0, 1.0]) / 31.0  # hill = 1..5
+
     def __init__(self, rng: random.Random, max_token: int, zero_value: int = 0):
-        choices = [5] + 2 * [4] + 4 * [3] + 8 * [2] + 16 * [1]
-        self.numbers = np.array(
-            [zero_value] + rng.choices(choices, k=max_token), dtype=np.int32
+        drawn = _token_rng(rng).choice(
+            np.arange(1, 6), size=max_token, p=self._HILL_P
         )
+        self.numbers = np.concatenate([[zero_value], drawn]).astype(np.int32)
 
     def __call__(self, t: np.ndarray) -> np.ndarray:
         return self.numbers[t]
@@ -73,19 +81,20 @@ class _LogNormWeightMapFact:
         weight_range: tuple[float, float],
         zero_value: float = math.nan,
     ):
-        min_w = min(weight_range)
-        max_w = max(weight_range)
-        l_min_w = math.log(min_w)
-        l_max_w = math.log(max_w)
-        mu = (l_min_w + l_max_w) / 2
-        sig = l_max_w - l_min_w
-        weights: list[float] = [zero_value]
-        for _ in range(max_token):
-            sample = math.exp(rng.gauss(mu, sig))
-            while not min_w <= sample <= max_w:
-                sample = math.exp(rng.gauss(mu, sig))
-            weights.append(sample)
-        self.weights = np.array(weights, dtype=np.float32)
+        lo, hi = sorted(weight_range)
+        mu = (math.log(lo) + math.log(hi)) / 2.0
+        sig = math.log(hi) - math.log(lo)
+        nprng = _token_rng(rng)
+        # vectorized rejection: redraw the whole remainder until full
+        # (the acceptance rate is ~2/3, so this converges in a few rounds)
+        vals = np.empty(max_token, dtype=np.float64)
+        n_ok = 0
+        while n_ok < max_token:
+            draw = np.exp(nprng.normal(mu, sig, size=max_token - n_ok))
+            draw = draw[(draw >= lo) & (draw <= hi)]
+            vals[n_ok : n_ok + len(draw)] = draw
+            n_ok += len(draw)
+        self.weights = np.concatenate([[zero_value], vals]).astype(np.float32)
 
     def __call__(self, t: np.ndarray) -> np.ndarray:
         return self.weights[t]
@@ -101,9 +110,8 @@ class _SignMapFact:
     """Token -> +1 or -1 with 50% probability each"""
 
     def __init__(self, rng: random.Random, max_token: int, zero_value: int = 0):
-        self.signs = np.array(
-            [zero_value] + rng.choices([1, -1], k=max_token), dtype=np.int32
-        )
+        drawn = np.where(_token_rng(rng).random(max_token) < 0.5, 1, -1)
+        self.signs = np.concatenate([[zero_value], drawn]).astype(np.int32)
 
     def __call__(self, t: np.ndarray) -> np.ndarray:
         return self.signs[t]
@@ -126,29 +134,25 @@ class _VectorMapFact:
         vectors: list[list[int]],
         zero_value: int = 0,
     ):
-        n_vectors = len(vectors)
         M = np.full((max_token + 1, n_signals), zero_value, dtype=np.int32)
-
-        if n_vectors == 0:
+        if len(vectors) == 0:
             self.M = M
             return
-        if not all(len(d) == n_signals for d in vectors):
-            raise ValueError(f"Not all vectors have length of signal_size={n_signals}")
-        if n_vectors > max_token:
-            raise ValueError(
-                f"There are max_token={max_token} and {n_vectors} vectors."
-                " It is not possible to map all vectors"
-            )
-        for vector in vectors:
-            if all(d == 0 for d in vector):
-                raise ValueError(
-                    "At least one vector includes only zeros."
-                    " Each vector should contain at least one non-zero value."
-                )
 
-        idxs = rng.choices(range(n_vectors), k=max_token)
-        for row_i, idx in enumerate(idxs):
-            M[row_i + 1] = vectors[idx]
+        V = np.asarray(vectors, dtype=np.int32)
+        if V.ndim != 2 or V.shape[1] != n_signals:
+            raise ValueError(
+                f"every vector must have one entry per signal ({n_signals})"
+            )
+        if len(V) > max_token:
+            raise ValueError(
+                f"{len(V)} vectors cannot all get a token: only"
+                f" {max_token} tokens are available"
+            )
+        if (V == 0).all(axis=1).any():
+            raise ValueError("all-zero vectors cannot be mapped to tokens")
+
+        M[1:] = V[_token_rng(rng).integers(0, len(V), size=max_token)]
         self.M = M
 
     def __call__(self, t: np.ndarray) -> np.ndarray:
@@ -393,14 +397,37 @@ class Kinetics:
 
     def _resize(self, c: int, p: int):
         old = self.params
-        new = self._alloc(c, p)
-        oc = min(self.max_cells, c)
-        op = min(self.max_proteins, p)
-        if oc > 0 and op > 0:
-            new = CellParams(
-                *(n.at[:oc, :op].set(o[:oc, :op]) for n, o in zip(new, old))
+        if self.max_cells == 0 or self.max_proteins == 0:
+            self.params = self._alloc(c, p)
+            self.max_cells = c
+            self.max_proteins = p
+            return
+        # grow-only (ensure_capacity never shrinks): one fused+donated pad
+        # program instead of 9 eager slice/scatter pairs — growth used to
+        # cost seconds of eager compiles per pow2 step
+        s = self.n_signals
+
+        def _grow(params: CellParams) -> CellParams:
+            def g(o: jax.Array, tgt: tuple) -> jax.Array:
+                return jnp.pad(o, [(0, t - d) for t, d in zip(tgt, o.shape)])
+
+            cp, cps = (c, p), (c, p, s)
+            return CellParams(
+                Ke=g(params.Ke, cp),
+                Kmf=g(params.Kmf, cp),
+                Kmb=g(params.Kmb, cp),
+                Kmr=g(params.Kmr, cps),
+                Vmax=g(params.Vmax, cp),
+                N=g(params.N, cps),
+                Nf=g(params.Nf, cps),
+                Nb=g(params.Nb, cps),
+                A=g(params.A, cps),
             )
-        self.params = new
+
+        kwargs = {}
+        if self.cell_sharding is not None:
+            kwargs["out_shardings"] = CellParams(*([self.cell_sharding] * 9))
+        self.params = jax.jit(_grow, **kwargs)(old)
         self.max_cells = c
         self.max_proteins = p
 
@@ -535,6 +562,9 @@ class Kinetics:
 
     def __setstate__(self, state: dict):
         self.__dict__.update(state)
+        # compat defaults for pickles from before these attributes existed
+        self.__dict__.setdefault("max_doms", 1)
+        self.__dict__.setdefault("cell_sharding", None)
         self.params = CellParams(*(jnp.asarray(t) for t in state["params"]))
         self.tables = TokenTables(*(jnp.asarray(t) for t in state["tables"]))
         self._abs_temp_arr = jnp.asarray(state["_abs_temp_arr"])

@@ -252,6 +252,7 @@ _NTS = "ACTG"  # reference mutation alphabet order (rust/mutations.rs:6)
 def point_mutations_flat(
     seqs: list[str],
     n_muts_per_seq: np.ndarray,
+    orig_idxs: np.ndarray,
     p_indel: float,
     p_del: float,
     seed: int,
@@ -260,15 +261,19 @@ def point_mutations_flat(
     Apply the given number of point mutations (substitutions and indels)
     to each sequence.  Mutation counts are pre-drawn by the caller
     (vectorized Poisson); per-sequence deterministic RNG stream derived
-    from ``seed`` and the sequence index.  Returns only mutated sequences
-    with their input index.
+    from ``seed`` and the sequence's index in the caller's full
+    population (``orig_idxs``), so outcomes don't depend on which other
+    sequences were batched in.  Returns only mutated sequences with
+    their input index (position within ``seqs``).
     """
     out: list[tuple[str, int]] = []
     for idx, seq in enumerate(seqs):
         n = len(seq)
         if n < 1:
             continue
-        rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + idx))
+        rng = np.random.default_rng(
+            np.random.PCG64(seed * 1_000_003 + int(orig_idxs[idx]))
+        )
         n_muts = int(n_muts_per_seq[idx])
         if n_muts < 1:
             continue
@@ -294,13 +299,16 @@ def point_mutations_flat(
 def recombinations_flat(
     seq_pairs: list[tuple[str, str]],
     n_breaks_per_pair: np.ndarray,
+    orig_idxs: np.ndarray,
     seed: int,
 ) -> list[tuple[str, str, int]]:
     """
     Recombine sequence pairs by the given numbers of strand breaks: both
     sequences are cut at random positions, all fragments shuffled, and a
     random split point reassembles two new sequences (length-conserving).
-    Break counts are pre-drawn by the caller (vectorized Poisson).
+    Break counts are pre-drawn by the caller (vectorized Poisson);
+    per-pair RNG streams are keyed by ``orig_idxs`` (the pair's index in
+    the caller's full pair list) for batch-independence.
     Returns only recombined pairs with their input index.
     """
     out: list[tuple[str, str, int]] = []
@@ -310,7 +318,9 @@ def recombinations_flat(
         n_both = n0 + n1
         if n_both < 1:
             continue
-        rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + idx))
+        rng = np.random.default_rng(
+            np.random.PCG64(seed * 1_000_003 + int(orig_idxs[idx]))
+        )
         n_muts = int(n_breaks_per_pair[idx])
         if n_muts < 1:
             continue

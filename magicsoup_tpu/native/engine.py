@@ -79,6 +79,7 @@ def _load_lib():
     lib.ms_point_mutations.argtypes = [
         _charp, _i64p, ctypes.c_int64,
         _i64p,  # pre-drawn per-seq mutation counts
+        _i64p,  # original population indices (RNG stream keys)
         ctypes.c_float, ctypes.c_float,
         ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
@@ -88,6 +89,7 @@ def _load_lib():
     lib.ms_recombinations.argtypes = [
         _charp, _i64p, ctypes.c_int64,
         _i64p,  # pre-drawn per-pair strand-break counts
+        _i64p,  # original population indices (RNG stream keys)
         ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(_charp), ctypes.POINTER(_i64p),
         ctypes.POINTER(_i64p), _i64p,
@@ -220,9 +222,10 @@ def point_mutations(
         return []
     sub = [seqs[int(i)] for i in sel]
     counts = n_muts[sel].astype(np.int64)
+    orig = sel.astype(np.int64)  # RNG streams keyed by original index
     lib = get_lib()
     if lib is None:
-        out = _pyengine.point_mutations_flat(sub, counts, p_indel, p_del, seed)
+        out = _pyengine.point_mutations_flat(sub, counts, orig, p_indel, p_del, seed)
     else:
         data, offsets = _concat(sub)
         out_data = _charp()
@@ -234,6 +237,7 @@ def point_mutations(
             offsets.ctypes.data_as(_i64p),
             len(sub),
             counts.ctypes.data_as(_i64p),
+            orig.ctypes.data_as(_i64p),
             p_indel, p_del,
             seed & 0xFFFFFFFFFFFFFFFF,
             n_threads,
@@ -271,9 +275,10 @@ def recombinations(
         return []
     sub = [seq_pairs[int(i)] for i in sel]
     counts = n_breaks[sel].astype(np.int64)
+    orig = sel.astype(np.int64)  # RNG streams keyed by original index
     lib = get_lib()
     if lib is None:
-        out = _pyengine.recombinations_flat(sub, counts, seed)
+        out = _pyengine.recombinations_flat(sub, counts, orig, seed)
     else:
         flat = [s for pair in sub for s in pair]
         data, offsets = _concat(flat)
@@ -286,6 +291,7 @@ def recombinations(
             offsets.ctypes.data_as(_i64p),
             len(sub),
             counts.ctypes.data_as(_i64p),
+            orig.ctypes.data_as(_i64p),
             seed & 0xFFFFFFFFFFFFFFFF,
             n_threads,
             ctypes.byref(out_data),

@@ -296,8 +296,12 @@ struct MutResult {
 // (vectorized numpy on the host) and passes only sequences with >= 1
 // mutation — this keeps the per-call work proportional to the number of
 // actually-mutated sequences instead of the population size.
+// orig_idxs holds each sequence's index in the caller's full population:
+// RNG streams are keyed by it (not by the position within this call) so a
+// genome's mutations don't depend on which other genomes were batched in.
 void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
-                        const int64_t* n_muts_in, float p_indel, float p_del,
+                        const int64_t* n_muts_in, const int64_t* orig_idxs,
+                        float p_indel, float p_del,
                         uint64_t seed, int n_threads, char** out_data,
                         int64_t** out_offsets, int64_t** out_idxs,
                         int64_t* out_n) {
@@ -316,7 +320,7 @@ void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
       const char* seq = data + offsets[si];
       int64_t len = offsets[si + 1] - offsets[si];
       if (len < 1) continue;
-      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)si);
+      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)orig_idxs[si]);
       int64_t n_muts = n_muts_in[si];
       if (n_muts < 1) continue;
       if (n_muts > len) n_muts = len;
@@ -379,7 +383,8 @@ void ms_point_mutations(const char* data, const int64_t* offsets, int64_t n,
 // pair i = sequences 2i and 2i+1).  Output mirrors ms_point_mutations but
 // with two sequences per result (2*out_n sequences, out_n indices).
 void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
-                       const int64_t* n_breaks_in, uint64_t seed,
+                       const int64_t* n_breaks_in, const int64_t* orig_idxs,
+                       uint64_t seed,
                        int n_threads, char** out_data, int64_t** out_offsets,
                        int64_t** out_idxs, int64_t* out_n) {
   std::vector<MutResult> results((size_t)n);
@@ -401,7 +406,7 @@ void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
       int64_t n1 = offsets[2 * pi + 2] - offsets[2 * pi + 1];
       int64_t n_both = n0 + n1;
       if (n_both < 1) continue;
-      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)pi);
+      std::mt19937_64 rng(seed * 1000003ULL + (uint64_t)orig_idxs[pi]);
       int64_t n_muts = n_breaks_in[pi];
       if (n_muts < 1) continue;
       if (n_muts > n_both) n_muts = n_both;

@@ -16,21 +16,26 @@ from typing import Iterable
 from itertools import product
 import string
 import random
-import math
 
 from magicsoup_tpu.constants import ALL_NTS, CODON_SIZE
 
 _DEFAULT_RNG = random.Random()
 
+_LABEL_CHARS = string.ascii_uppercase + string.ascii_lowercase + string.digits
+
+# template wildcard -> allowed nucleotides; expansion order of each pool is
+# what fixes the (token-map-relevant) enumeration order of codons()
+_WILDCARDS = {"N": "TCGA", "R": "AG", "Y": "CT"}
+
 
 def round_down(d: float, to: int = 3) -> int:
     """Round down to declared integer multiple"""
-    return math.floor(d / to) * to
+    return int(d // to) * to
 
 
 def closest_value(values: Iterable[float], key: float) -> float:
     """Get closest value to key in values"""
-    return min(values, key=lambda d: abs(d - key))
+    return min(values, key=lambda v: abs(v - key))
 
 
 def randstr(n: int = 12, rng: random.Random | None = None) -> str:
@@ -41,8 +46,7 @@ def randstr(n: int = 12, rng: random.Random | None = None) -> str:
     collision after 5e10 draws (birthday paradox).
     """
     rng = rng or _DEFAULT_RNG
-    chars = string.ascii_uppercase + string.ascii_lowercase + string.digits
-    return "".join(rng.choices(chars, k=n))
+    return "".join(rng.choice(_LABEL_CHARS) for _ in range(n))
 
 
 def random_genome(
@@ -61,15 +65,23 @@ def random_genome(
     those should also be excluded.
     """
     rng = rng or _DEFAULT_RNG
-    out = "".join(rng.choices(ALL_NTS, k=s))
-    if excl is not None:
+
+    def draw(k: int) -> str:
+        return "".join(rng.choices(ALL_NTS, k=k))
+
+    if not excl:
+        return draw(s)
+
+    def scrub(g: str) -> str:
         for seq in excl:
-            out = "".join(out.split(seq))
-        while len(out) != s:
-            n = s - len(out)
-            out += random_genome(s=n, rng=rng)
-            for seq in excl:
-                out = "".join(out.split(seq))
+            g = g.replace(seq, "")
+        return g
+
+    out = scrub(draw(s))
+    while len(out) < s:
+        # top up and re-scrub: appending can create new matches across
+        # the seam, so the whole string is checked again
+        out = scrub(out + draw(s - len(out)))
     return out
 
 
@@ -80,19 +92,8 @@ def variants(seq: str) -> list[str]:
     Special characters: `N` any nucleotide, `R` purines (A/G),
     `Y` pyrimidines (C/T).
     """
-
-    def apply(s: str, char: str, nts: tuple[str, ...]) -> list[str]:
-        n = s.count(char)
-        for i in range(n):
-            idx = s.find(char)
-            s = s[:idx] + "{" + str(i) + "}" + s[idx + 1 :]
-        ns = [nts] * n
-        return [s.format(*d) for d in product(*ns)]
-
-    seqs1 = apply(seq, "N", ("T", "C", "G", "A"))
-    seqs2 = [ss for s in seqs1 for ss in apply(s, "R", ("A", "G"))]
-    seqs3 = [ss for s in seqs2 for ss in apply(s, "Y", ("C", "T"))]
-    return seqs3
+    pools = [_WILDCARDS.get(c, c) for c in seq]
+    return ["".join(chars) for chars in product(*pools)]
 
 
 def codons(n: int, excl_codons: list[str] | None = None) -> list[str]:
@@ -100,20 +101,18 @@ def codons(n: int, excl_codons: list[str] | None = None) -> list[str]:
     All possible nucleotide sequences of `n` codons, optionally excluding
     sequences that contain any codon from `excl_codons` at a codon boundary.
     """
-    all_seqs = variants("N" * n * CODON_SIZE)
+    seqs = variants("N" * (n * CODON_SIZE))
     if excl_codons is None:
-        return all_seqs
-    seqs = []
-    for seq in all_seqs:
-        has_excl = False
-        for i in range(n):
-            a = i * CODON_SIZE
-            b = (i + 1) * CODON_SIZE
-            if seq[a:b] in excl_codons:
-                has_excl = True
-        if not has_excl:
-            seqs.append(seq)
-    return seqs
+        return seqs
+    banned = set(excl_codons)
+    return [
+        seq
+        for seq in seqs
+        if not any(
+            seq[a : a + CODON_SIZE] in banned
+            for a in range(0, len(seq), CODON_SIZE)
+        )
+    ]
 
 
 def reverse_complement(seq: str) -> str:

@@ -261,7 +261,15 @@ class World:
         if use_pallas is None:
             import os
 
-            use_pallas = os.environ.get("MAGICSOUP_TPU_PALLAS") == "1" and mesh is None
+            env_pallas = os.environ.get("MAGICSOUP_TPU_PALLAS") == "1"
+            if env_pallas and mesh is not None:
+                import warnings
+
+                warnings.warn(
+                    "MAGICSOUP_TPU_PALLAS=1 is ignored for mesh-placed"
+                    " worlds: the sharded step uses the XLA integrator"
+                )
+            use_pallas = env_pallas and mesh is None
         if use_pallas and mesh is not None:
             raise ValueError(
                 "use_pallas is not supported with a mesh: pallas_call has"
@@ -643,6 +651,84 @@ class World:
         self._update_cell_params(genomes=[d.genome for d in cells], idxs=new_idxs)
         return new_idxs
 
+    _MOORE_DX = np.array([-1, -1, -1, 0, 0, 1, 1, 1], dtype=np.int64)
+    _MOORE_DY = np.array([-1, 0, 1, -1, 1, -1, 0, 1], dtype=np.int64)
+
+    def _place_in_neighborhood(
+        self, idxs: np.ndarray, vacate: bool
+    ) -> list[tuple[int, tuple[int, int]]]:
+        """
+        Place one pixel per cell in its free Moore neighborhood, no two on
+        the same pixel (reference rust/world.rs:59-146, which scans
+        candidates in parallel then resolves conflicts sequentially).
+
+        Vectorized round-based resolution instead of a per-cell loop: each
+        round every pending cell draws a uniformly random free neighbor
+        against the current map; when several cells draw the same pixel
+        the lowest-index cell wins and the rest retry next round against
+        the updated map — so earlier cells constrain later ones exactly as
+        in a sequential pass.  With ``vacate`` (move), a winner frees its
+        old pixel, which becomes available from the next round on; without
+        (divide), the new pixel stays occupied by the child.  Updates
+        ``_np_cell_map`` (+ ``_np_positions`` when vacating) in place and
+        returns ``(cell_idx, (x, y))`` for each placed cell, by cell idx.
+        """
+        m = self.map_size
+        cmap = self._np_cell_map
+        pos = self._np_positions
+        dx, dy = self._MOORE_DX, self._MOORE_DY
+        pending = idxs
+        placed: list[tuple[int, tuple[int, int]]] = []
+        while len(pending) > 0:
+            p = pos[pending]
+            nx = (p[:, 0:1] + dx[None, :]) % m  # (k, 8)
+            ny = (p[:, 1:2] + dy[None, :]) % m
+            free = ~cmap[nx, ny]
+            has_opts = free.sum(axis=1) > 0
+            if not vacate:
+                # divide: pixels only fill up, so no options is terminal;
+                # move: blocked cells retry (a later round may vacate a pixel)
+                pending = pending[has_opts]
+                has_opts = has_opts[has_opts]
+            active = np.nonzero(has_opts)[0]
+            if len(active) == 0:
+                break
+            nx, ny, free = nx[active], ny[active], free[active]
+            n_free = free.sum(axis=1)
+
+            # rank-r free option per cell, r uniform in [0, n_free)
+            rank = (self._nprng.random(len(active)) * n_free).astype(np.int64)
+            opt_rank = np.cumsum(free, axis=1) - 1
+            sel = np.argmax(free & (opt_rank == rank[:, None]), axis=1)
+            rows = np.arange(len(active))
+            tx = nx[rows, sel]
+            ty = ny[rows, sel]
+
+            # same-target conflicts: lowest cell idx wins (pending is sorted)
+            target = tx * m + ty
+            order = np.argsort(target, kind="stable")
+            win = np.ones(len(active), dtype=bool)
+            srt = target[order]
+            win[order[1:]] = srt[1:] != srt[:-1]
+
+            w_idx = pending[active[win]]
+            w_x, w_y = tx[win], ty[win]
+            cmap[w_x, w_y] = True
+            if vacate:
+                old = pos[w_idx]
+                cmap[old[:, 0], old[:, 1]] = False
+                pos[w_idx, 0] = w_x
+                pos[w_idx, 1] = w_y
+            placed.extend(
+                (int(i), (int(x), int(y)))
+                for i, x, y in zip(w_idx, w_x, w_y)
+            )
+            drop = np.zeros(len(pending), dtype=bool)
+            drop[active[win]] = True
+            pending = pending[~drop]
+        placed.sort(key=lambda t: t[0])
+        return placed
+
     def divide_cells(self, cell_idxs: list[int]) -> list[tuple[int, int]]:
         """
         Divide cells that have at least one free Moore-neighborhood pixel;
@@ -654,27 +740,13 @@ class World:
             return []
         cell_idxs = sorted(set(cell_idxs))
 
-        # sequential conflict-free child placement (reference
-        # rust/world.rs:59-97); the host cell map doubles as the conflict set
-        m = self.map_size
-        parent_idxs: list[int] = []
-        child_pos: list[tuple[int, int]] = []
-        cmap = self._np_cell_map
-        for idx in cell_idxs:
-            x, y = self._np_positions[idx]
-            opts = [
-                ((x + dx) % m, (y + dy) % m)
-                for dx in (-1, 0, 1)
-                for dy in (-1, 0, 1)
-                if not (dx == 0 and dy == 0)
-            ]
-            opts = [d for d in opts if not cmap[d[0], d[1]]]
-            if len(opts) == 0:
-                continue
-            pos = opts[self._rng.randrange(len(opts))]
-            cmap[pos[0], pos[1]] = True
-            parent_idxs.append(int(idx))
-            child_pos.append(pos)
+        # conflict-free child placement (reference rust/world.rs:59-97),
+        # vectorized: no per-cell Python loop
+        placed = self._place_in_neighborhood(
+            np.asarray(cell_idxs, dtype=np.int64), vacate=False
+        )
+        parent_idxs = [int(i) for i, _ in placed]
+        child_pos = [p for _, p in placed]
 
         n_new = len(parent_idxs)
         if n_new == 0:
@@ -781,23 +853,9 @@ class World:
         if len(cell_idxs) == 0:
             return
         cell_idxs = sorted(set(cell_idxs))
-        m = self.map_size
-        cmap = self._np_cell_map
-        for idx in cell_idxs:
-            x, y = self._np_positions[idx]
-            opts = [
-                ((x + dx) % m, (y + dy) % m)
-                for dx in (-1, 0, 1)
-                for dy in (-1, 0, 1)
-                if not (dx == 0 and dy == 0)
-            ]
-            opts = [d for d in opts if not cmap[d[0], d[1]]]
-            if len(opts) == 0:
-                continue
-            new = opts[self._rng.randrange(len(opts))]
-            cmap[x, y] = False
-            cmap[new[0], new[1]] = True
-            self._np_positions[idx] = new
+        self._place_in_neighborhood(
+            np.asarray(cell_idxs, dtype=np.int64), vacate=True
+        )
         self._sync_positions()
 
     def reposition_cells(self, cell_idxs: list[int] | None = None):
@@ -971,7 +1029,13 @@ class World:
 
     def __setstate__(self, state: dict):
         self.__dict__.update(state)
+        # compat defaults for pickles from before these attributes existed
         self.__dict__.setdefault("use_pallas", False)
+        self.__dict__.setdefault("_mm_cache", None)
+        self.__dict__.setdefault("_cm_cache", None)
+        self.__dict__.setdefault("_mesh", None)
+        self.__dict__.setdefault("_map_sharding", None)
+        self.__dict__.setdefault("_cell_sharding", None)
         self._cell_molecules = jnp.asarray(state["_cell_molecules"])
         self._molecule_map = jnp.asarray(state["_molecule_map"])
         self._diff_kernels = jnp.asarray(state["_diff_kernels"])

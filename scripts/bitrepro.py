@@ -1,0 +1,171 @@
+"""
+Bit-reproducibility check: CPU vs accelerator (the BASELINE.json north
+star — "bit-reproducible vs CPU").
+
+Runs the canonical benchmark workload (`performance/workload.py`) for N
+seeded steps once on the CPU backend and once on whatever accelerator JAX
+finds, hashing every piece of simulation state after every step, and
+reports the first divergence (step + tensor).
+
+All simulation randomness is host-side (numpy / python / C++ engine) and
+seeded, so the two runs execute identical event sequences; any divergence
+comes from device float semantics — reduction order, exp/log
+implementations, FMA contraction.  Divergence at step k poisons selection
+at step k+1, so only the FIRST divergent (step, tensor) is meaningful.
+
+Usage:
+    python scripts/bitrepro.py                     # parent: run + compare
+    python scripts/bitrepro.py --child cpu         # internal
+    python scripts/bitrepro.py --steps 20 --n-cells 500 --map-size 64
+
+Exit code 0 = bit-identical, 1 = diverged, 2 = runner error.
+Results are recorded in BITREPRO.md.
+"""
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_REPO))
+sys.path.insert(0, str(_REPO / "performance"))
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--n-cells", type=int, default=500)
+    ap.add_argument("--map-size", type=int, default=64)
+    ap.add_argument("--genome-size", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--child", choices=["cpu", "accel"], default=None)
+    return ap
+
+
+def _digest(arr) -> str:
+    import numpy as np
+
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:16]
+
+
+def state_digests(world) -> dict[str, str]:
+    """Hash every piece of simulation state, device and host"""
+    import numpy as np
+
+    n = world.n_cells
+    out = {
+        "molecule_map": _digest(np.asarray(world._molecule_map)),
+        "cell_molecules": _digest(np.asarray(world._cell_molecules)[:n]),
+        "positions": _digest(world.cell_positions),
+        "lifetimes": _digest(world.cell_lifetimes),
+        "divisions": _digest(world.cell_divisions),
+        "genomes": hashlib.sha256(
+            "\n".join(world.cell_genomes).encode()
+        ).hexdigest()[:16],
+    }
+    for name in ("Ke", "Kmf", "Kmb", "Kmr", "Vmax", "N", "Nf", "Nb", "A"):
+        t = getattr(world.kinetics.params, name)
+        out[f"params.{name}"] = _digest(np.asarray(t)[:n])
+    return out
+
+
+def child_main(args: argparse.Namespace) -> None:
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+    from workload import sim_step
+
+    import jax
+
+    rng = random.Random(args.seed)
+    world = ms.World(chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed)
+    atp = CHEMISTRY.molname_2_idx["ATP"]
+    print(json.dumps({"platform": jax.default_backend()}))
+    for step in range(args.steps):
+        sim_step(
+            world,
+            rng,
+            n_cells=args.n_cells,
+            genome_size=args.genome_size,
+            atp_idx=atp,
+            sync=True,
+        )
+        print(json.dumps({"step": step, "n_cells": world.n_cells} | state_digests(world)))
+
+
+def _run_child(args: argparse.Namespace, platform: str) -> list[dict]:
+    env = dict(os.environ)
+    if platform == "cpu":
+        # strip any PJRT shim and pin the CPU backend
+        env["PYTHONPATH"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable, str(Path(__file__).resolve()), "--child", platform,
+        "--steps", str(args.steps), "--n-cells", str(args.n_cells),
+        "--map-size", str(args.map_size), "--genome-size", str(args.genome_size),
+        "--seed", str(args.seed),
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=3600)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr[-3000:])
+        raise RuntimeError(f"{platform} child failed (rc={res.returncode})")
+    return [json.loads(line) for line in res.stdout.splitlines() if line.strip()]
+
+
+def main() -> None:
+    args = _build_parser().parse_args()
+    if args.child is not None:
+        child_main(args)
+        return
+
+    try:
+        cpu_rows = _run_child(args, "cpu")
+        acc_rows = _run_child(args, "accel")
+    except RuntimeError as err:
+        print(json.dumps({"result": "error", "error": str(err)}))
+        sys.exit(2)
+
+    cpu_platform = cpu_rows.pop(0)["platform"]
+    acc_platform = acc_rows.pop(0)["platform"]
+    header = f"{cpu_platform} vs {acc_platform}"
+    if acc_platform == cpu_platform:
+        header += " (no accelerator found: self-check)"
+
+    for cpu_row, acc_row in zip(cpu_rows, acc_rows):
+        step = cpu_row["step"]
+        diff = [
+            k
+            for k in cpu_row
+            if k not in ("step",) and cpu_row[k] != acc_row.get(k)
+        ]
+        if diff:
+            print(
+                json.dumps(
+                    {
+                        "result": "diverged",
+                        "backends": header,
+                        "first_divergence_step": step,
+                        "tensors": diff,
+                        "steps_checked": len(cpu_rows),
+                    }
+                )
+            )
+            sys.exit(1)
+    print(
+        json.dumps(
+            {
+                "result": "bit-identical",
+                "backends": header,
+                "steps_checked": len(cpu_rows),
+                "final_n_cells": cpu_rows[-1]["n_cells"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

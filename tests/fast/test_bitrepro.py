@@ -1,0 +1,41 @@
+"""
+Determinism plumbing for the bit-reproducibility north star
+(`scripts/bitrepro.py`): a seeded world must produce a byte-identical
+trajectory on the same backend, independent of process state — the
+prerequisite for comparing trajectories ACROSS backends.
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "scripts"))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "performance"))
+
+from bitrepro import state_digests  # noqa: E402
+from workload import sim_step  # noqa: E402
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+
+
+def _trajectory(seed: int, steps: int) -> list[dict]:
+    rng = random.Random(seed)
+    world = ms.World(chemistry=CHEMISTRY, map_size=32, seed=seed)
+    atp = CHEMISTRY.molname_2_idx["ATP"]
+    out = []
+    for _ in range(steps):
+        sim_step(world, rng, n_cells=100, genome_size=300, atp_idx=atp, sync=True)
+        out.append(state_digests(world))
+    return out
+
+
+def test_seeded_trajectory_is_byte_identical():
+    a = _trajectory(seed=11, steps=5)
+    b = _trajectory(seed=11, steps=5)
+    assert a == b
+
+
+def test_different_seeds_diverge():
+    a = _trajectory(seed=11, steps=3)
+    b = _trajectory(seed=12, steps=3)
+    assert a != b

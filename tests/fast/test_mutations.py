@@ -91,13 +91,15 @@ def test_python_engine_mutation_semantics():
     seqs = _genomes(200, 500, 8)
     rng = np.random.default_rng(3)
     counts = rng.poisson(1e-2 * np.array([len(s) for s in seqs]))
-    res = _pyengine.point_mutations_flat(seqs, counts, p_indel=0.4, p_del=0.66, seed=3)
+    res = _pyengine.point_mutations_flat(
+        seqs, counts, np.arange(len(seqs)), p_indel=0.4, p_del=0.66, seed=3
+    )
     assert len(res) > 150
     n_diff = sum(1 for seq, idx in res if seq != seqs[idx])
     assert n_diff > 0.5 * len(res)
     pairs = list(zip(seqs[:100], seqs[100:]))
     breaks = rng.poisson(1e-2 * np.array([len(a) + len(b) for a, b in pairs]))
-    rec = _pyengine.recombinations_flat(pairs, breaks, seed=3)
+    rec = _pyengine.recombinations_flat(pairs, breaks, np.arange(len(pairs)), seed=3)
     for a, b, idx in rec:
         s0, s1 = pairs[idx]
         assert len(a) + len(b) == len(s0) + len(s1)
@@ -125,3 +127,18 @@ def test_native_mutation_rates_match_python_statistically():
     assert [i for _, i in native] == [i for _, i in py]
     for (sn, _), (sp, _) in zip(native, py):
         assert abs(len(sn) - len(sp)) < 20
+
+
+def test_mutation_streams_are_batch_independent():
+    # a genome's mutation outcome depends only on (seed, its index, its
+    # pre-drawn count), not on which other genomes sit in the same call
+    seqs = _genomes(50, 800, 11)
+    full = {i: s for s, i in engine.point_mutations(seqs, 5e-3, 0.4, 0.66, seed=7)}
+    assert len(full) > 10
+    some_idx = sorted(full)[0]
+    # same lengths keep the vectorized Poisson pre-draw identical, but
+    # every other genome's content changes -> same batch composition,
+    # different neighbors; the target's outcome must not change
+    other = [seqs[j] if j == some_idx else "A" * len(seqs[j]) for j in range(len(seqs))]
+    solo = {i: s for s, i in engine.point_mutations(other, 5e-3, 0.4, 0.66, seed=7)}
+    assert solo[some_idx] == full[some_idx]

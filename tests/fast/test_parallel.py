@@ -140,10 +140,10 @@ def test_mesh_placed_world_full_lifecycle_matches_unsharded():
     # sharded reductions reorder float sums; drift accumulates over the 5
     # steps and amplifies near zero, hence the absolute tolerance
     np.testing.assert_allclose(
-        ws._host_molecule_map(), wu._host_molecule_map(), rtol=1e-4, atol=1e-3
+        ws._host_molecule_map(), wu._host_molecule_map(), rtol=1e-4, atol=5e-3
     )
     np.testing.assert_allclose(
-        ws.cell_molecules, wu.cell_molecules, rtol=1e-4, atol=1e-3
+        ws.cell_molecules, wu.cell_molecules, rtol=1e-4, atol=5e-3
     )
 
 
