@@ -63,6 +63,22 @@ def _domain_seq(world: "World", dom_type: int, tok_seqs: list[str]) -> str:
     return type_seq + "".join(tok_seqs)
 
 
+def _opt_parts(*pairs) -> list[str]:
+    """``(fmt, value)`` pairs -> formatted strings for the non-None values."""
+    return [fmt.format(v) for fmt, v in pairs if v is not None]
+
+
+def _with_opts(base: str, opts: list[str]) -> str:
+    return base if not opts else f"{base} | {' '.join(opts)}"
+
+
+def _mol_side(mols: list[Molecule]) -> str:
+    """``1 A + 2 B`` style summary with per-species counts (count first,
+    matching the containers' domain ``__str__`` format)."""
+    counts = Counter(str(m) for m in mols)
+    return " + ".join(f"{n} {name}" for name, n in counts.items())
+
+
 class CatalyticDomainFact:
     """
     Factory generating nucleotide sequences encoding a catalytic domain.
@@ -90,16 +106,18 @@ class CatalyticDomainFact:
 
     def validate(self, world: "World"):
         """Validate this domain factory's attributes against the world"""
-        all_reacts = [
-            (tuple(sorted(s)), tuple(sorted(p))) for s, p in world.chemistry.reactions
-        ]
-        all_reacts.extend([(p, s) for s, p in all_reacts])
-        if (tuple(self.substrates), tuple(self.products)) not in all_reacts:
+        want = (tuple(self.substrates), tuple(self.products))
+        known: set[tuple] = set()
+        for subs, prods in world.chemistry.reactions:
+            fwd = (tuple(sorted(subs)), tuple(sorted(prods)))
+            known.add(fwd)
+            known.add(fwd[::-1])
+        if want not in known:
             lft = " + ".join(d.name for d in self.substrates)
             rgt = " + ".join(d.name for d in self.products)
             raise ValueError(
-                f"CatalyticDomainFact has this reaction defined: {lft} <-> {rgt}."
-                " This world's chemistry doesn't define this reaction."
+                f"Cannot encode catalytic domain for {lft} <-> {rgt}:"
+                " no such reaction in this world's chemistry"
             )
 
     def gen_coding_sequence(self, world: "World") -> str:
@@ -136,25 +154,14 @@ class CatalyticDomainFact:
     def __repr__(self) -> str:
         ins = ",".join(str(d) for d in self.substrates)
         outs = ",".join(str(d) for d in self.products)
-        args = [f"{ins}<->{outs}"]
-        if self.km is not None:
-            args.append(f"Km={self.km:.2e}")
-        if self.vmax is not None:
-            args.append(f"Vmax={self.vmax:.2e}")
-        return f"CatalyticDomain({','.join(args)})"
+        opts = _opt_parts(("Km={:.2e}", self.km), ("Vmax={:.2e}", self.vmax))
+        return f"CatalyticDomain({','.join([f'{ins}<->{outs}', *opts])})"
 
     def __str__(self) -> str:
-        subs_cnts = Counter(str(d) for d in self.substrates)
-        prods_cnts = Counter(str(d) for d in self.products)
-        subs_str = " + ".join(f"{d} {k}" for k, d in subs_cnts.items())
-        prods_str = " + ".join(f"{d} {k}" for k, d in prods_cnts.items())
-        optargs = []
-        if self.km is not None:
-            optargs.append(f"Km {self.km:.2e}")
-        if self.vmax is not None:
-            optargs.append(f"Vmax {self.vmax:.2e}")
-        args = f"{subs_str} <-> {prods_str}"
-        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+        base = f"{_mol_side(self.substrates)} <-> {_mol_side(self.products)}"
+        return _with_opts(
+            base, _opt_parts(("Km {:.2e}", self.km), ("Vmax {:.2e}", self.vmax))
+        )
 
 
 class TransporterDomainFact:
@@ -184,8 +191,8 @@ class TransporterDomainFact:
         """Validate this domain factory's attributes against the world"""
         if self.molecule not in world.chemistry.molecules:
             raise ValueError(
-                f"TransporterDomainFact has this molecule defined: {self.molecule}."
-                " This world's chemistry doesn't define this molecule species."
+                f"Cannot encode transporter domain for {self.molecule}:"
+                " no such molecule species in this world's chemistry"
             )
 
     def gen_coding_sequence(self, world: "World") -> str:
@@ -221,27 +228,24 @@ class TransporterDomainFact:
             is_exporter=dct.get("is_exporter"),
         )
 
+    def _kind(self) -> str | None:
+        if self.is_exporter is None:
+            return None
+        return "exporter" if self.is_exporter else "importer"
+
     def __repr__(self) -> str:
-        args = [str(self.molecule)]
-        if self.km is not None:
-            args.append(f"Km={self.km:.2e}")
-        if self.vmax is not None:
-            args.append(f"Vmax={self.vmax:.2e}")
-        if self.is_exporter is not None:
-            args.append("exporter" if self.is_exporter else "importer")
-        return f"TransporterDomain({','.join(args)})"
+        opts = _opt_parts(
+            ("Km={:.2e}", self.km),
+            ("Vmax={:.2e}", self.vmax),
+            ("{}", self._kind()),
+        )
+        return f"TransporterDomain({','.join([str(self.molecule), *opts])})"
 
     def __str__(self) -> str:
-        optargs = []
-        if self.km is not None:
-            optargs.append(f"Km {self.km:.2e}")
-        if self.vmax is not None:
-            optargs.append(f"Vmax {self.vmax:.2e}")
-        sign = "transporter"
-        if self.is_exporter is not None:
-            sign = "exporter" if self.is_exporter else "importer"
-        args = f"{self.molecule} {sign}"
-        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+        base = f"{self.molecule} {self._kind() or 'transporter'}"
+        return _with_opts(
+            base, _opt_parts(("Km {:.2e}", self.km), ("Vmax {:.2e}", self.vmax))
+        )
 
 
 class RegulatoryDomainFact:
@@ -277,8 +281,8 @@ class RegulatoryDomainFact:
         """Validate this domain factory's attributes against the world"""
         if self.effector not in world.chemistry.molecules:
             raise ValueError(
-                f"RegulatoryDomainFact has this effector defined: {self.effector}."
-                " This world's chemistry doesn't define this molecule species."
+                f"Cannot encode regulatory domain with effector {self.effector}:"
+                " no such molecule species in this world's chemistry"
             )
 
     def gen_coding_sequence(self, world: "World") -> str:
@@ -322,29 +326,30 @@ class RegulatoryDomainFact:
             is_transmembrane=dct["is_transmembrane"],
         )
 
+    def _mode(self) -> str | None:
+        if self.is_inhibiting is None:
+            return None
+        return "inhibitor" if self.is_inhibiting else "activator"
+
     def __repr__(self) -> str:
-        args = [f"{self.effector}"]
-        if self.km is not None:
-            args.append(f"Km={self.km:.2e}")
-        if self.hill is not None:
-            args.append(f"hill={self.hill}")
-        args.append("transmembrane" if self.is_transmembrane else "cytosolic")
+        # same vocabulary as containers.RegulatoryDomain.__repr__
+        mode = None
         if self.is_inhibiting is not None:
-            args.append("inhibiting" if self.is_inhibiting else "activating")
-        return f"ReceptorDomain({','.join(args)})"
+            mode = "inhibiting" if self.is_inhibiting else "activating"
+        opts = _opt_parts(
+            ("Km={:.2e}", self.km),
+            ("hill={}", self.hill),
+            ("{}", "transmembrane" if self.is_transmembrane else "cytosolic"),
+            ("{}", mode),
+        )
+        return f"ReceptorDomain({','.join([str(self.effector), *opts])})"
 
     def __str__(self) -> str:
         loc = "[e]" if self.is_transmembrane else "[i]"
-        eff = "effector"
-        if self.is_inhibiting is not None:
-            eff = " inhibitor" if self.is_inhibiting else " activator"
-        args = f"{self.effector}{loc} {eff}"
-        optargs = []
-        if self.km is not None:
-            optargs.append(f"Km {self.km:.2e}")
-        if self.hill is not None:
-            optargs.append(f"Hill {self.hill}")
-        return args if len(optargs) == 0 else args + " | " + " ".join(optargs)
+        base = f"{self.effector}{loc} {self._mode() or 'effector'}"
+        return _with_opts(
+            base, _opt_parts(("Km {:.2e}", self.km), ("Hill {}", self.hill))
+        )
 
 
 class GenomeFact:

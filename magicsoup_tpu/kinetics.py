@@ -424,7 +424,7 @@ class Kinetics:
                 A=g(params.A, cps),
             )
 
-        kwargs = {}
+        kwargs = {"donate_argnums": 0}
         if self.cell_sharding is not None:
             kwargs["out_shardings"] = CellParams(*([self.cell_sharding] * 9))
         self.params = jax.jit(_grow, **kwargs)(old)

@@ -130,6 +130,16 @@ def _set_rows(
 
 
 @jax.jit
+def _add_at(
+    cell_molecules: jax.Array,
+    idxs: jax.Array,  # (b_pad,); padding OOB
+    col: jax.Array,  # scalar int — molecule column
+    delta: jax.Array,  # scalar float
+) -> jax.Array:
+    return cell_molecules.at[idxs, col].add(delta, mode="drop")
+
+
+@jax.jit
 def _spill_molecules(
     molecule_map: jax.Array,
     cell_molecules: jax.Array,
@@ -382,6 +392,63 @@ class World:
         """
         return self._host_cell_molecules()[: self.n_cells]
 
+    def _slice_column_async(self, mol_idx: int) -> jax.Array:
+        """Dispatch the (static-capacity) column slice and start its
+        device→host copy; returns the in-flight device array."""
+        col = self._cell_molecules[:, mol_idx]
+        try:
+            col.copy_to_host_async()
+        except AttributeError:  # non-jax array stand-ins in tests
+            pass
+        return col
+
+    def prefetch_cell_molecule_column(self, mol_idx: int):
+        """
+        Start an async device→host copy of one molecule column.  Call
+        right after dispatching the device work that produces it (e.g.
+        ``enzymatic_activity``) so the transfer overlaps the computation
+        and — on remote accelerators — the request's network round trip.
+        A later :meth:`cell_molecule_column` for the same state picks up
+        the in-flight copy instead of starting a fresh one.
+        """
+        self._col_prefetch = (self._cell_molecules, mol_idx,
+                              self._slice_column_async(mol_idx))
+
+    def cell_molecule_column(self, mol_idx: int) -> np.ndarray:
+        """
+        (n_cells,) float32 host copy of ONE molecule's intracellular
+        concentrations.  ~n_mols× less device→host traffic than the full
+        ``cell_molecules`` matrix — use for per-step selection thresholds
+        (the canonical workload only ever looks at ATP).
+
+        The slice is taken at the full (static) slot capacity so XLA
+        compiles it once, not once per population size.
+        """
+        pf = getattr(self, "_col_prefetch", None)
+        if (
+            pf is not None
+            and pf[0] is self._cell_molecules
+            and pf[1] == mol_idx
+        ):
+            col = pf[2]
+        else:
+            col = self._slice_column_async(mol_idx)
+        self._col_prefetch = None
+        return np.asarray(col)[: self.n_cells]
+
+    def add_cell_molecules(self, cell_idxs: list[int], mol_idx: int, delta: float):
+        """Add ``delta`` to one molecule of the given cells on device —
+        avoids a full fetch-modify-push round trip of ``cell_molecules``."""
+        if len(cell_idxs) == 0:
+            return
+        idxs_pad = pad_idxs(np.asarray(cell_idxs, dtype=np.int32), oob=self._capacity)
+        self._cell_molecules = _add_at(
+            self._cell_molecules,
+            jnp.asarray(idxs_pad),
+            jnp.asarray(mol_idx, dtype=jnp.int32),
+            jnp.asarray(delta, dtype=jnp.float32),
+        )
+
     @cell_molecules.setter
     def cell_molecules(self, value):
         value = np.asarray(value, dtype=np.float32)
@@ -549,7 +616,7 @@ class World:
         # 1D-encoded unique (np.unique(axis=0) goes through a slow
         # void-dtype view; this is ~100x faster at 10k cells)
         enc = np.unique(lo * np.int64(self.n_cells) + hi)
-        return [(int(e // self.n_cells), int(e % self.n_cells)) for e in enc.tolist()]
+        return list(zip((enc // self.n_cells).tolist(), (enc % self.n_cells).tolist()))
 
     # ------------------------------------------------------------------ #
     # cell lifecycle                                                     #
@@ -1018,6 +1085,7 @@ class World:
         state["_perm_factors"] = np.asarray(self._perm_factors)
         state["_degrad_factors"] = np.asarray(self._degrad_factors)
         state.pop("_positions_dev")
+        state.pop("_col_prefetch", None)
         state["_mm_cache"] = None
         state["_cm_cache"] = None
         # meshes/shardings are bound to live devices — a restored world is

@@ -1,0 +1,111 @@
+"""
+Steady-state per-phase profile of the canonical workload step.
+
+Unlike `run_simulation.py` (which averages from step 0 and therefore mixes
+the initial population ramp into the numbers), this warms the world up to
+its steady state first, then times each phase over N further steps, and
+optionally captures a `jax.profiler` trace of the hot phases.
+
+    python performance/profile_step.py --n-cells 10000 --map-size 128
+
+Also prints the device round-trip latency (tiny transfer) so remote-tunnel
+overhead is visible separately from compute.
+"""
+import json
+import random
+import statistics
+import sys
+import time
+from argparse import ArgumentParser
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def main() -> None:
+    ap = ArgumentParser()
+    ap.add_argument("--n-cells", type=int, default=10_000)
+    ap.add_argument("--map-size", type=int, default=128)
+    ap.add_argument("--genome-size", type=int, default=500)
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--trace-dir", type=str, default=None,
+                    help="capture a jax.profiler trace of the timed steps")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+    from workload import sim_step
+
+    # device round-trip latency: median of 20 tiny fetches
+    x = jax.device_put(np.zeros(4, dtype=np.float32))
+    jax.block_until_ready(x)
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(x + 1.0)
+        rtts.append(time.perf_counter() - t0)
+    rtt = statistics.median(rtts)
+
+    rng = random.Random(args.seed)
+    world = ms.World(chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed)
+    world.spawn_cells(
+        [ms.random_genome(s=args.genome_size, rng=rng) for _ in range(args.n_cells)]
+    )
+    atp = CHEMISTRY.molname_2_idx["ATP"]
+
+    times: dict[str, list[float]] = defaultdict(list)
+
+    @contextmanager
+    def timeit(label: str):
+        t0 = time.perf_counter()
+        yield
+        times[label].append(time.perf_counter() - t0)
+
+    def step(record: bool) -> None:
+        sim_step(
+            world,
+            rng,
+            n_cells=args.n_cells,
+            genome_size=args.genome_size,
+            atp_idx=atp,
+            timeit=timeit if record else None,
+            sync=True,
+        )
+
+    for _ in range(args.warmup):
+        sim_step(world, rng, n_cells=args.n_cells,
+                 genome_size=args.genome_size, atp_idx=atp, sync=True)
+
+    if args.trace_dir:
+        jax.profiler.start_trace(args.trace_dir)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step(record=True)
+    total = time.perf_counter() - t0
+    if args.trace_dir:
+        jax.profiler.stop_trace()
+
+    per_step = total / args.steps
+    print(json.dumps({
+        "device": str(jax.devices()[0]),
+        "rtt_ms": round(rtt * 1e3, 3),
+        "n_cells_end": world.n_cells,
+        "s_per_step": round(per_step, 4),
+        "steps_per_s": round(1.0 / per_step, 3),
+    }))
+    for label, vals in sorted(times.items(), key=lambda kv: -sum(kv[1])):
+        print(f"  {label:20s} mean {statistics.mean(vals)*1e3:8.1f} ms"
+              f"  median {statistics.median(vals)*1e3:8.1f} ms"
+              f"  max {max(vals)*1e3:8.1f} ms  n={len(vals)}")
+
+
+if __name__ == "__main__":
+    main()

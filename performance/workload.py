@@ -52,24 +52,26 @@ def sim_step(
 
     with timeit("activity"):
         world.enzymatic_activity()
+        # start the ATP-column device→host copy now: it overlaps the
+        # integrator's device time and the request's network round trip
+        world.prefetch_cell_molecule_column(atp_idx)
 
-    # ONE device fetch drives both selections: killing only compacts rows
-    # (it does not change survivors' contents), so the post-kill state is
-    # host-computable from the pre-kill snapshot — on a remote accelerator
-    # every fetch costs a round trip
+    # ONE device fetch drives both selections, and only the ATP column is
+    # transferred: killing only compacts rows (it does not change
+    # survivors' contents), so the post-kill ATP levels are host-computable
+    # from the pre-kill snapshot — on a remote accelerator every fetch
+    # costs a round trip, and the full matrix costs n_mols× the bytes
     with timeit("kill"):
-        cm = world.cell_molecules
-        atp = cm[:, atp_idx]
+        atp = world.cell_molecule_column(atp_idx)
         kill_mask = atp < KILL_BELOW_ATP
         world.kill_cells(cell_idxs=np.nonzero(kill_mask)[0].tolist())
 
     with timeit("replicate"):
-        keep = ~kill_mask
-        cm_after = cm[keep]  # advanced indexing: already a fresh array
-        repl = np.nonzero(cm_after[:, atp_idx] > DIVIDE_ABOVE_ATP)[0]
+        atp_after = atp[~kill_mask]  # kill compaction is stable
+        repl = np.nonzero(atp_after > DIVIDE_ABOVE_ATP)[0]
         if len(repl):
-            cm_after[repl, atp_idx] -= DIVIDE_COST_ATP
-            world.cell_molecules = cm_after
+            # division cost is paid on device; no full-matrix push
+            world.add_cell_molecules(repl.tolist(), atp_idx, -DIVIDE_COST_ATP)
             world.divide_cells(cell_idxs=repl.tolist())
 
     with timeit("recombinateGenomes"):

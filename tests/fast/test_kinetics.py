@@ -368,3 +368,590 @@ def test_get_proteome_interpretation():
     assert reg.hill == 3
     assert reg.is_inhibiting
     assert reg.is_transmembrane  # effector token 6 = signal 5 = ext b
+
+
+# --------------------------------------------------------------------- #
+# raw-parameter golden tests (reference tests/fast/test_kinetics.py     #
+# :1046-:2234): parameter tensors are injected directly so every piece  #
+# of integrator arithmetic can be checked against hand-computed values  #
+# --------------------------------------------------------------------- #
+
+
+def _raw_params(Ke, Kmf, Kmb, Vmax, N, Kmr=None, A=None, Nf=None, Nb=None):
+    """CellParams from literal numpy arrays (Nf/Nb default to the +/-
+    split of N; Kmr/A default to no regulation)."""
+    N = np.asarray(N, dtype=np.int32)
+    c, p, s = N.shape
+    if Nf is None:
+        Nf = np.where(N < 0, -N, 0)
+    if Nb is None:
+        Nb = np.where(N > 0, N, 0)
+    if Kmr is None:
+        Kmr = np.zeros((c, p, s), dtype=np.float32)
+    if A is None:
+        A = np.zeros((c, p, s), dtype=np.int32)
+    return integ.CellParams(
+        Ke=jnp.asarray(np.asarray(Ke, dtype=np.float32)),
+        Kmf=jnp.asarray(np.asarray(Kmf, dtype=np.float32)),
+        Kmb=jnp.asarray(np.asarray(Kmb, dtype=np.float32)),
+        Kmr=jnp.asarray(np.asarray(Kmr, dtype=np.float32)),
+        Vmax=jnp.asarray(np.asarray(Vmax, dtype=np.float32)),
+        N=jnp.asarray(N),
+        Nf=jnp.asarray(np.asarray(Nf, dtype=np.int32)),
+        Nb=jnp.asarray(np.asarray(Nb, dtype=np.int32)),
+        A=jnp.asarray(np.asarray(A, dtype=np.int32)),
+    )
+
+
+def _single_pass(X0, p) -> np.ndarray:
+    """One untrimmed integrator pass without equilibrium adjustment — the
+    reference's `_MockedKinetics.integrate_signals` (test_kinetics.py:87-97)."""
+    X = jnp.asarray(np.asarray(X0, dtype=np.float32))
+    V = integ._velocities(X, p.Vmax, p)
+    NV = p.N.astype(jnp.float32) * V[:, :, None]
+    NV_adj = integ._negative_adjusted_nv(NV, X)
+    X1 = np.array(X + jnp.sum(NV_adj, axis=1))
+    X1[X1 < 0.0] = 0.0
+    return X1
+
+
+def _mm(s, p, kf, kb, v):
+    """reversible MM velocity for 1 substrate / 1 product (hand math)"""
+    return v * (s / kf - p / kb) / (1 + s / kf + p / kb)
+
+
+def test_mm_kinetic_with_proportions():
+    # cell 0: P0: a -> 2b, P1: 2c -> d;  cell 1: P0: 3b -> 2c
+    # (reference test_kinetics.py:1046)
+    X0 = np.array([[1.1, 0.1, 2.9, 0.8], [1.2, 4.9, 5.1, 1.4]])
+    N = [
+        [[-1, 2, 0, 0], [0, 0, -2, 1], [0, 0, 0, 0]],
+        [[0, -3, 2, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Kmf = [[1.3, 2.1, 1.0], [1.4, 1.0, 1.0]]
+    Kmb = [[0.3, 1.1, 1.0], [1.5, 1.0, 1.0]]
+    Vmax = [[2.1, 1.1, 0.0], [1.9, 0.0, 0.0]]
+    p = _raw_params(np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N)
+
+    def mm_pow(s, ns, pr, np_, kf, kb, v):
+        fw = s**ns / kf
+        bw = pr**np_ / kb
+        return v * (fw - bw) / (1 + fw + bw)
+
+    v00 = mm_pow(X0[0, 0], 1, X0[0, 1], 2, 1.3, 0.3, 2.1)
+    v01 = mm_pow(X0[0, 2], 2, X0[0, 3], 1, 2.1, 1.1, 1.1)
+    v10 = mm_pow(X0[1, 1], 3, X0[1, 2], 2, 1.4, 1.5, 1.9)
+    want = np.array([
+        [-v00, 2 * v00 - 0, -2 * v01, v01],
+        [0.0, -3 * v10, 2 * v10, 0.0],
+    ])
+    Xd = _single_pass(X0, p) - X0
+    np.testing.assert_allclose(Xd, want, atol=1e-4)
+
+
+def test_mm_kinetic_with_multiple_substrates():
+    # cell 0: P0: a,b -> c, P1: b,d -> 2a,c;  cell 1: P0: a,d -> b
+    # (reference test_kinetics.py:1147)
+    X0 = np.array([[1.1, 2.1, 2.9, 0.8], [2.3, 0.4, 1.1, 3.2]])
+    N = [
+        [[-1, -1, 1, 0], [2, -1, 1, -1], [0, 0, 0, 0]],
+        [[-1, 1, 0, -1], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Kmf = [[1.3, 2.1, 1.0], [1.4, 1.0, 1.0]]
+    Kmb = [[0.3, 1.1, 1.0], [1.5, 1.0, 1.0]]
+    Vmax = [[2.1, 1.1, 0.0], [1.2, 0.0, 0.0]]
+    p = _raw_params(np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N)
+
+    def mm_nm(fw, bw, v):
+        return v * (fw - bw) / (1 + fw + bw)
+
+    v00 = mm_nm(X0[0, 0] * X0[0, 1] / 1.3, X0[0, 2] / 0.3, 2.1)
+    v01 = mm_nm(
+        X0[0, 1] * X0[0, 3] / 2.1, X0[0, 0] ** 2 * X0[0, 2] / 1.1, 1.1
+    )
+    v10 = mm_nm(X0[1, 0] * X0[1, 3] / 1.4, X0[1, 1] / 1.5, 1.2)
+    want = np.array([
+        [-v00 + 2 * v01, -v00 - v01, v00 + v01, -v01],
+        [-v10, v10, 0.0, -v10],
+    ])
+    Xd = _single_pass(X0, p) - X0
+    np.testing.assert_allclose(Xd, want, atol=1e-4)
+
+
+def test_mm_kinetic_with_cofactors():
+    # N is 0 for a cofactor but it is still required on both sides
+    # cell 0: P0: a -> b | b -> c;  cell 1: P0: a + c -> b + c
+    # (reference test_kinetics.py:1245)
+    X0 = np.array([[10.0, 0.1, 3.0, 0.8], [10.0, 3.0, 0.1, 0.0]])
+    N = [
+        [[-1, 0, 1, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+        [[-1, 1, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Nf = [
+        [[1, 1, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+        [[1, 0, 1, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Nb = [
+        [[0, 1, 1, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+        [[0, 1, 1, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Kmf = [[2.0, 1.0, 1.0], [2.0, 1.0, 1.0]]
+    Kmb = [[1.0, 1.0, 1.0], [1.0, 1.0, 1.0]]
+    Vmax = [[1.0, 0.0, 0.0], [1.0, 0.0, 0.0]]
+    p = _raw_params(
+        np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N, Nf=Nf, Nb=Nb
+    )
+
+    def mm_nm(fw, bw, v):
+        return v * (fw - bw) / (1 + fw + bw)
+
+    v00 = mm_nm(X0[0, 0] * X0[0, 1] / 2.0, X0[0, 1] * X0[0, 2] / 1.0, 1.0)
+    v10 = mm_nm(X0[1, 0] * X0[1, 2] / 2.0, X0[1, 1] * X0[1, 2] / 1.0, 1.0)
+    want = np.array([
+        [-v00, 0.0, v00, 0.0],
+        [-v10, v10, 0.0, 0.0],
+    ])
+    Xd = _single_pass(X0, p) - X0
+    np.testing.assert_allclose(Xd, want, atol=1e-4)
+
+
+def test_mm_kinetic_with_allosteric_action():
+    # multi-effector allosteric modulation (reference test_kinetics.py:1353)
+    # cell 0: P0: a->b inh c, P1: c->d act a, P2: a->b inh c + act d
+    # cell 1: P0: a->b inh c,d, P1: c->d act a,b
+    X0 = np.array([[2.1, 3.5, 1.9, 2.0], [3.2, 1.6, 4.0, 1.9]])
+    N = [
+        [[-1, 1, 0, 0], [0, 0, -1, 1], [-1, 1, 0, 0]],
+        [[-1, 1, 0, 0], [0, 0, -1, 1], [0, 0, 0, 0]],
+    ]
+    Kmf = [[1.3, 2.1, 0.9], [1.4, 2.2, 1.0]]
+    Kmb = [[1.1, 1.1, 1.0], [1.5, 1.9, 1.0]]
+    KmrBase = [
+        [[1.0, 1.0, 1.3, 1.0], [2.1, 1.0, 1.0, 1.0], [1.0, 1.0, 0.9, 0.9]],
+        [[1.0, 1.0, 1.4, 1.4], [2.2, 2.2, 1.0, 1.0], [1.0, 1.0, 1.0, 1.0]],
+    ]
+    A = [
+        [[0, 0, -1, 0], [1, 0, 0, 0], [0, 0, -1, 1]],
+        [[0, 0, -1, -1], [1, 1, 0, 0], [0, 0, 0, 0]],
+    ]
+    Vmax = [[2.1, 2.0, 1.0], [3.2, 2.5, 0.0]]
+    # stored Kmr is Km^A (set_cell_params does the pow)
+    Kmr = np.power(np.array(KmrBase), np.array(A))
+    p = _raw_params(
+        np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N, Kmr=Kmr, A=A
+    )
+
+    def al(x, k, n):
+        return x**n / (k**n + x**n)
+
+    v00 = _mm(X0[0, 0], X0[0, 1], 1.3, 1.1, 2.1) * al(X0[0, 2], 1.3, -1)
+    v01 = _mm(X0[0, 2], X0[0, 3], 2.1, 1.1, 2.0) * al(X0[0, 0], 2.1, 1)
+    v02 = (
+        _mm(X0[0, 0], X0[0, 1], 0.9, 1.0, 1.0)
+        * al(X0[0, 2], 0.9, -1)
+        * al(X0[0, 3], 0.9, 1)
+    )
+    v10 = (
+        _mm(X0[1, 0], X0[1, 1], 1.4, 1.5, 3.2)
+        * al(X0[1, 2], 1.4, -1)
+        * al(X0[1, 3], 1.4, -1)
+    )
+    v11 = (
+        _mm(X0[1, 2], X0[1, 3], 2.2, 1.9, 2.5)
+        * al(X0[1, 0], 2.2, 1)
+        * al(X0[1, 1], 2.2, 1)
+    )
+    want = np.array([
+        [-v00 - v02, v00 + v02, -v01, v01],
+        [-v10, v10, -v11, v11],
+    ])
+    Xd = _single_pass(X0, p) - X0
+    np.testing.assert_allclose(Xd, want, atol=1e-4)
+
+
+def test_reduce_velocity_to_avoid_negative_concentrations():
+    # cell 0: P0: a -> b (too little a), P1: b -> d
+    # cell 1: P0: 2c -> d (too little c)  (reference test_kinetics.py:1479)
+    X0 = np.array([[0.1, 1.0, 2.9, 0.8], [2.9, 3.1, 0.1, 0.3]])
+    N = [
+        [[-1, 1, 0, 0], [0, -1, 0, 1], [0, 0, 0, 0]],
+        [[0, 0, -2, 1], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Kmf = [[0.1, 2.1, 1.0], [0.1, 1.0, 1.0]]
+    Kmb = [[10.3, 1.1, 1.0], [10.5, 1.0, 1.0]]
+    Vmax = [[2.1, 1.0, 0.0], [3.1, 0.0, 0.0]]
+    p = _raw_params(np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N)
+
+    v00 = _mm(X0[0, 0], X0[0, 1], 0.1, 10.3, 2.1)
+    v01 = _mm(X0[0, 1], X0[0, 3], 2.1, 1.1, 1.0)
+    assert X0[0, 0] - v00 < 0.0  # would go negative
+    v00 = X0[0, 0]  # slowed down to exactly consume what's there
+
+    def mm21(s, pr, kf, kb, v):
+        fw = s**2 / kf
+        bw = pr / kb
+        return v * (fw - bw) / (1 + fw + bw)
+
+    v10 = mm21(X0[1, 2], X0[1, 3], 0.1, 10.5, 3.1)
+    assert X0[1, 2] - 2 * v10 < 0.0
+    v10 = X0[1, 2] / 2.0
+
+    want = np.array([
+        [-v00, v00 - v01, 0.0, v01],
+        [0.0, 0.0, -2 * v10, v10],
+    ])
+    X1 = _single_pass(X0, p)
+    np.testing.assert_allclose(X1 - X0, want, atol=1e-4)
+    assert not np.any(X1 < 0.0)
+
+
+def test_reduce_velocity_in_multiple_proteins():
+    # two proteins of one cell share a limiting substrate; both must slow
+    # down by the same factor (reference test_kinetics.py:1589)
+    X0 = np.array([[2.0, 1.2, 2.9, 1.5], [2.9, 3.1, 0.1, 1.0]])
+    N = [
+        [[-1, 1, 0, 0], [-2, 0, 0, 1], [0, 0, 0, 0]],
+        [[-1, 1, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Kmf = [[0.1, 2.1, 1.0], [0.1, 1.0, 1.0]]
+    Kmb = [[10.3, 1.1, 1.0], [1.5, 1.0, 1.0]]
+    Vmax = [[3.1, 2.0, 0.0], [3.1, 0.0, 0.0]]
+    p = _raw_params(np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N)
+
+    def mm21(s, pr, kf, kb, v):
+        fw = s**2 / kf
+        bw = pr / kb
+        return v * (fw - bw) / (1 + fw + bw)
+
+    v00 = _mm(X0[0, 0], X0[0, 1], 0.1, 10.3, 3.1)
+    v01 = mm21(X0[0, 0], X0[0, 3], 2.1, 1.1, 2.0)
+    naive_da = -v00 - 2 * v01
+    assert X0[0, 0] + naive_da < 0.0
+    f = X0[0, 0] / -naive_da
+    v00, v01 = v00 * f, v01 * f
+    v10 = _mm(X0[1, 0], X0[1, 1], 0.1, 1.5, 3.1)
+    want = np.array([
+        [-v00 - 2 * v01, v00, 0.0, v01],
+        [-v10, v10, 0.0, 0.0],
+    ])
+    X1 = _single_pass(X0, p)
+    np.testing.assert_allclose(X1 - X0, want, atol=1e-4)
+    assert not np.any(X1 < 0.0)
+
+
+def test_multiply_signals_golden():
+    # 0^0 pitfalls, float32 overflow saturation (reference :1697)
+    X = np.array([
+        [1.0, 2.0, 3.0, 4.0],
+        [100.0, 200.0, 300.0, 400.0],
+        [0.0, 0.0, 3.0, 4.0],
+        [0.0, 0.0, 0.0, 0.0],
+    ], dtype=np.float32)
+    N = np.array([
+        [[0, 1, 2, 0], [3, 0, 0, 0], [0, 0, 0, 0]],
+        [[10, 10, 5, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+        [[2, 1, 2, 0], [0, 0, 1, 2], [0, 0, 0, 0]],
+        [[1, 1, 1, 1], [1, 2, 0, 0], [0, 0, 0, 0]],
+    ], dtype=np.int32)
+    xx, prots = integ._multiply_signals(jnp.asarray(X), jnp.asarray(N))
+    xx = np.asarray(xx)
+    prots = np.asarray(prots)
+    np.testing.assert_array_equal(
+        prots,
+        [[True, True, False], [True, False, False],
+         [True, True, False], [True, True, False]],
+    )
+    assert xx[0, 0] == pytest.approx(2.0 * 3.0**2)
+    assert xx[0, 1] == pytest.approx(1.0)
+    assert xx[1, 0] == MAX  # 100^10 * 200^10 * 300^5 overflows f32
+    assert xx[2, 0] == 0.0  # 0^2 * ... = 0
+    assert xx[2, 1] == pytest.approx(3.0 * 4.0**2)
+    assert xx[3, 0] == 0.0
+    assert xx[3, 1] == 0.0
+
+
+def test_get_quotient_golden():
+    # Q -> Ke golden values incl. MAX/MAX, x/0 and 0/x clamps (ref :1780)
+    X = np.array([
+        [1.0, 2.0, 3.0, 4.0],
+        [100.0, 200.0, 300.0, 400.0],
+        [0.0, 0.0, 10.0, 20.0],
+    ], dtype=np.float32)
+    Nf = np.array([
+        [[1, 0, 0, 0], [0, 1, 0, 1], [0, 2, 1, 0]],
+        [[5, 7, 0, 0], [0, 0, 20, 0], [1, 0, 0, 0]],
+        [[1, 0, 3, 0], [0, 0, 1, 0], [1, 0, 0, 0]],
+    ], dtype=np.int32)
+    Nb = np.array([
+        [[0, 1, 0, 0], [0, 0, 1, 0], [3, 0, 0, 0]],
+        [[0, 0, 10, 0], [0, 0, 0, 30], [0, 0, 0, 0]],
+        [[0, 0, 0, 2], [2, 0, 0, 0], [0, 1, 0, 0]],
+    ], dtype=np.int32)
+    c, p, s = Nf.shape
+    params = _raw_params(
+        np.ones((c, p)), np.ones((c, p)), np.ones((c, p)),
+        np.zeros((c, p)), np.zeros((c, p, s), dtype=np.int32),
+        Nf=Nf, Nb=Nb,
+    )
+    Q = np.asarray(integ._quotient(jnp.asarray(X), params))
+    x = X[0]
+    assert Q[0, 0] == pytest.approx(x[1] / x[0])
+    assert Q[0, 1] == pytest.approx(x[2] / (x[1] * x[3]))
+    assert Q[0, 2] == pytest.approx(x[0] ** 3 / (x[1] ** 2 * x[2]))
+    x = X[1].astype(np.float64)
+    assert Q[1, 0] == pytest.approx(
+        float(x[2] ** 10 / (x[0] ** 5 * x[1] ** 7)), rel=1e-4
+    )
+    assert Q[1, 1] == pytest.approx(1.0)  # MAX / MAX (both overflow)
+    assert Q[2, 0] == MAX  # substrate zero -> Inf -> clamp
+    assert Q[2, 1] == EPS  # product zero -> 0 -> clamp
+    assert Q[2, 2] == pytest.approx(1.0)  # 0/0 -> NaN -> 1
+
+
+def test_zeros_dont_stop_reactions():
+    # products must be creatable from zero concentrations (ref :1856)
+    # P0: A + B <-> C (+5 kJ), P1: 3A <-> C (-10 kJ); only A present
+    X = np.zeros((1, 6), dtype=np.float32)
+    X[0, 0] = 3.0
+    N = [[[-1, -1, 1, 0, 0, 0], [-3, 0, 1, 0, 0, 0]]]
+    Kmf = [[7.3328, 1.0539]]
+    Kmb = [[1.0539, 5.1021]]
+    Vmax = [[0.3, 0.3]]
+    p = _raw_params(np.array(Kmb) / np.array(Kmf), Kmf, Kmb, Vmax, N)
+
+    X1 = np.asarray(integ.integrate_signals(jnp.asarray(X), p))
+    assert 0.0 < X1[0, 0] < 3.0
+    assert 0.0 < X1[0, 1] < 1.0
+    assert 0.0 < X1[0, 2] < 1.0
+    assert X1[0, 0] > X1[0, 2]
+
+    X2 = np.asarray(integ.integrate_signals(jnp.asarray(X1), p))
+    assert 0.0 < X2[0, 0] < X1[0, 0]
+    assert X2[0, 1] > X1[0, 1]
+    assert X2[0, 0] > X2[0, 2]
+
+
+def test_equilibrium_is_quickly_reached():
+    # high-order reactions overshoot; correction must converge (ref :1918)
+    X0 = np.array([
+        [100.0, 0.0, 0.0, 100.0],
+        [100.0, 100.0, 0.0, 0.0],
+    ], dtype=np.float32)
+    N = [
+        [[-1, 1, 0, 0], [0, 0, -1, 1], [0, 0, 0, 0]],
+        [[-5, -5, 5, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+    ]
+    Ke = [[1.0, 1.0, 1.0], [1.0, 1.0, 1.0]]
+    Kmf = [[1.0, 1.0, 1.0], [1.0, 1.0, 1.0]]
+    Vmax = [[100.0, 100.0, 0.0], [100.0, 0.0, 0.0]]
+    p = _raw_params(Ke, Kmf, Kmf, Vmax, N)
+
+    def q_c0_0(x):
+        return float(x[0, 1] / max(x[0, 0], 1e-30))
+
+    def q_c0_1(x):
+        return float(x[0, 3] / max(x[0, 2], 1e-30))
+
+    def diff(q, ke=1.0):
+        if q == 0.0:
+            return MAX
+        return q / ke if q / ke > 1.0 else ke / q
+
+    X1 = np.asarray(integ.integrate_signals(jnp.asarray(X0), p))
+    assert diff(q_c0_0(X1)) <= diff(q_c0_0(X0))
+    assert diff(q_c0_1(X1)) <= diff(q_c0_1(X0))
+    assert q_c0_0(X1) == pytest.approx(1.0, rel=0.5)
+    assert q_c0_1(X1) == pytest.approx(1.0, rel=0.5)
+
+    X2 = np.asarray(integ.integrate_signals(jnp.asarray(X1), p))
+    assert diff(q_c0_0(X2)) <= diff(q_c0_0(X1)) + 1e-6
+    assert diff(q_c0_1(X2)) <= diff(q_c0_1(X1)) + 1e-6
+
+    X3 = np.asarray(integ.integrate_signals(jnp.asarray(X2), p))
+    q31 = float(X3[1, 2] ** 5 / max(X3[1, 0] ** 5 * X3[1, 1] ** 5, 1e-30))
+    assert q31 == pytest.approx(1.0, rel=0.5)
+    # stoichiometry respected: cell 0 reactions are 1:1, sum conserved
+    assert X3[0].sum() == pytest.approx(X0[0].sum(), rel=1e-3)
+
+
+def test_get_negative_adjusted_nv_golden():
+    # 3-cell golden case incl. shared limiting substrates (ref :2023)
+    X0 = np.array([
+        [1.0, 0.0, 10.0, 0.0],
+        [10.0, 0.0, 1.0, 0.0],
+        [10.0, 0.0, 5.0, 5.0],
+    ], dtype=np.float32)
+    NV = np.array([
+        [[-100, 100, -10, 10], [0, 0, -10, 10], [0, 0, 0, 0]],
+        [[-10, 10, 0, 0], [0, 0, -100, 100], [0, 0, 0, 0]],
+        [[-5, 5, 0, 0], [0, 0, -10, 10], [0, 0, 10, -10]],
+    ], dtype=np.float32)
+    NV_adj = np.asarray(
+        integ._negative_adjusted_nv(jnp.asarray(NV), jnp.asarray(X0))
+    )
+    X1 = X0 + NV_adj.sum(1)
+
+    np.testing.assert_allclose(
+        NV_adj[0],
+        [[-1.0, 1.0, -0.1, 0.1], [0, 0, -5.0, 5.0], [0, 0, 0, 0]],
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(X1[0], [0.0, 1.0, 4.9, 5.1], atol=1e-4)
+    np.testing.assert_allclose(
+        NV_adj[1],
+        [[-10.0, 10.0, 0, 0], [0, 0, -1.0, 1.0], [0, 0, 0, 0]],
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(X1[1], [0.0, 10.0, 0.0, 1.0], atol=1e-4)
+    np.testing.assert_allclose(
+        NV_adj[2],
+        [[-5.0, 5.0, 0, 0], [0, 0, -5.0, 5.0], [0, 0, 5.0, -5.0]],
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(X1[2], [5.0, 5.0, 5.0, 5.0], atol=1e-4)
+
+
+def test_get_equilibrium_adjusted_x_golden():
+    # 4-cell golden case incl. counteracting proteins (ref :2121)
+    X0 = np.array([
+        [10.0, 0.0, 10.0, 0.0],
+        [10.0, 1.0, 0.0, 0.0],
+        [5.0, 5.0, 0.0, 0.0],
+        [5.0, 5.0, 0.0, 0.0],
+    ], dtype=np.float32)
+    N = np.array([
+        [[-1, 1, 0, 0], [0, 0, -1, 1], [0, -1, 0, 1]],
+        [[-1, 1, 0, 0], [0, -1, 1, 0], [0, 0, 0, 0]],
+        [[-1, 1, 0, 0], [1, -1, 0, 0], [0, 0, 0, 0]],
+        [[-1, 1, 0, 0], [1, -1, 0, 0], [0, 0, 0, 0]],
+    ], dtype=np.int32)
+    V = np.array([
+        [10.0, 10.0, 0.0],
+        [10.0, 1.0, 0.0],
+        [2.0, 2.0, 0.0],
+        [10.0, 1.0, 0.0],
+    ], dtype=np.float32)
+    Ke = np.array([
+        [1.0, MAX, 1.0],
+        [1.0, 1.0, 1.0],
+        [10.0, 1.0, 1.0],
+        [10.0, 1.0, 1.0],
+    ], dtype=np.float32)
+    c, p, s = N.shape
+    params = _raw_params(Ke, np.ones((c, p)), np.ones((c, p)),
+                         np.zeros((c, p)), N)
+    NV = N.astype(np.float32) * V[:, :, None]
+    X1 = X0 + NV.sum(1)
+    X2 = np.asarray(
+        integ._equilibrium_adjusted_x(
+            jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(NV),
+            jnp.asarray(V), params,
+        )
+    )
+    np.testing.assert_allclose(X2[0], [5.0, 5.0, 0.0, 10.0], atol=1e-4)
+    np.testing.assert_allclose(X2[1], [5.0, 5.0, 1.0, 0.0], atol=1e-4)
+    np.testing.assert_allclose(X2[2], [5.0, 5.0, 0.0, 0.0], atol=1e-4)
+    np.testing.assert_allclose(X2[3], [1.0, 9.0, 0.0, 0.0], atol=1e-4)
+
+
+def _literal_equilibrium_adjusted_x(X0, X1, NV, V, Ke, Nf, Nb):
+    """Line-for-line numpy port of the reference's iterative Q-vs-Ke
+    correction INCLUDING its `torch.any` global early exit
+    (reference kinetics.py:808-859) — the oracle for the A/B test of the
+    traced `stopped` flag."""
+    X0 = X0.astype(np.float32)
+    X1 = X1.astype(np.float32).copy()
+    NV = NV.astype(np.float32)
+    V = V.astype(np.float32)
+
+    def mult(X, N):
+        M = N > 0
+        x = np.where(M, X[:, None, :], np.float32(0.0))
+        with np.errstate(over="ignore", invalid="ignore"):
+            xx = np.prod(
+                np.power(x, N.astype(np.float32)), axis=2, dtype=np.float32
+            )
+        xx[np.isnan(xx)] = 0.0
+        xx[xx < 0.0] = 0.0
+        xx[np.isinf(xx)] = MAX
+        return xx, M.any(2)
+
+    def quotient(X):
+        prod, pp = mult(X, Nb)
+        prod[~pp] = 0.0
+        subs, sp = mult(X, Nf)
+        subs[~sp] = 0.0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            q = prod / subs
+        q = np.clip(q, EPS, MAX)
+        return np.nan_to_num(q, nan=1.0)
+
+    has_impact = np.abs(V) > 0.1
+    is_fwd = V > 0.0
+    F = np.ones_like(V)
+    for increment in (0.5, 0.25, 0.125, 0.0625):
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            QKe = quotient(X1) / Ke
+        v_too_low = np.where(is_fwd, QKe < 1 / 1.5, QKe > 1.5)
+        v_too_low[is_fwd & (F == 1.0)] = False
+        v_too_high = np.where(is_fwd, QKe > 1.5, QKe < 1 / 1.5)
+        v_too_high[~is_fwd & (F == 0.0)] = False
+        if not np.any((v_too_low | v_too_high) & has_impact):
+            return X1
+        F[v_too_high] -= increment
+        F[v_too_low] += increment
+        np.clip(F, 0.0, 1.0, out=F)
+        X1 = X0 + np.einsum("cps,cp->cs", NV, F).astype(np.float32)
+        X1[X1 < 0.0] = 0.0
+    return X1
+
+
+def test_equilibrium_early_stop_matches_literal_port():
+    """Adversarial A/B: the traced batch-global `stopped` flag must
+    reproduce the reference's `torch.any` early exit exactly — including
+    batches engineered to trip the exit at every possible iteration."""
+    rng = np.random.default_rng(7)
+    c, pn, s = 6, 3, 4
+
+    def run_case(X0, N, V, Ke):
+        Nf = np.where(N < 0, -N, 0).astype(np.int32)
+        Nb = np.where(N > 0, N, 0).astype(np.int32)
+        NV = N.astype(np.float32) * V[:, :, None]
+        X1 = np.maximum(X0 + NV.sum(1), 0.0).astype(np.float32)
+        params = _raw_params(
+            Ke, np.ones_like(Ke), np.ones_like(Ke), np.zeros_like(Ke),
+            np.zeros((X0.shape[0], N.shape[1], X0.shape[1]), dtype=np.int32),
+            Nf=Nf, Nb=Nb,
+        )
+        ours = np.asarray(
+            integ._equilibrium_adjusted_x(
+                jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(NV),
+                jnp.asarray(V), params,
+            )
+        )
+        want = _literal_equilibrium_adjusted_x(X0, X1, NV, V, Ke, Nf, Nb)
+        np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+    # crafted: no protein impactful -> exit at iteration 0 (X1 unchanged)
+    X0 = np.full((2, s), 5.0, dtype=np.float32)
+    N = np.zeros((2, pn, s), dtype=np.int32)
+    N[:, 0, 0], N[:, 0, 1] = -1, 1
+    V = np.full((2, pn), 0.05, dtype=np.float32)  # below impact threshold
+    run_case(X0, N, V, np.ones((2, pn), dtype=np.float32))
+
+    # crafted: strong overshoot -> all 4 increments run
+    V = np.zeros((2, pn), dtype=np.float32)
+    V[:, 0] = 4.9
+    run_case(X0, N, V, np.full((2, pn), 1e-6, dtype=np.float32))
+
+    # fuzz: random stoichiometries, velocities (some < 0.1), zeros in X,
+    # extreme Ke — any divergence in stop timing shows up as a different
+    # fixed point
+    for _ in range(25):
+        X0 = rng.uniform(0.0, 8.0, (c, s)).astype(np.float32)
+        X0[rng.random((c, s)) < 0.25] = 0.0
+        N = rng.integers(-2, 3, (c, pn, s)).astype(np.int32)
+        V = rng.uniform(-2.0, 2.0, (c, pn)).astype(np.float32)
+        V[rng.random((c, pn)) < 0.3] *= 0.04  # some below impact threshold
+        Ke = np.exp(rng.uniform(-12, 12, (c, pn))).astype(np.float32)
+        run_case(X0, N, V, Ke)

@@ -442,3 +442,32 @@ def test_add_cells():
         np.stack([np.asarray(d.int_molecules) for d in cells]),
         rtol=1e-6,
     )
+
+
+def test_cell_molecule_column_and_add():
+    world = _world()
+    world.spawn_cells(_genomes(7, s=400, seed=11))
+    cm = world.cell_molecules
+
+    col = world.cell_molecule_column(2)
+    assert col.shape == (7,)
+    np.testing.assert_array_equal(col, cm[:, 2])
+
+    # prefetched copy returns the same state
+    world.prefetch_cell_molecule_column(2)
+    np.testing.assert_array_equal(world.cell_molecule_column(2), cm[:, 2])
+
+    # stale prefetch (state mutated in between) is discarded
+    world.prefetch_cell_molecule_column(2)
+    world.add_cell_molecules([1, 4], mol_idx=2, delta=-0.25)
+    col2 = world.cell_molecule_column(2)
+    want = cm[:, 2].copy()
+    want[[1, 4]] -= 0.25
+    np.testing.assert_allclose(col2, want, rtol=1e-6)
+
+    # other columns untouched
+    other = np.delete(np.asarray(world.cell_molecules), 2, axis=1)
+    np.testing.assert_array_equal(other, np.delete(cm, 2, axis=1))
+
+    world.add_cell_molecules([], mol_idx=2, delta=1.0)  # no-op
+    np.testing.assert_allclose(world.cell_molecule_column(2), want, rtol=1e-6)
