@@ -424,7 +424,9 @@ class Kinetics:
                 A=g(params.A, cps),
             )
 
-        kwargs = {"donate_argnums": 0}
+        # note: donation would be useless here — the padded outputs are
+        # strictly larger than the inputs, so no buffer can be reused
+        kwargs = {}
         if self.cell_sharding is not None:
             kwargs["out_shardings"] = CellParams(*([self.cell_sharding] * 9))
         self.params = jax.jit(_grow, **kwargs)(old)

@@ -1,0 +1,188 @@
+"""
+Deterministic, backend-independent math building blocks.
+
+The CPU-vs-TPU bit-reproducibility target (BASELINE.md north star) fails
+on exactly three classes of primitives, because XLA lowers them to
+backend-specific implementations:
+
+1. transcendentals (`exp`, `pow`) — each backend ships its own
+   approximation, so results differ by a few ULP;
+2. reductions (`sum`, `prod`, convolutions) — each backend picks its own
+   reduction tree, and float addition is not associative;
+3. excess-precision rewrites (FMA contraction) — measured to happen ONLY
+   inside large fusions on TPU (an isolated ``a*b+c`` jit two-rounds, the
+   same expression fused into a big program contracts), so every
+   multiply feeding an add/sub below is separated by
+   ``lax.optimization_barrier``; `scripts/bitrepro.py` additionally sets
+   ``XLA_FLAGS=--xla_allow_excess_precision=false``.
+
+Everything here is built ONLY from IEEE-754-exact single ops (add, sub,
+mul, div, compare, select, integer bit ops) applied in a fixed order, so
+any two IEEE-conforming backends produce bit-identical results.  The
+constructions are also TPU-friendly: masked square-and-multiply replaces
+`pow` (faster than a transcendental on the VPU), and the fixed binary
+reduction trees vectorize exactly like the backend's own.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _nofma(x: jax.Array) -> jax.Array:
+    """Pin a multiply result so XLA cannot contract it into a dependent
+    add/sub as an FMA (which rounds once instead of twice and does so
+    backend-dependently)."""
+    return jax.lax.optimization_barrier(x)
+
+_LOG2E = 1.4426950408889634
+# Taylor coefficients of 2^f = exp(f ln2) on f in [-0.5, 0.5]
+_EXP2_COEFFS = (
+    1.0,
+    6.931471805599453e-1,
+    2.402265069591007e-1,
+    5.550410866482158e-2,
+    9.618129107628477e-3,
+    1.3333558146428441e-3,
+    1.5403530393381606e-4,
+    1.525273380405984e-5,
+)
+_POW_BITS = 7  # supports |n| <= 127; stoichiometries/hill sums stay far below
+
+
+def ipow(x: jax.Array, n: jax.Array) -> jax.Array:
+    """
+    ``x ** n`` for float ``x >= 0`` and integer ``n`` via masked
+    square-and-multiply — bit-identical across backends, and matching
+    ``jnp.power``'s edge semantics on the integrator's domain:
+    ``0**0 = 1``, ``0**+n = 0``, ``0**-n = inf``.
+
+    Exponents with ``|n| >= 2**_POW_BITS`` (beyond any real stoichiometry
+    or hill sum) saturate to the limit value 0/1/inf of ``x**±inf``
+    instead of silently dropping high bits.
+    """
+    n = n.astype(jnp.int32)
+    absn = jnp.abs(n)
+    r = jnp.ones_like(x)
+    xp = x
+    for bit in range(_POW_BITS):
+        r = jnp.where((absn >> bit) & 1 == 1, r * xp, r)
+        if bit < _POW_BITS - 1:
+            xp = xp * xp
+    # saturate out-of-range exponents: x**(huge n) -> 0 / 1 / inf
+    huge = jnp.where(
+        x > 1.0, jnp.float32(jnp.inf), jnp.where(x == 1.0, 1.0, 0.0)
+    )
+    r = jnp.where(absn >= (1 << _POW_BITS), huge, r)
+    return jnp.where(n < 0, det_div(jnp.ones_like(r), r), r)
+
+
+def det_exp(x: jax.Array) -> jax.Array:
+    """
+    ``exp(x)`` from exact ops only: split ``x·log2(e) = k + f`` with
+    integer ``k`` and ``f ∈ [-0.5, 0.5]``, evaluate ``2^f`` by a fixed
+    Horner polynomial, and scale by ``2^k`` built by integer bit
+    assembly.  Accuracy ~1-2 ULP vs the libm exp; identical on every
+    IEEE backend.
+    """
+    x = x.astype(jnp.float32)
+    y = x * jnp.float32(_LOG2E)
+    k = jnp.round(y)
+    f = (y - k).astype(jnp.float32)
+
+    p = jnp.full_like(f, _EXP2_COEFFS[-1])
+    for c in _EXP2_COEFFS[-2::-1]:
+        p = _nofma(p * f) + jnp.float32(c)
+
+    # 2^k via exponent-field assembly; clamp into normal f32 range and
+    # split into two factors so k in [-252, 252] is representable
+    # (NaN -> 0 first: NaN-to-int conversion is backend-defined)
+    k = jnp.clip(jnp.nan_to_num(k), -252.0, 252.0).astype(jnp.int32)
+    k_half = k // 2
+    k_rest = k - k_half
+
+    def pow2i(e):
+        return jax.lax.bitcast_convert_type(
+            ((e + 127) << 23).astype(jnp.int32), jnp.float32
+        )
+
+    return p * pow2i(k_half) * pow2i(k_rest)
+
+
+def det_div(a: jax.Array, b: jax.Array) -> jax.Array:
+    """
+    Deterministic float32 division.  Hardware f32 division is NOT
+    correctly rounded on TPU (measured: up to 2 ULP off the CPU result),
+    so ``a / b`` is the one arithmetic primitive that cannot be used
+    directly for cross-backend bit-reproducibility.  This computes the
+    reciprocal by the classic magic-constant bit hack plus Newton
+    iterations — integer ops, multiplies and subtractions only, all of
+    which ARE exact on both backends — then multiplies.  Accuracy ~1 ULP;
+    more importantly, bit-identical everywhere.
+
+    Non-finite/zero divisors fall back to hardware division: IEEE special
+    cases (x/0 = ±inf, x/inf = 0, NaN propagation) are exact on every
+    backend.  |b| must otherwise be in the normal range; the simulation
+    clamps its divisors into [EPS, MAX] = [1e-36, 1e36], far inside it.
+    """
+    bn = jnp.abs(b)
+    # seed: r0 ~ 1/bn with ~3% error (0x7EF311C3 bit trick)
+    bits = jax.lax.bitcast_convert_type(bn, jnp.int32)
+    r = jax.lax.bitcast_convert_type(jnp.int32(0x7EF311C3) - bits, jnp.float32)
+    for _ in range(4):
+        # Newton: quadratic convergence; barrier stops FMS contraction
+        r = r * (2.0 - _nofma(bn * r))
+    q = a * r
+    q = jnp.where(jnp.signbit(b), -q, q)
+    # soft path only where the seed is valid: NORMAL-range divisors below
+    # ~1.6e38 (the magic-constant subtraction underflows above that, and
+    # denormal divisors diverge at input level anyway via TPU FTZ);
+    # outside, hardware division — IEEE special cases are exact everywhere
+    ok = (
+        (bn >= jnp.float32(1.17549435e-38))
+        & (bn <= jnp.float32(1e37))
+        & jnp.isfinite(bn)
+    )
+    return jnp.where(ok, q, a / b)
+
+
+def tree_reduce(x: jax.Array, axis: int, op, identity: float) -> jax.Array:
+    """
+    Reduce one axis with a FIXED binary tree (padded with the exact
+    identity element to a power of two).  One shared implementation for
+    the deterministic sum and product trees — the tree SHAPE is
+    load-bearing for cross-backend bit-identity, so it must not drift
+    between them.  Slices along the ORIGINAL axis: no transpose/relayout,
+    which would dominate the cost on TPU for (cells, proteins, signals)
+    tensors.
+    """
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    p = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    if p != n:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, p - n)
+        x = jnp.pad(x, pad, constant_values=identity)
+    while x.shape[axis] > 1:
+        h = x.shape[axis] // 2
+        x = op(
+            jax.lax.slice_in_dim(x, 0, h, axis=axis),
+            jax.lax.slice_in_dim(x, h, 2 * h, axis=axis),
+        )
+    return jnp.squeeze(x, axis=axis)
+
+
+def sum_axis(x: jax.Array, axis: int) -> jax.Array:
+    """Deterministic float sum over one axis (fixed binary tree)."""
+    # the summands are often products; stop the first tree level from
+    # absorbing them as FMAs
+    return tree_reduce(_nofma(x), axis, jnp.add, 0.0)
+
+
+def prod_axis(x: jax.Array, axis: int) -> jax.Array:
+    """Deterministic float product over one axis (fixed binary tree) —
+    also the Pallas-lowerable form (`reduce_prod` has no Mosaic rule)."""
+    return tree_reduce(x, axis, jnp.multiply, 1.0)
+
+
+def sum_hw(x: jax.Array) -> jax.Array:
+    """Sum over the trailing two (spatial) axes via one fixed tree."""
+    return sum_axis(x.reshape(x.shape[:-2] + (-1,)), -1)

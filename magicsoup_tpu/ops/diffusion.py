@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from magicsoup_tpu.ops.detmath import _nofma, det_div, sum_hw
+
 
 def diffusion_kernels(diffusivities: list[float]) -> np.ndarray:
     """(n_mols, 3, 3) depthwise kernels from per-molecule diffusivities"""
@@ -53,26 +55,35 @@ def degradation_factors(half_lives: list[float]) -> np.ndarray:
 @jax.jit
 def diffuse(molecule_map: jax.Array, kernels: jax.Array) -> jax.Array:
     """
-    One diffusion step: depthwise 3x3 convolution on the torus for every
-    molecule channel at once, followed by the reference's mass-conservation
-    fixup (convolution rounding errors spread over all pixels) and a clamp
-    at zero.
+    One diffusion step: a depthwise 3x3 torus stencil for every molecule
+    channel at once, followed by the reference's mass-conservation fixup
+    (rounding errors spread over all pixels) and a clamp at zero.
+
+    The stencil is 9 explicit roll-multiply-adds in a FIXED order and the
+    map totals use a fixed binary reduction tree — a backend convolution
+    would pick its own tap/reduction order, breaking CPU-vs-TPU
+    bit-reproducibility.  Unlike the integrator there is no fast/det
+    split: a 3x3 depthwise conv cannot use the MXU, so the stencil costs
+    the same as the convolution it replaces (~1 ms at 128x128).
     """
-    n_mols, m, _ = molecule_map.shape
-    total_before = jnp.sum(molecule_map, axis=(1, 2))  # (mols,)
+    m = molecule_map.shape[1]
+    total_before = sum_hw(molecule_map)  # (mols,)
 
-    padded = jnp.pad(molecule_map, ((0, 0), (1, 1), (1, 1)), mode="wrap")
-    out = jax.lax.conv_general_dilated(
-        padded[None],  # (1, mols, m+2, m+2)
-        kernels[:, None],  # (mols, 1, 3, 3)
-        window_strides=(1, 1),
-        padding="VALID",
-        feature_group_count=n_mols,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-    )[0]
+    out = jnp.zeros_like(molecule_map)
+    for i in range(3):
+        for j in range(3):
+            # correlation semantics: out[x,y] += k[i,j] * map[x+i-1, y+j-1]
+            # (_nofma: keep the tap multiply from contracting into the
+            # accumulating add as a backend-dependent FMA)
+            term = _nofma(
+                kernels[:, i, j][:, None, None]
+                * jnp.roll(molecule_map, shift=(1 - i, 1 - j), axis=(1, 2))
+            )
+            out = out + term
 
-    total_after = jnp.sum(out, axis=(1, 2))
-    out = out + ((total_before - total_after) / (m * m))[:, None, None]
+    total_after = sum_hw(out)
+    fix = det_div(total_before - total_after, jnp.float32(m * m))
+    out = out + fix[:, None, None]
     return jnp.clip(out, min=0.0)
 
 
@@ -84,8 +95,8 @@ def permeate(
 ) -> tuple[jax.Array, jax.Array]:
     """Exchange molecules between each cell and its pixel by the per-species
     permeation ratio (reference world.py:654-665)."""
-    d_int = cell_molecules * factors
-    d_ext = ext_molecules * factors
+    d_int = _nofma(cell_molecules * factors)
+    d_ext = _nofma(ext_molecules * factors)
     return cell_molecules + d_ext - d_int, ext_molecules + d_int - d_ext
 
 

@@ -21,9 +21,23 @@ approximates; the XLA path keeps the batch-global flag for exact reference
 parity.
 
 Enable with ``MAGICSOUP_TPU_PALLAS=1`` (or call
-:func:`integrate_signals_pallas` directly).  Off by default until
-benchmarked on hardware; `interpret=True` runs the kernel on CPU for
-tests.
+:func:`integrate_signals_pallas` directly).  `interpret=True` runs the
+kernel on CPU for tests.
+
+**Hardware status (2026-07-29, TPU v5e via remote Mosaic compile
+service):** OFF by default, and for now prove-or-drop resolves to
+"documented, not default".  Two successive blockers were found on real
+hardware: (1) ``reduce_prod`` has no Mosaic lowering — fixed by the
+fixed-tree `_prod_last` / `ipow` now shared with the deterministic XLA
+mode; (2) the remaining kernel body crashes the Mosaic compiler itself
+(``remote_compile: HTTP 500: tpu_compile_helper subprocess exit code 1``
+with no diagnostics; a trivial Pallas kernel compiles fine on the same
+chip, and the crash reproduces with just the `_multiply_signals`
+sub-kernel).  The fall-back XLA integrator measures 13 ms/step at
+benchmark shapes (16384 cells x 32 proteins x 28 signals) vs a ~0.4 ms
+1x-HBM-read bound, so a working kernel remains worth ~12 ms/step of
+device time — relevant once steps are not dominated by host round-trip
+latency (see performance/README.md).
 """
 import functools
 import math
@@ -61,7 +75,11 @@ def _kernel(
     )
     X = x_ref[:]
     for trim in TRIM_FACTORS:
-        X = _integrate_part(X, jnp.clip(params.Vmax * trim, min=0.0), params)
+        # det=True: reduce_prod/pow have no Mosaic lowering; the
+        # deterministic fixed-tree/square-and-multiply forms lower
+        X = _integrate_part(
+            X, jnp.clip(params.Vmax * trim, min=0.0), params, det=True
+        )
     out_ref[:] = X
 
 

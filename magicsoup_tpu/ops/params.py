@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from magicsoup_tpu.constants import EPS, GAS_CONSTANT, MAX
+from magicsoup_tpu.ops.detmath import det_div, det_exp, ipow, sum_axis
 from magicsoup_tpu.ops.integrate import CellParams
 
 
@@ -98,11 +99,13 @@ def flat_to_dense(
 
 
 def _nanmean0(x: jax.Array, axis: int) -> jax.Array:
-    """nanmean with all-NaN slices giving 0 (torch nanmean().nan_to_num(0))"""
+    """nanmean with all-NaN slices giving 0 (torch nanmean().nan_to_num(0));
+    fixed-order float sum so the result is backend-independent"""
     mask = ~jnp.isnan(x)
-    total = jnp.sum(jnp.where(mask, x, 0.0), axis=axis)
+    total = sum_axis(jnp.where(mask, x, 0.0), axis=axis)
     count = jnp.sum(mask, axis=axis)
-    return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+    mean = det_div(total, jnp.maximum(count, 1).astype(total.dtype))
+    return jnp.where(count > 0, mean, 0.0)
 
 
 @partial(jax.jit, static_argnames=())
@@ -149,7 +152,7 @@ def compute_cell_params(
     Kmr_ds = effectors.astype(jnp.float32) * Kmr_d[..., None]  # (b,p,d,s)
     Kmr_ds = jnp.where(Kmr_ds == 0.0, jnp.nan, Kmr_ds)  # effectors add 0s
     Kmr = _nanmean0(Kmr_ds, axis=2)  # (b,p,s)
-    Kmr = jnp.power(Kmr, A.astype(jnp.float32))  # pre-exponentiated by hill
+    Kmr = ipow(Kmr, A)  # pre-exponentiated by hill
 
     # stoichiometry; Nf/Nb split keeps zero-net cofactors alive
     N_d = (reacts + trnspts) * signs[..., None]  # (b,p,d,s) i32
@@ -160,13 +163,18 @@ def compute_cell_params(
     # Km of catalytic/transporter domains
     Kmn = _nanmean0(jnp.where(~is_reg, Kms, jnp.nan), axis=2)  # (b,p)
 
-    # energies -> equilibrium constant, clamped against Inf/0
-    E = jnp.einsum("bps,s->bp", N.astype(jnp.float32), tables.mol_energies)
-    Ke = jnp.clip(jnp.exp(-E / abs_temp / GAS_CONSTANT), EPS, MAX)
+    # energies -> equilibrium constant, clamped against Inf/0; fixed-order
+    # sum + deterministic exp/div keep Ke bit-identical across backends
+    E = sum_axis(N.astype(jnp.float32) * tables.mol_energies, axis=2)
+    Ke = jnp.clip(
+        det_exp(det_div(det_div(-E, abs_temp), jnp.float32(GAS_CONSTANT))),
+        EPS,
+        MAX,
+    )
 
     # sampled Km defines the smaller side of Ke = Kmf/Kmb
     is_fwd = Ke >= 1.0
-    Kmf = jnp.clip(jnp.where(is_fwd, Kmn, Kmn / Ke), EPS, MAX)
+    Kmf = jnp.clip(jnp.where(is_fwd, Kmn, det_div(Kmn, Ke)), EPS, MAX)
     Kmb = jnp.clip(jnp.where(is_fwd, Kmn * Ke, Kmn), EPS, MAX)
 
     return CellParams(Ke=Ke, Kmf=Kmf, Kmb=Kmb, Kmr=Kmr, Vmax=Vmax, N=N, Nf=Nf, Nb=Nb, A=A)

@@ -16,6 +16,19 @@ decomposition** of the molecule map over a 1D mesh of tiles, with
 
 The "sequence-parallel" analog of this simulation is exactly this map/cell
 sharding (SURVEY.md §5: ring-attention/Ulysses have no counterpart here).
+
+Measured collective cost of the GSPMD cell<->map exchange (8-way mesh,
+HLO census — regression-pinned by
+`tests/fast/test_parallel.py::test_sharded_step_collective_budget`):
+2 collective-permutes (the diffusion row halos), small all-gathers of the
+replicated position tensor, and one (mols, cap) all-reduce/all-gather
+pair per gather site (activity + permeation).  At benchmark scale
+(128x128 map, 16384 slots, 14 molecules) that is ~6 MB/step over ICI —
+microseconds — and nothing map- or parameter-sized ever crosses the
+interconnect, so cells do NOT need to be co-located with their map tile
+at these scales.  Co-location (per-tile slot pools with tile-local
+gathers under shard_map) becomes worthwhile only when per-step bytes
+approach ICI bandwidth, i.e. ~100x more cells or molecules.
 """
 from functools import partial
 
@@ -82,29 +95,28 @@ def halo_diffuse(
     )
     def _step(local: jax.Array, kern: jax.Array) -> jax.Array:
         # local: (mols, m/n_tiles, m); kern arrives flattened (mols, 9)
-        kern = kern.reshape(-1, 1, 3, 3)
-        n_mols = local.shape[0]
-        total_before = jax.lax.psum(jnp.sum(local, axis=(1, 2)), axis)
+        kern = kern.reshape(-1, 3, 3)
+        n_local = local.shape[1]
+        total_before = jax.lax.psum(_diff.sum_hw(local), axis)
 
         # my first row becomes the lower halo of the tile above, my last row
         # the upper halo of the tile below (torus-wrapped)
         halo_for_above = jax.lax.ppermute(local[:, :1, :], axis, up)
         halo_for_below = jax.lax.ppermute(local[:, -1:, :], axis, down)
         rows = jnp.concatenate([halo_for_below, local, halo_for_above], axis=1)
-        # columns are fully local: wrap-pad
-        padded = jnp.pad(rows, ((0, 0), (0, 0), (1, 1)), mode="wrap")
 
-        out = jax.lax.conv_general_dilated(
-            padded[None],
-            kern,
-            window_strides=(1, 1),
-            padding="VALID",
-            feature_group_count=n_mols,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        )[0]
+        # same fixed-order 9-tap stencil as ops.diffusion.diffuse (rows via
+        # halo slices, columns via local torus roll) so the sharded step is
+        # numerically identical to the single-device one, tap for tap
+        out = jnp.zeros_like(local)
+        for i in range(3):
+            for j in range(3):
+                shifted = jnp.roll(rows[:, i : i + n_local, :], 1 - j, axis=2)
+                out = out + _diff._nofma(kern[:, i, j][:, None, None] * shifted)
 
-        total_after = jax.lax.psum(jnp.sum(out, axis=(1, 2)), axis)
-        out = out + ((total_before - total_after) / (m * m))[:, None, None]
+        total_after = jax.lax.psum(_diff.sum_hw(out), axis)
+        fix = _diff.det_div(total_before - total_after, jnp.float32(m * m))
+        out = out + fix[:, None, None]
         return jnp.clip(out, min=0.0)
 
     return _step(molecule_map, kernels.reshape(kernels.shape[0], -1))

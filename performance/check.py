@@ -49,8 +49,11 @@ def main() -> None:
         return [ms.random_genome(s=rng.choice(pop), rng=rng) for _ in range(n)]
 
     def sync(world) -> None:
-        jax.block_until_ready((world._molecule_map, world._cell_molecules))
-        jax.block_until_ready(world.kinetics.params.Vmax)
+        # VALUE fetches, not block_until_ready: remote-tunneled backends
+        # can ack readiness before the device work finishes
+        float(world._molecule_map[0, 0, 0])
+        float(world._cell_molecules[0, 0])
+        float(world.kinetics.params.Vmax[0, 0])
 
     print(
         f"Benchmarking spawn_cells, update_cells, divide_cells, "

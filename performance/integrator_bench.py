@@ -1,0 +1,125 @@
+"""
+Device-time microbenchmark of the signal integrator: XLA path vs the
+VMEM-tiled Pallas kernel, at benchmark shapes, plus an HBM-bandwidth
+utilisation estimate (the op is memory-bound: its FLOPs are elementwise,
+there is no matmul).
+
+    python performance/integrator_bench.py --cells 16384 --proteins 32 --signals 28
+
+Timing method: median of N repetitions of K chained integrator steps
+(lax.scan under one jit), synchronised by a VALUE FETCH of one output
+element — on remote-tunneled accelerators `block_until_ready` can ack
+before the device work finishes, so only a data fetch is a true barrier.
+The per-call fetch latency is measured separately and subtracted.
+"""
+import argparse
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=16384)
+    ap.add_argument("--proteins", type=int, default=32)
+    ap.add_argument("--signals", type=int, default=28)
+    ap.add_argument("--occupancy", type=float, default=0.75,
+                    help="fraction of cell slots with live parameters")
+    ap.add_argument("--chain", type=int, default=10,
+                    help="integrator steps fused under one jit")
+    ap.add_argument("--reps", type=int, default=7)
+    ap.add_argument("--tile-c", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
+    from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+    c, p, s = args.cells, args.proteins, args.signals
+    rng = np.random.default_rng(0)
+    live = rng.random(c) < args.occupancy
+
+    def cp(lo, hi):
+        a = rng.uniform(lo, hi, (c, p)).astype(np.float32)
+        a[~live] = 0.0
+        return jnp.asarray(a)
+
+    N = rng.integers(-2, 3, (c, p, s)).astype(np.int32)
+    N[~live] = 0
+    Nf = np.where(N < 0, -N, 0).astype(np.int32)
+    Nb = np.where(N > 0, N, 0).astype(np.int32)
+    params = CellParams(
+        Ke=cp(0.1, 10.0), Kmf=cp(0.5, 5.0), Kmb=cp(0.5, 5.0),
+        Kmr=jnp.zeros((c, p, s), dtype=jnp.float32),
+        Vmax=cp(0.0, 10.0),
+        N=jnp.asarray(N), Nf=jnp.asarray(Nf), Nb=jnp.asarray(Nb),
+        A=jnp.zeros((c, p, s), dtype=jnp.int32),
+    )
+    X = jnp.asarray(rng.uniform(0.0, 5.0, (c, s)).astype(np.float32))
+
+    interpret = jax.default_backend() == "cpu"
+
+    def chain(fn):
+        def stepped(X, params):
+            def body(x, _):
+                return fn(x, params), None
+            x, _ = jax.lax.scan(body, X, None, length=args.chain)
+            return x
+        return jax.jit(stepped)
+
+    # fetch latency baseline (RTT + tiny transfer), to subtract
+    tiny = jnp.zeros((), jnp.float32)
+    float(tiny)
+    rtts = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        float(tiny + 1.0)
+        rtts.append(time.perf_counter() - t0)
+    rtt = statistics.median(rtts)
+    print(f"fetch latency baseline: {rtt * 1e3:.1f} ms")
+
+    def timed(fn, label):
+        out = fn(X, params)
+        float(out[0, 0])  # compile + true barrier
+        vals = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = fn(X, params)
+            float(out[0, 0])  # value fetch = true barrier
+            vals.append((time.perf_counter() - t0 - rtt) / args.chain)
+        med = statistics.median(vals)
+        print(f"{label:28s} {med * 1e3:8.3f} ms/step (fetch-synced)")
+        return med, out
+
+    t_xla, out_xla = timed(chain(integrate_signals), "XLA integrate_signals")
+    pallas_fn = lambda X, p_: integrate_signals_pallas(  # noqa: E731
+        X, p_, tile_c=args.tile_c, interpret=interpret
+    )
+    try:
+        t_pal, out_pal = timed(chain(pallas_fn), "Pallas integrate_signals")
+        diff = float(jnp.max(jnp.abs(out_xla - out_pal)))
+        print(f"max |XLA - Pallas| after {args.chain} steps: {diff:.3e}")
+    except Exception as e:  # noqa: BLE001
+        t_pal = None
+        print(f"Pallas failed: {type(e).__name__}: {str(e)[:300]}")
+
+    # memory-bound model: one step must read the 5 (c,p,s) tensors + 4 (c,p)
+    # + X at least once; XLA re-reads per reduction, Pallas ~once
+    cps_bytes = 5 * c * p * s * 4
+    cp_bytes = 4 * c * p * 4
+    x_bytes = c * s * 4
+    min_bytes = cps_bytes + cp_bytes + 2 * x_bytes
+    print(f"param bytes/step (1x read): {min_bytes / 1e6:.1f} MB")
+    print(f"XLA    effective HBM bw (if 1x): {min_bytes / t_xla / 1e9:.1f} GB/s")
+    if t_pal:
+        print(f"Pallas effective HBM bw (if 1x): {min_bytes / t_pal / 1e9:.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

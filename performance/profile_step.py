@@ -70,19 +70,19 @@ def main() -> None:
         times[label].append(time.perf_counter() - t0)
 
     def step(record: bool) -> None:
+        kwargs = {"timeit": timeit} if record else {}
         sim_step(
             world,
             rng,
             n_cells=args.n_cells,
             genome_size=args.genome_size,
             atp_idx=atp,
-            timeit=timeit if record else None,
             sync=True,
+            **kwargs,
         )
 
     for _ in range(args.warmup):
-        sim_step(world, rng, n_cells=args.n_cells,
-                 genome_size=args.genome_size, atp_idx=atp, sync=True)
+        step(record=False)
 
     if args.trace_dir:
         jax.profiler.start_trace(args.trace_dir)

@@ -85,6 +85,8 @@ def sim_step(
         world.diffuse_molecules()
         world.increment_cell_lifetimes()
         if sync:
-            import jax
-
-            jax.block_until_ready((world._molecule_map, world._cell_molecules))
+            # a VALUE fetch, not block_until_ready: remote-tunneled
+            # accelerators can ack readiness before the work is done, so
+            # only a data fetch is a true barrier
+            float(world._molecule_map[0, 0, 0])
+            float(world._cell_molecules[0, 0])

@@ -42,6 +42,9 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--genome-size", type=int, default=300)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--child", choices=["cpu", "accel"], default=None)
+    ap.add_argument("--dump-step", type=int, default=None,
+                    help="child: also save raw state arrays after this step")
+    ap.add_argument("--dump-path", type=str, default=None)
     return ap
 
 
@@ -95,10 +98,35 @@ def child_main(args: argparse.Namespace) -> None:
             sync=True,
         )
         print(json.dumps({"step": step, "n_cells": world.n_cells} | state_digests(world)))
+        if args.dump_step == step and args.dump_path:
+            import numpy as np
+
+            n = world.n_cells
+            arrays = {
+                "molecule_map": np.asarray(world._molecule_map),
+                "cell_molecules": np.asarray(world._cell_molecules)[:n],
+            }
+            for name in ("Ke", "Kmf", "Kmb", "Kmr", "Vmax", "N", "Nf", "Nb", "A"):
+                arrays[f"params.{name}"] = np.asarray(
+                    getattr(world.kinetics.params, name)
+                )[:n]
+            np.savez(args.dump_path, **arrays)
 
 
-def _run_child(args: argparse.Namespace, platform: str) -> list[dict]:
+def _run_child(
+    args: argparse.Namespace, platform: str, dump: tuple[int, str] | None = None
+) -> list[dict]:
     env = dict(os.environ)
+    # the deterministic numeric mode (fixed-order reductions, integer
+    # powers, polynomial exp, software division) is what makes the two
+    # backends comparable at all — see BITREPRO.md
+    env["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
+    # forbid FMA contraction / excess precision: the deterministic math in
+    # ops/detmath.py fixes operation ORDER, but XLA may still fuse a
+    # mul+add into an FMA on one backend and not the other
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_allow_excess_precision=false"
+    ).strip()
     if platform == "cpu":
         # strip any PJRT shim and pin the CPU backend
         env["PYTHONPATH"] = ""
@@ -109,11 +137,57 @@ def _run_child(args: argparse.Namespace, platform: str) -> list[dict]:
         "--map-size", str(args.map_size), "--genome-size", str(args.genome_size),
         "--seed", str(args.seed),
     ]
+    if dump is not None:
+        cmd += ["--dump-step", str(dump[0]), "--dump-path", dump[1]]
     res = subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=3600)
     if res.returncode != 0:
         sys.stderr.write(res.stderr[-3000:])
         raise RuntimeError(f"{platform} child failed (rc={res.returncode})")
     return [json.loads(line) for line in res.stdout.splitlines() if line.strip()]
+
+
+def _divergence_magnitudes(args: argparse.Namespace, step: int) -> dict:
+    """Re-run both children dumping raw state at the first divergent step
+    and quantify how far apart the tensors actually are (max abs/rel diff
+    and max ULP distance) — a hash mismatch alone cannot distinguish an
+    ULP-level transcendental difference from a real bug."""
+    import tempfile
+
+    import numpy as np
+
+    out: dict[str, dict] = {}
+    with tempfile.TemporaryDirectory() as td:
+        cpu_npz = str(Path(td) / "cpu.npz")
+        acc_npz = str(Path(td) / "acc.npz")
+        _run_child(args, "cpu", dump=(step, cpu_npz))
+        _run_child(args, "accel", dump=(step, acc_npz))
+        a = np.load(cpu_npz)
+        b = np.load(acc_npz)
+        for key in a.files:
+            x, y = a[key], b[key]
+            if x.shape != y.shape:
+                out[key] = {"shape_mismatch": [list(x.shape), list(y.shape)]}
+                continue
+            if not np.array_equal(x, y):
+                dx = np.abs(x.astype(np.float64) - y.astype(np.float64))
+                denom = np.maximum(np.abs(x).astype(np.float64), 1e-30)
+                ulp = 0
+                if x.dtype == np.float32:
+                    ulp = int(
+                        np.max(
+                            np.abs(
+                                x.view(np.int32).astype(np.int64)
+                                - y.view(np.int32).astype(np.int64)
+                            )
+                        )
+                    )
+                out[key] = {
+                    "n_diff": int((dx > 0).sum()),
+                    "max_abs": float(dx.max()),
+                    "max_rel": float((dx / denom).max()),
+                    "max_ulp": ulp,
+                }
+    return out
 
 
 def main() -> None:
@@ -143,6 +217,10 @@ def main() -> None:
             if k not in ("step",) and cpu_row[k] != acc_row.get(k)
         ]
         if diff:
+            try:
+                magnitudes = _divergence_magnitudes(args, step)
+            except Exception as err:  # noqa: BLE001
+                magnitudes = {"error": str(err)[:500]}
             print(
                 json.dumps(
                     {
@@ -150,6 +228,7 @@ def main() -> None:
                         "backends": header,
                         "first_divergence_step": step,
                         "tensors": diff,
+                        "magnitudes": magnitudes,
                         "steps_checked": len(cpu_rows),
                     }
                 )

@@ -1,0 +1,105 @@
+"""
+Tests for the deterministic math building blocks (ops/detmath.py) that
+make CPU-vs-accelerator bit-reproducibility possible: exact integer
+powers, a polynomial exp, and fixed-tree reductions.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magicsoup_tpu.ops.detmath import det_div, det_exp, ipow, sum_axis, sum_hw
+
+
+def test_ipow_matches_power_semantics():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0.0, 30.0, (64,)).astype(np.float32)
+    n = rng.integers(-9, 10, (64,)).astype(np.int32)
+    got = np.asarray(ipow(jnp.asarray(x), jnp.asarray(n)))
+    want = np.power(x.astype(np.float64), n.astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=2e-6)
+
+
+def test_ipow_edge_cases():
+    x = jnp.asarray([0.0, 0.0, 0.0, 2.0, 5.0], dtype=jnp.float32)
+    n = jnp.asarray([0, 3, -2, 0, 1], dtype=jnp.int32)
+    got = np.asarray(ipow(x, n))
+    assert got[0] == 1.0  # 0^0 = 1 (the integrator's neutral element)
+    assert got[1] == 0.0  # 0^+n = 0
+    assert np.isinf(got[2])  # 0^-n = inf (absent inhibitor -> NaN later)
+    assert got[3] == 1.0
+    assert got[4] == 5.0
+
+
+def test_ipow_small_ints_exact():
+    # small integer bases/exponents must be exact (parity with hand math)
+    for base in (2.0, 3.0, 10.0):
+        for n in range(0, 8):
+            got = float(ipow(jnp.float32(base), jnp.int32(n)))
+            assert got == base**n
+
+
+def test_ipow_overflow_saturates_to_inf():
+    got = float(ipow(jnp.float32(1e30), jnp.int32(3)))
+    assert np.isinf(got)
+
+
+def test_det_exp_accuracy():
+    x = np.linspace(-80.0, 80.0, 2001).astype(np.float32)
+    got = np.asarray(det_exp(jnp.asarray(x)))
+    want = np.exp(x.astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=5e-6)
+
+
+def test_det_exp_extremes():
+    assert float(det_exp(jnp.float32(0.0))) == 1.0
+    # out-of-f32-range inputs saturate exactly like np.exp on float32
+    # (0.0 / inf); callers clamp into [EPS, MAX] right after
+    assert float(det_exp(jnp.float32(-500.0))) == 0.0
+    assert np.isinf(float(det_exp(jnp.float32(500.0))))
+    # still finite just inside the f32 range
+    assert np.isfinite(float(det_exp(jnp.float32(88.0))))
+    assert float(det_exp(jnp.float32(-87.0))) > 0.0
+
+
+def test_sum_axis_matches_numpy():
+    rng = np.random.default_rng(1)
+    for shape, axis in [((4, 7, 5), 1), ((3, 28), 1), ((2, 3, 4, 9), 2)]:
+        x = rng.normal(size=shape).astype(np.float32)
+        got = np.asarray(sum_axis(jnp.asarray(x), axis=axis))
+        want = x.astype(np.float64).sum(axis=axis)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sum_hw_matches_numpy():
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 10, (3, 16, 16)).astype(np.float32)
+    got = np.asarray(sum_hw(jnp.asarray(x)))
+    np.testing.assert_allclose(
+        got, x.astype(np.float64).sum(axis=(1, 2)), rtol=1e-6
+    )
+
+
+def test_sum_axis_single_element():
+    x = jnp.asarray(np.ones((3, 1), dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(sum_axis(x, axis=1)), [1, 1, 1])
+
+
+def test_ipow_saturates_out_of_range_exponents():
+    # |n| >= 2^7: limit semantics of x**(±inf), not silent bit truncation
+    x = jnp.asarray([2.0, 1.0, 0.5, 2.0], dtype=jnp.float32)
+    n = jnp.asarray([128, 200, 150, -130], dtype=jnp.int32)
+    got = np.asarray(ipow(x, n))
+    assert np.isinf(got[0])  # 2^128 -> inf
+    assert got[1] == 1.0  # 1^200 = 1
+    assert got[2] == 0.0  # 0.5^150 -> 0
+    assert got[3] == 0.0  # 2^-130 -> 1/inf = 0
+
+
+def test_det_div_huge_divisors():
+    # divisors above the magic-seed range fall back to hardware division
+    for b in (1.5e38, 3.0e38):
+        got = float(det_div(jnp.float32(1.0), jnp.float32(b)))
+        assert got == pytest.approx(1.0 / b, rel=1e-6)
+    # and tiny-but-normal divisors still use the soft path accurately
+    got = float(det_div(jnp.float32(1.0), jnp.float32(1e-30)))
+    assert got == pytest.approx(1e30, rel=1e-6)

@@ -955,3 +955,27 @@ def test_equilibrium_early_stop_matches_literal_port():
         V[rng.random((c, pn)) < 0.3] *= 0.04  # some below impact threshold
         Ke = np.exp(rng.uniform(-12, 12, (c, pn))).astype(np.float32)
         run_case(X0, N, V, Ke)
+
+
+def test_fast_and_deterministic_modes_agree():
+    """The fast (backend-native reductions) and deterministic (fixed-order
+    detmath) integrator modes implement the same math: results agree to
+    float tolerance on random parameter sets, and the deterministic mode
+    passes the same hand-math checks."""
+    rng = np.random.default_rng(11)
+    c, pn, s = 8, 4, 6
+    N = rng.integers(-2, 3, (c, pn, s)).astype(np.int32)
+    Kmf = rng.uniform(0.5, 4.0, (c, pn)).astype(np.float32)
+    Kmb = rng.uniform(0.5, 4.0, (c, pn)).astype(np.float32)
+    Vmax = rng.uniform(0.0, 4.0, (c, pn)).astype(np.float32)
+    p = _raw_params(Kmb / Kmf, Kmf, Kmb, Vmax, N)
+    X = jnp.asarray(rng.uniform(0.0, 6.0, (c, s)).astype(np.float32))
+
+    fast = np.asarray(integ.integrate_signals(X, p, det=False))
+    det = np.asarray(integ.integrate_signals(X, p, det=True))
+    np.testing.assert_allclose(fast, det, rtol=1e-4, atol=1e-5)
+
+    # det mode respects the hand-math single-pass numbers too
+    V_fast = np.asarray(integ._velocities(X, p.Vmax, p, det=False))
+    V_det = np.asarray(integ._velocities(X, p.Vmax, p, det=True))
+    np.testing.assert_allclose(V_fast, V_det, rtol=1e-4, atol=1e-6)

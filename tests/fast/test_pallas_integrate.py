@@ -22,10 +22,11 @@ def _world_with_cells(n: int, seed: int) -> ms.World:
 
 
 def test_pallas_integrator_matches_xla_per_tile():
-    # the equilibrium-correction early-stop is evaluated per tile in the
-    # kernel (batch-global in the XLA path, mirroring the reference's
-    # global torch.any) — so the exact-parity reference is the XLA
-    # integrator applied tile by tile
+    # the kernel runs the DETERMINISTIC math (reduce_prod/pow have no
+    # Mosaic lowering), and its equilibrium-correction early-stop is
+    # evaluated per tile (batch-global in the XLA path, mirroring the
+    # reference's global torch.any) — so the exact-parity reference is
+    # the det-mode XLA integrator applied tile by tile
     world = _world_with_cells(48, seed=3)
     cap = world._capacity
     nprng = np.random.default_rng(3)
@@ -36,7 +37,9 @@ def test_pallas_integrator_matches_xla_per_tile():
     ref_tiles = []
     for a in range(0, cap, tile):
         tile_params = type(params)(*(np.asarray(t)[a : a + tile] for t in params))
-        ref_tiles.append(np.asarray(integrate_signals(X[a : a + tile], tile_params)))
+        ref_tiles.append(
+            np.asarray(integrate_signals(X[a : a + tile], tile_params, det=True))
+        )
     ref = np.concatenate(ref_tiles)
 
     out = np.asarray(
@@ -51,7 +54,7 @@ def test_pallas_integrator_single_tile():
     nprng = np.random.default_rng(5)
     X = nprng.random((cap, 2 * world.n_molecules), dtype=np.float32)
 
-    ref = np.asarray(integrate_signals(X, world.kinetics.params))
+    ref = np.asarray(integrate_signals(X, world.kinetics.params, det=True))
     out = np.asarray(
         integrate_signals_pallas(X, world.kinetics.params, interpret=True)
     )

@@ -183,3 +183,60 @@ def test_custom_axis_name_mesh_works():
     )
     out_mm, out_cm = step(mm, cm, pos, jnp.asarray(world.n_cells), params)
     assert np.isfinite(np.asarray(out_mm)).all()
+
+
+def test_sharded_step_collective_budget():
+    """Census of the collectives GSPMD inserts into the 8-way sharded
+    step (VERDICT r1 item 7).  Measured composition: 2 collective-permutes
+    (the diffusion halos), small all-gathers of the replicated positions,
+    and per-gather-site (mols, cap) all-reduce/all-gather pairs from the
+    cell<->map signal exchange — ~6 MB/step over ICI at benchmark scale,
+    i.e. microseconds; there is NO map-sized or params-sized collective.
+    This test pins the budget so a layout regression (e.g. a future
+    change resharding the parameter tensors every step) shows up."""
+    import re
+    from collections import Counter
+
+    mesh = tiled.make_mesh(8)
+    world = ms.World(chemistry=CHEMISTRY, map_size=64, seed=51, mesh=mesh)
+    rng = random.Random(51)
+    world.spawn_cells([random_genome(s=300, rng=rng) for _ in range(32)])
+    step = tiled.make_sharded_step(
+        mesh, world._diff_kernels, world._perm_factors, world._degrad_factors
+    )
+    hlo = step.lower(
+        world._molecule_map,
+        world._cell_molecules,
+        world._positions_dev,
+        world._n_cells_dev(),
+        world.kinetics.params,
+    ).compile().as_text()
+
+    ops = Counter()
+    big_ops = []
+    for line in hlo.splitlines():
+        m = re.search(
+            r"=\s*(\S+)\s+(all-to-all|all-gather|all-reduce"
+            r"|collective-permute|reduce-scatter)\(",
+            line,
+        )
+        if m:
+            ops[m.group(2)] += 1
+            shape = m.group(1)
+            # dims live inside the brackets — "f32[14,64]" must not parse
+            # the dtype's bit width as a dimension
+            bracket = shape[shape.index("[") :].split("{")[0] if "[" in shape else ""
+            dims = [int(d) for d in re.findall(r"\d+", bracket)]
+            elems = 1
+            for d in dims:
+                elems *= d
+            if elems > 1_000_000:  # > ~4 MB
+                big_ops.append(shape)
+
+    assert ops["collective-permute"] == 2, ops  # the two diffusion halos
+    assert ops.get("all-to-all", 0) == 0, ops
+    # cell<->map exchange: a bounded handful of all-reduce/all-gather
+    assert ops["all-reduce"] <= 20, ops
+    assert ops["all-gather"] <= 10, ops
+    # nothing map- or params-sized ever crosses the interconnect
+    assert big_ops == [], big_ops

@@ -1,0 +1,112 @@
+"""
+Host-side utility tests (counterpart of the reference's util coverage):
+random sequence generation, template expansion, codon enumeration, and
+torus geometry.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from magicsoup_tpu.constants import ALL_NTS, CODON_SIZE
+from magicsoup_tpu.util import (
+    closest_value,
+    codons,
+    dist_1d,
+    free_moores_nghbhd,
+    moores_nghbhd,
+    random_genome,
+    randstr,
+    reverse_complement,
+    round_down,
+    variants,
+)
+
+
+def test_round_down():
+    assert round_down(7.9, to=3) == 6
+    assert round_down(9.0, to=3) == 9
+    assert round_down(2.5, to=3) == 0
+
+
+def test_closest_value():
+    assert closest_value([0.1, 1.0, 10.0], key=0.4) == 0.1
+    assert closest_value([0.1, 1.0, 10.0], key=4.0) == 1.0
+    assert closest_value({2.0: "x", 8.0: "y"}, key=6.0) == 8.0  # iterates keys
+
+
+def test_randstr_seeded():
+    a = randstr(12, rng=random.Random(1))
+    b = randstr(12, rng=random.Random(1))
+    c = randstr(12, rng=random.Random(2))
+    assert len(a) == 12
+    assert a == b
+    assert a != c
+
+
+def test_random_genome_length_and_alphabet():
+    g = random_genome(s=1000, rng=random.Random(0))
+    assert len(g) == 1000
+    assert set(g) <= set(ALL_NTS)
+
+
+def test_random_genome_exclusion():
+    # excluded sequences must not appear, even across re-fill seams
+    excl = ["TTG", "GTG", "ATG", "TGA", "TAG", "TAA"]
+    rng = random.Random(3)
+    for _ in range(20):
+        g = random_genome(s=200, excl=excl, rng=rng)
+        assert len(g) == 200
+        for seq in excl:
+            assert seq not in g
+
+
+def test_variants_expansion():
+    assert sorted(variants("AN")) == sorted(f"A{c}" for c in "TCGA")
+    assert sorted(variants("RY")) == sorted(a + b for a in "AG" for b in "CT")
+    assert variants("ACG") == ["ACG"]
+    assert len(variants("NNN")) == 64
+
+
+def test_codons_enumeration():
+    all1 = codons(1)
+    assert len(all1) == 64
+    assert len(set(all1)) == 64
+    stops = ["TGA", "TAG", "TAA"]
+    non_stop = codons(1, excl_codons=stops)
+    assert len(non_stop) == 61
+    assert not set(stops) & set(non_stop)
+    # 2-codon sequences excluding those containing a stop codon AT A CODON
+    # BOUNDARY: 61 * 61
+    two = codons(2, excl_codons=stops)
+    assert len(two) == 61 * 61
+
+
+def test_reverse_complement():
+    assert reverse_complement("ATCG") == "CGAT"
+    assert reverse_complement("") == ""
+    g = random_genome(s=99, rng=random.Random(5))
+    assert reverse_complement(reverse_complement(g)) == g
+
+
+def test_dist_1d_torus():
+    assert dist_1d(0, 0, 10) == 0
+    assert dist_1d(0, 9, 10) == 1  # wraps
+    assert dist_1d(2, 7, 10) == 5
+    assert dist_1d(7, 2, 10) == 5  # symmetric
+
+
+def test_moores_nghbhd_wraps():
+    n = moores_nghbhd(0, 0, map_size=8)
+    assert len(n) == 8
+    assert (7, 7) in n  # diagonal wrap
+    assert (0, 0) not in n
+    assert all(0 <= x < 8 and 0 <= y < 8 for x, y in n)
+
+
+def test_free_moores_nghbhd():
+    occupied = [(0, 1), (1, 1)]
+    free = free_moores_nghbhd(0, 0, positions=occupied, map_size=8)
+    assert (0, 1) not in free
+    assert (1, 1) not in free
+    assert len(free) == 6
