@@ -3,35 +3,42 @@ Deterministic, backend-independent math building blocks.
 
 The CPU-vs-TPU bit-reproducibility target (BASELINE.md north star) fails
 on exactly three classes of primitives, because XLA lowers them to
-backend-specific implementations:
+backend-specific implementations.  Each class was isolated empirically
+on TPU v5e vs XLA:CPU (see BITREPRO.md):
 
 1. transcendentals (`exp`, `pow`) — each backend ships its own
-   approximation, so results differ by a few ULP;
+   approximation (measured: up to 67 ULP in exp-derived values);
 2. reductions (`sum`, `prod`, convolutions) — each backend picks its own
    reduction tree, and float addition is not associative;
-3. excess-precision rewrites (FMA contraction) — measured to happen ONLY
-   inside large fusions on TPU (an isolated ``a*b+c`` jit two-rounds, the
-   same expression fused into a big program contracts), so every
-   multiply feeding an add/sub below is separated by
-   ``lax.optimization_barrier``; `scripts/bitrepro.py` additionally sets
-   ``XLA_FLAGS=--xla_allow_excess_precision=false``.
+3. division and mixed multiply-add:
+   - f32 (and even f64) HARDWARE division is not correctly rounded on
+     TPU (measured: up to 2 ULP vs CPU);
+   - a float32 multiply feeding an add/sub inside ANY fusion is
+     FMA-contracted on TPU (single rounding) but not on CPU — and
+     ``lax.optimization_barrier`` does NOT prevent it (measured: a
+     standalone jitted Horner with barriers still differs by 1 ULP
+     while the op-by-op eager execution is bit-identical).
 
-Everything here is built ONLY from IEEE-754-exact single ops (add, sub,
-mul, div, compare, select, integer bit ops) applied in a fixed order, so
-any two IEEE-conforming backends produce bit-identical results.  The
-constructions are also TPU-friendly: masked square-and-multiply replaces
-`pow` (faster than a transcendental on the VPU), and the fixed binary
-reduction trees vectorize exactly like the backend's own.
+The verified-deterministic primitive set on both backends is therefore:
+float32 multiply CHAINS, float32 add/sub TREES (no multiply operands),
+float64 multiply+add (the TPU emulates f64 in software, measured
+bit-identical even fused), integer/bit ops, compares, selects, and
+dtype conversions.  Everything here is built only from that set:
+
+- `ipow` — masked square-and-multiply (f32 multiply chain + selects);
+- `det_exp` — exp2-split + Horner polynomial evaluated in float64;
+- `det_div` — magic-constant seeded Newton reciprocal iterated in
+  float64 (no hardware division on the soft path);
+- `tree_reduce`/`sum_axis`/`prod_axis` — fixed binary reduction trees;
+  `sum_axis` accumulates in float64 so raw-product inputs are separated
+  from the first add level by a dtype conversion (structurally
+  un-contractable, unlike a barrier).
+
+`scripts/bitrepro.py` additionally sets
+``XLA_FLAGS=--xla_allow_excess_precision=false`` for both children.
 """
 import jax
 import jax.numpy as jnp
-
-
-def _nofma(x: jax.Array) -> jax.Array:
-    """Pin a multiply result so XLA cannot contract it into a dependent
-    add/sub as an FMA (which rounds once instead of twice and does so
-    backend-dependently)."""
-    return jax.lax.optimization_barrier(x)
 
 _LOG2E = 1.4426950408889634
 # Taylor coefficients of 2^f = exp(f ln2) on f in [-0.5, 0.5]
@@ -46,18 +53,30 @@ _EXP2_COEFFS = (
     1.525273380405984e-5,
 )
 _POW_BITS = 7  # supports |n| <= 127; stoichiometries/hill sums stay far below
+_F32_MIN_NORMAL = 1.17549435e-38
 
 
-def ipow(x: jax.Array, n: jax.Array) -> jax.Array:
+def _f64(x: jax.Array) -> jax.Array:
+    """Convert to float64 (requires the enclosing x64 context)."""
+    return x.astype(jnp.float64)
+
+
+def ipow(x: jax.Array, n: jax.Array, nonneg: bool = False) -> jax.Array:
     """
     ``x ** n`` for float ``x >= 0`` and integer ``n`` via masked
-    square-and-multiply — bit-identical across backends, and matching
-    ``jnp.power``'s edge semantics on the integrator's domain:
-    ``0**0 = 1``, ``0**+n = 0``, ``0**-n = inf``.
+    square-and-multiply — a pure f32 multiply chain plus selects, both
+    bit-identical across backends — matching ``jnp.power``'s edge
+    semantics on the integrator's domain: ``0**0 = 1``, ``0**+n = 0``,
+    ``0**-n = inf``.
 
     Exponents with ``|n| >= 2**_POW_BITS`` (beyond any real stoichiometry
     or hill sum) saturate to the limit value 0/1/inf of ``x**±inf``
     instead of silently dropping high bits.
+
+    ``nonneg=True`` (static) promises ``n >= 0`` and skips the Newton
+    reciprocal for the negative-exponent branch entirely — the
+    substrate/product stoichiometries (Nf/Nb) are non-negative by
+    construction and are the integrator's hottest ipow sites.
     """
     n = n.astype(jnp.int32)
     absn = jnp.abs(n)
@@ -72,75 +91,90 @@ def ipow(x: jax.Array, n: jax.Array) -> jax.Array:
         x > 1.0, jnp.float32(jnp.inf), jnp.where(x == 1.0, 1.0, 0.0)
     )
     r = jnp.where(absn >= (1 << _POW_BITS), huge, r)
+    if nonneg:
+        return r
     return jnp.where(n < 0, det_div(jnp.ones_like(r), r), r)
 
 
 def det_exp(x: jax.Array) -> jax.Array:
     """
-    ``exp(x)`` from exact ops only: split ``x·log2(e) = k + f`` with
-    integer ``k`` and ``f ∈ [-0.5, 0.5]``, evaluate ``2^f`` by a fixed
-    Horner polynomial, and scale by ``2^k`` built by integer bit
-    assembly.  Accuracy ~1-2 ULP vs the libm exp; identical on every
-    IEEE backend.
+    ``exp(x)`` deterministic across backends: split ``x·log2(e) = k + f``
+    with integer ``k`` and ``f ∈ [-0.5, 0.5]``, evaluate ``2^f`` by a
+    Horner polynomial in FLOAT64 (f64 multiply+add is deterministic on
+    both backends even when fused; the f32 Horner gets FMA-contracted on
+    TPU only), and scale by ``2^k`` built by integer bit assembly.
+    Returns float32; accuracy ~1 ULP vs libm, saturating to 0/inf exactly
+    where float32 ``np.exp`` does.
     """
-    x = x.astype(jnp.float32)
-    y = x * jnp.float32(_LOG2E)
-    k = jnp.round(y)
-    f = (y - k).astype(jnp.float32)
+    with jax.enable_x64(True):
+        x64 = _f64(x)
+        y = x64 * _LOG2E
+        k = jnp.round(y)
+        f = y - k
 
-    p = jnp.full_like(f, _EXP2_COEFFS[-1])
-    for c in _EXP2_COEFFS[-2::-1]:
-        p = _nofma(p * f) + jnp.float32(c)
+        p = jnp.full_like(f, _EXP2_COEFFS[-1])
+        for c in _EXP2_COEFFS[-2::-1]:
+            p = p * f + c
 
-    # 2^k via exponent-field assembly; clamp into normal f32 range and
-    # split into two factors so k in [-252, 252] is representable
-    # (NaN -> 0 first: NaN-to-int conversion is backend-defined)
-    k = jnp.clip(jnp.nan_to_num(k), -252.0, 252.0).astype(jnp.int32)
-    k_half = k // 2
-    k_rest = k - k_half
-
-    def pow2i(e):
-        return jax.lax.bitcast_convert_type(
-            ((e + 127) << 23).astype(jnp.int32), jnp.float32
+        # 2^k via f64 exponent-field assembly (one factor covers the
+        # whole f64 range; overflow/underflow happens at the final f32
+        # downcast, exactly like np.exp on float32)
+        # (NaN -> 0 first: NaN-to-int conversion is backend-defined)
+        k = jnp.clip(jnp.nan_to_num(k), -1022.0, 1023.0).astype(jnp.int64)
+        scale = jax.lax.bitcast_convert_type(
+            (k + 1023) << 52, jnp.float64
         )
-
-    return p * pow2i(k_half) * pow2i(k_rest)
+        out = (p * scale).astype(jnp.float32)
+    # ±inf inputs: f = inf - inf = NaN poisons the polynomial; restore the
+    # np.exp saturation contract (exp(inf) = inf, exp(-inf) = 0)
+    out = jnp.where(x == jnp.inf, jnp.float32(jnp.inf), out)
+    out = jnp.where(x == -jnp.inf, jnp.float32(0.0), out)
+    return out
 
 
 def det_div(a: jax.Array, b: jax.Array) -> jax.Array:
     """
-    Deterministic float32 division.  Hardware f32 division is NOT
-    correctly rounded on TPU (measured: up to 2 ULP off the CPU result),
-    so ``a / b`` is the one arithmetic primitive that cannot be used
-    directly for cross-backend bit-reproducibility.  This computes the
-    reciprocal by the classic magic-constant bit hack plus Newton
-    iterations — integer ops, multiplies and subtractions only, all of
-    which ARE exact on both backends — then multiplies.  Accuracy ~1 ULP;
-    more importantly, bit-identical everywhere.
+    Deterministic float32 division.  Hardware division is NOT correctly
+    rounded on TPU in f32 or f64 (measured: up to 2 ULP off the CPU
+    result), so ``a / b`` cannot be used directly.  The divisor's
+    mantissa is extracted by integer bit ops into [1, 2), its reciprocal
+    is seeded by the classic magic-constant bit hack (exact) and refined
+    by Newton iterations in FLOAT64 — whose fused multiply+add is
+    deterministic on both backends — then rescaled by the exact power of
+    two of the original exponent, so EVERY normal-range f32 divisor takes
+    the deterministic path.  Relative error ~1e-16 before the single
+    rounding to f32.
 
-    Non-finite/zero divisors fall back to hardware division: IEEE special
-    cases (x/0 = ±inf, x/inf = 0, NaN propagation) are exact on every
-    backend.  |b| must otherwise be in the normal range; the simulation
-    clamps its divisors into [EPS, MAX] = [1e-36, 1e36], far inside it.
+    Subnormal, zero, and non-finite divisors fall back to hardware
+    division: IEEE special cases (x/0 = ±inf, x/inf = 0, NaN propagation)
+    are exact everywhere, and subnormal divisors diverge at input level
+    anyway via the TPU's flush-to-zero.
     """
     bn = jnp.abs(b)
-    # seed: r0 ~ 1/bn with ~3% error (0x7EF311C3 bit trick)
     bits = jax.lax.bitcast_convert_type(bn, jnp.int32)
-    r = jax.lax.bitcast_convert_type(jnp.int32(0x7EF311C3) - bits, jnp.float32)
-    for _ in range(4):
-        # Newton: quadratic convergence; barrier stops FMS contraction
-        r = r * (2.0 - _nofma(bn * r))
-    q = a * r
-    q = jnp.where(jnp.signbit(b), -q, q)
-    # soft path only where the seed is valid: NORMAL-range divisors below
-    # ~1.6e38 (the magic-constant subtraction underflows above that, and
-    # denormal divisors diverge at input level anyway via TPU FTZ);
-    # outside, hardware division — IEEE special cases are exact everywhere
-    ok = (
-        (bn >= jnp.float32(1.17549435e-38))
-        & (bn <= jnp.float32(1e37))
-        & jnp.isfinite(bn)
+    # normalize: mantissa m in [1, 2) with bn = m * 2^e (all exact bit ops)
+    e = (bits >> 23) - 127  # unbiased exponent (normal bn only)
+    m = jax.lax.bitcast_convert_type(
+        (bits & jnp.int32(0x007FFFFF)) | jnp.int32(0x3F800000), jnp.float32
     )
+    # seed: r0 ~ 1/m with ~3% error (0x7EF311C3 bit trick, f32 exact)
+    seed = jax.lax.bitcast_convert_type(
+        jnp.int32(0x7EF311C3)
+        - jax.lax.bitcast_convert_type(m, jnp.int32),
+        jnp.float32,
+    )
+    with jax.enable_x64(True):
+        m64 = _f64(m)
+        r = _f64(seed)
+        for _ in range(4):
+            r = r * (2.0 - m64 * r)  # f64 Newton: deterministic fused
+        # 1/bn = (1/m) * 2^-e; scale by exact f64 exponent assembly
+        scale = jax.lax.bitcast_convert_type(
+            (jnp.int64(1023) - e.astype(jnp.int64)) << 52, jnp.float64
+        )
+        q = (_f64(a) * (r * scale)).astype(jnp.float32)
+    q = jnp.where(jnp.signbit(b), -q, q)
+    ok = (bn >= jnp.float32(_F32_MIN_NORMAL)) & jnp.isfinite(bn)
     return jnp.where(ok, q, a / b)
 
 
@@ -171,15 +205,23 @@ def tree_reduce(x: jax.Array, axis: int, op, identity: float) -> jax.Array:
 
 
 def sum_axis(x: jax.Array, axis: int) -> jax.Array:
-    """Deterministic float sum over one axis (fixed binary tree)."""
-    # the summands are often products; stop the first tree level from
-    # absorbing them as FMAs
-    return tree_reduce(_nofma(x), axis, jnp.add, 0.0)
+    """
+    Deterministic float sum over one axis.  The tree accumulates in
+    FLOAT64: the up-conversion structurally separates raw-product inputs
+    from the first add level (an f32 multiply feeding an f32 add would be
+    FMA-contracted on TPU regardless of optimization barriers), and f64
+    multiply/add is itself deterministic on both backends.  Returns the
+    input dtype.
+    """
+    with jax.enable_x64(True):
+        out = tree_reduce(_f64(x), axis, jnp.add, 0.0)
+        return out.astype(x.dtype)
 
 
 def prod_axis(x: jax.Array, axis: int) -> jax.Array:
-    """Deterministic float product over one axis (fixed binary tree) —
-    also the Pallas-lowerable form (`reduce_prod` has no Mosaic rule)."""
+    """Deterministic float product over one axis (fixed binary f32
+    multiply tree — multiply chains do not get contracted) — also the
+    Pallas-lowerable form (`reduce_prod` has no Mosaic rule)."""
     return tree_reduce(x, axis, jnp.multiply, 1.0)
 
 

@@ -14,11 +14,13 @@ molecule), wrap-padding via ``jnp.pad(mode="wrap")``, and all three physics
 ops exposed as pure functions over the full slot-capacity state so they fuse
 under a single jit with the gather/scatter of cell signals.
 """
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from magicsoup_tpu.ops.detmath import _nofma, det_div, sum_hw
+from magicsoup_tpu.ops.detmath import det_div, sum_hw
 
 
 def diffusion_kernels(diffusivities: list[float]) -> np.ndarray:
@@ -52,51 +54,86 @@ def degradation_factors(half_lives: list[float]) -> np.ndarray:
     )
 
 
-@jax.jit
-def diffuse(molecule_map: jax.Array, kernels: jax.Array) -> jax.Array:
+def stencil_3x3(map_: jax.Array, kernels: jax.Array) -> jax.Array:
+    """The 9-tap torus stencil in its one canonical FIXED tap order —
+    shared by the fast and deterministic branches and mirrored (with halo
+    slices instead of row rolls) by the sharded version in
+    parallel/tiled.py; the order is load-bearing for det/fast and
+    sharded/unsharded agreement, so it must not drift between copies.
+    Correlation semantics: out[x,y] += k[i,j] * map[x+i-1, y+j-1]."""
+    out = jnp.zeros_like(map_)
+    for i in range(3):
+        for j in range(3):
+            out = out + kernels[:, i, j][:, None, None] * jnp.roll(
+                map_, shift=(1 - i, 1 - j), axis=(1, 2)
+            )
+    return out
+
+
+@partial(jax.jit, static_argnames=("det",))
+def diffuse(
+    molecule_map: jax.Array, kernels: jax.Array, det: bool = False
+) -> jax.Array:
     """
     One diffusion step: a depthwise 3x3 torus stencil for every molecule
     channel at once, followed by the reference's mass-conservation fixup
     (rounding errors spread over all pixels) and a clamp at zero.
 
-    The stencil is 9 explicit roll-multiply-adds in a FIXED order and the
-    map totals use a fixed binary reduction tree — a backend convolution
-    would pick its own tap/reduction order, breaking CPU-vs-TPU
-    bit-reproducibility.  Unlike the integrator there is no fast/det
-    split: a 3x3 depthwise conv cannot use the MXU, so the stencil costs
-    the same as the convolution it replaces (~1 ms at 128x128).
+    The stencil is 9 explicit roll-multiply-adds in a FIXED order — a
+    backend convolution would pick its own tap order, and a 3x3 depthwise
+    conv cannot use the MXU anyway, so the stencil costs the same.  In
+    deterministic mode the accumulation runs in FLOAT64 (an f32 tap
+    multiply feeding the f32 accumulating add would be FMA-contracted on
+    TPU but not CPU; f64 multiply-add is deterministic on both) and the
+    map totals use the fixed f64 reduction tree.
     """
     m = molecule_map.shape[1]
+
+    # totals use the f64 tree in BOTH modes: the fixup is a small
+    # difference of large sums (catastrophic cancellation), and f32
+    # totals make the single-device and halo-sharded paths disagree at
+    # ~1e-5 rel
     total_before = sum_hw(molecule_map)  # (mols,)
+    if det:
+        with jax.enable_x64(True):
+            out = stencil_3x3(
+                molecule_map.astype(jnp.float64), kernels.astype(jnp.float64)
+            ).astype(jnp.float32)
+        total_after = sum_hw(out)
+        fix = det_div(total_before - total_after, jnp.float32(m * m))
+    else:
+        out = stencil_3x3(molecule_map, kernels)
+        total_after = sum_hw(out)
+        fix = (total_before - total_after) / (m * m)
 
-    out = jnp.zeros_like(molecule_map)
-    for i in range(3):
-        for j in range(3):
-            # correlation semantics: out[x,y] += k[i,j] * map[x+i-1, y+j-1]
-            # (_nofma: keep the tap multiply from contracting into the
-            # accumulating add as a backend-dependent FMA)
-            term = _nofma(
-                kernels[:, i, j][:, None, None]
-                * jnp.roll(molecule_map, shift=(1 - i, 1 - j), axis=(1, 2))
-            )
-            out = out + term
-
-    total_after = sum_hw(out)
-    fix = det_div(total_before - total_after, jnp.float32(m * m))
     out = out + fix[:, None, None]
     return jnp.clip(out, min=0.0)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("det",))
 def permeate(
     cell_molecules: jax.Array,  # (c, n_mols) intracellular
     ext_molecules: jax.Array,  # (c, n_mols) the cells' map pixels
     factors: jax.Array,  # (n_mols,)
+    det: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Exchange molecules between each cell and its pixel by the per-species
-    permeation ratio (reference world.py:654-665)."""
-    d_int = _nofma(cell_molecules * factors)
-    d_ext = _nofma(ext_molecules * factors)
+    permeation ratio (reference world.py:654-665).  Deterministic mode
+    computes in float64: the exchange products feed adds/subs, which f32
+    would FMA-contract backend-dependently."""
+    if det:
+        with jax.enable_x64(True):
+            cm = cell_molecules.astype(jnp.float64)
+            ext = ext_molecules.astype(jnp.float64)
+            fac = factors.astype(jnp.float64)
+            d_int = cm * fac
+            d_ext = ext * fac
+            return (
+                (cm + d_ext - d_int).astype(jnp.float32),
+                (ext + d_int - d_ext).astype(jnp.float32),
+            )
+    d_int = cell_molecules * factors
+    d_ext = ext_molecules * factors
     return cell_molecules + d_ext - d_int, ext_molecules + d_int - d_ext
 
 

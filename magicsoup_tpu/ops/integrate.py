@@ -75,8 +75,12 @@ class CellParams(NamedTuple):
     A: jax.Array  # (c,p,s) i32 allosteric hill exponents (+-)
 
 
-def _pow(x: jax.Array, n: jax.Array, det: bool) -> jax.Array:
-    return ipow(x, n) if det else jnp.power(x, n.astype(jnp.float32))
+def _pow(
+    x: jax.Array, n: jax.Array, det: bool, nonneg: bool = False
+) -> jax.Array:
+    if det:
+        return ipow(x, n, nonneg=nonneg)
+    return jnp.power(x, n.astype(jnp.float32))
 
 
 def _prod2(x: jax.Array, det: bool) -> jax.Array:
@@ -106,7 +110,8 @@ def _multiply_signals(
     """
     M = N > 0  # (c,p,s)
     x = jnp.where(M, X[:, None, :], 0.0)
-    xx = _prod2(_pow(x, N, det), det)  # (c,p)
+    # all callers pass Nf/Nb, which are >= 0 by construction
+    xx = _prod2(_pow(x, N, det, nonneg=True), det)  # (c,p)
     xx = jnp.where(jnp.isnan(xx), 0.0, xx)
     xx = jnp.where(xx < 0.0, 0.0, xx)
     xx = jnp.where(jnp.isinf(xx), MAX, xx)

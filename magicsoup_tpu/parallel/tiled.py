@@ -69,20 +69,21 @@ def shard_params(params: CellParams, mesh: Mesh) -> CellParams:
 
 
 def halo_diffuse(
-    molecule_map: jax.Array, kernels: jax.Array, mesh: Mesh
+    molecule_map: jax.Array, kernels: jax.Array, mesh: Mesh, det: bool = False
 ) -> jax.Array:
     """
-    One diffusion step on the row-sharded molecule map: each tile convolves
-    its local rows plus 1-row halos fetched from its torus neighbors over
-    ICI; the reference's mass-conservation fixup becomes a global psum.
-    Matches :func:`magicsoup_tpu.ops.diffusion.diffuse` numerically.
+    One diffusion step on the row-sharded molecule map: each tile applies
+    the stencil to its local rows plus 1-row halos fetched from its torus
+    neighbors over ICI; the reference's mass-conservation fixup becomes a
+    global psum.  Matches :func:`magicsoup_tpu.ops.diffusion.diffuse`
+    tap for tap in both numeric modes.
     """
     axis = mesh.axis_names[0]
     n_tiles = mesh.shape[axis]
     m = molecule_map.shape[1]
 
     if n_tiles == 1:
-        return _diff.diffuse(molecule_map, kernels)
+        return _diff.diffuse(molecule_map, kernels, det=det)
 
     up = [(i, (i - 1) % n_tiles) for i in range(n_tiles)]
     down = [(i, (i + 1) % n_tiles) for i in range(n_tiles)]
@@ -97,7 +98,6 @@ def halo_diffuse(
         # local: (mols, m/n_tiles, m); kern arrives flattened (mols, 9)
         kern = kern.reshape(-1, 3, 3)
         n_local = local.shape[1]
-        total_before = jax.lax.psum(_diff.sum_hw(local), axis)
 
         # my first row becomes the lower halo of the tile above, my last row
         # the upper halo of the tile below (torus-wrapped)
@@ -105,17 +105,54 @@ def halo_diffuse(
         halo_for_below = jax.lax.ppermute(local[:, -1:, :], axis, down)
         rows = jnp.concatenate([halo_for_below, local, halo_for_above], axis=1)
 
-        # same fixed-order 9-tap stencil as ops.diffusion.diffuse (rows via
-        # halo slices, columns via local torus roll) so the sharded step is
-        # numerically identical to the single-device one, tap for tap
-        out = jnp.zeros_like(local)
-        for i in range(3):
-            for j in range(3):
-                shifted = jnp.roll(rows[:, i : i + n_local, :], 1 - j, axis=2)
-                out = out + _diff._nofma(kern[:, i, j][:, None, None] * shifted)
+        def stencil(rows_, kern_):
+            out_ = jnp.zeros(
+                (local.shape[0], n_local, local.shape[2]), dtype=rows_.dtype
+            )
+            for i in range(3):
+                for j in range(3):
+                    shifted = jnp.roll(
+                        rows_[:, i : i + n_local, :], 1 - j, axis=2
+                    )
+                    out_ = out_ + kern_[:, i, j][:, None, None] * shifted
+            return out_
 
-        total_after = jax.lax.psum(_diff.sum_hw(out), axis)
-        fix = _diff.det_div(total_before - total_after, jnp.float32(m * m))
+        def det_total(arr):
+            # per-tile f64 partial -> all-gather -> FIXED tree over tiles:
+            # a psum's all-reduce order is backend/topology-chosen, which
+            # would break the deterministic mode's bit-identity (and
+            # differ from the single-device global tree)
+            from magicsoup_tpu.ops.detmath import tree_reduce
+
+            with jax.enable_x64(True):
+                part = tree_reduce(
+                    arr.reshape(arr.shape[0], -1).astype(jnp.float64),
+                    1, jnp.add, 0.0,
+                )  # (mols,) f64
+                parts = jax.lax.all_gather(part, axis)  # (tiles, mols)
+                return tree_reduce(parts, 0, jnp.add, 0.0)  # f64
+
+        if det:
+            # f64 accumulation + fixed trees + soft division, matching
+            # the single-device deterministic stencil
+            total_before = det_total(local)
+            with jax.enable_x64(True):
+                out = stencil(
+                    rows.astype(jnp.float64), kern.astype(jnp.float64)
+                ).astype(jnp.float32)
+            total_after = det_total(out)
+            fix = _diff.det_div(
+                (total_before - total_after).astype(jnp.float32),
+                jnp.float32(m * m),
+            )
+        else:
+            # f64-tree totals in fast mode too (cancellation — see
+            # ops.diffusion.diffuse)
+            total_before = jax.lax.psum(_diff.sum_hw(local), axis)
+            out = stencil(rows, kern)
+            total_after = jax.lax.psum(_diff.sum_hw(out), axis)
+            fix = (total_before - total_after) / (m * m)
+
         out = out + fix[:, None, None]
         return jnp.clip(out, min=0.0)
 
@@ -127,12 +164,14 @@ def make_sharded_step(
     kernels: jax.Array,
     perm_factors: jax.Array,
     degrad_factors: jax.Array,
+    det: bool = False,
 ):
     """
     Build the fused one-step simulation function for a tile-sharded world:
     enzymatic activity (cell-sharded kinetics + GSPMD cell<->map exchange),
     halo-exchange diffusion, membrane permeation, and degradation under a
-    single jit over the mesh.
+    single jit over the mesh.  ``det`` selects the deterministic numeric
+    mode for every phase (see ops.integrate / BITREPRO.md).
     """
     map_sh = map_sharding(mesh)
     cell_sh = cell_sharding(mesh)
@@ -159,17 +198,19 @@ def make_sharded_step(
         # enzymatic activity
         ext = molecule_map[:, xs, ys].T
         X0 = jnp.concatenate([cell_molecules, ext], axis=1)
-        X1 = integrate_signals(X0, params)
+        X1 = integrate_signals(X0, params, det=det)
         cell_molecules = jnp.where(alive, X1[:, :n_mols], cell_molecules)
         delta = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
         molecule_map = molecule_map.at[:, xs, ys].add(delta.T)
 
         # diffusion with ICI halo exchange
-        molecule_map = halo_diffuse(molecule_map, kernels, mesh)
+        molecule_map = halo_diffuse(molecule_map, kernels, mesh, det=det)
 
         # membrane permeation
         ext = molecule_map[:, xs, ys].T
-        new_cm, new_ext = _diff.permeate(cell_molecules, ext, perm_factors)
+        new_cm, new_ext = _diff.permeate(
+            cell_molecules, ext, perm_factors, det=det
+        )
         cell_molecules = jnp.where(alive, new_cm, cell_molecules)
         delta = jnp.where(alive, new_ext - ext, 0.0)
         molecule_map = molecule_map.at[:, xs, ys].add(delta.T)

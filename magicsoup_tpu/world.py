@@ -36,7 +36,7 @@ from magicsoup_tpu.genetics import Genetics
 from magicsoup_tpu.kinetics import Kinetics
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import diffusion as _diff
-from magicsoup_tpu.ops.integrate import integrate_signals
+from magicsoup_tpu.ops.integrate import default_deterministic, integrate_signals
 from magicsoup_tpu.ops.params import pad_idxs, pad_pow2
 from magicsoup_tpu.util import randstr
 
@@ -77,11 +77,33 @@ def _make_enzymatic_activity(integrator):
     return _enzymatic_activity
 
 
-_enzymatic_activity = _make_enzymatic_activity(integrate_signals)
-_enzymatic_activity_pallas = None  # built lazily on first use
+_activity_fns: dict = {}  # keyed by (det, pallas); built lazily
 
 
-@jax.jit
+def _get_activity_fn(det: bool, pallas: bool):
+    key = (det, pallas)
+    if key not in _activity_fns:
+        if pallas:
+            import functools
+
+            from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+            interpret = jax.default_backend() != "tpu"
+            integrator = functools.partial(
+                integrate_signals_pallas, interpret=interpret
+            )
+        else:
+            def integrator(X, params, _det=det):
+                return integrate_signals(X, params, det=_det)
+
+        _activity_fns[key] = _make_enzymatic_activity(integrator)
+    return _activity_fns[key]
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("det",))
 def _diffuse_and_permeate(
     molecule_map: jax.Array,
     cell_molecules: jax.Array,
@@ -89,14 +111,15 @@ def _diffuse_and_permeate(
     n_cells: jax.Array,
     kernels: jax.Array,
     perm_factors: jax.Array,
+    det: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Map diffusion + membrane permeation (reference world.py:627-665)"""
-    new_map = _diff.diffuse(molecule_map, kernels)
+    new_map = _diff.diffuse(molecule_map, kernels, det=det)
     cap = cell_molecules.shape[0]
     alive = (jnp.arange(cap) < n_cells)[:, None]
     xs, ys = positions[:, 0], positions[:, 1]
     ext = new_map[:, xs, ys].T
-    new_cm, new_ext = _diff.permeate(cell_molecules, ext, perm_factors)
+    new_cm, new_ext = _diff.permeate(cell_molecules, ext, perm_factors, det=det)
     new_cm = jnp.where(alive, new_cm, cell_molecules)
     delta_ext = jnp.where(alive, new_ext - ext, 0.0)
     new_map = new_map.at[:, xs, ys].add(delta_ext.T)
@@ -287,6 +310,10 @@ class World:
                 " integrator"
             )
         self.use_pallas = bool(use_pallas)
+        # numeric mode, fixed per instance at construction (README
+        # "Numeric modes"): deterministic = bit-reproducible across
+        # backends, fast = backend-native lowerings
+        self.deterministic = default_deterministic()
 
         self.genetics = Genetics(
             start_codons=start_codons,
@@ -944,19 +971,7 @@ class World:
     # ------------------------------------------------------------------ #
 
     def _activity_fn(self):
-        if not self.use_pallas:
-            return _enzymatic_activity
-        global _enzymatic_activity_pallas
-        if _enzymatic_activity_pallas is None:
-            import functools
-
-            from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
-
-            interpret = jax.default_backend() != "tpu"
-            _enzymatic_activity_pallas = _make_enzymatic_activity(
-                functools.partial(integrate_signals_pallas, interpret=interpret)
-            )
-        return _enzymatic_activity_pallas
+        return _get_activity_fn(self.deterministic, self.use_pallas)
 
     def enzymatic_activity(self):
         """Catalyze reactions and transport for one time step; updates
@@ -975,7 +990,9 @@ class World:
         """Let molecules diffuse over the map and permeate membranes for
         one time step."""
         if self.n_cells == 0:
-            self._molecule_map = _diff.diffuse(self._molecule_map, self._diff_kernels)
+            self._molecule_map = _diff.diffuse(
+                self._molecule_map, self._diff_kernels, det=self.deterministic
+            )
             return
         self._molecule_map, self._cell_molecules = _diffuse_and_permeate(
             self._molecule_map,
@@ -984,6 +1001,7 @@ class World:
             self._n_cells_dev(),
             self._diff_kernels,
             self._perm_factors,
+            det=self.deterministic,
         )
 
     def degrade_molecules(self):
@@ -1099,6 +1117,7 @@ class World:
         self.__dict__.update(state)
         # compat defaults for pickles from before these attributes existed
         self.__dict__.setdefault("use_pallas", False)
+        self.__dict__.setdefault("deterministic", default_deterministic())
         self.__dict__.setdefault("_mm_cache", None)
         self.__dict__.setdefault("_cm_cache", None)
         self.__dict__.setdefault("_mesh", None)

@@ -16,7 +16,7 @@ from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
 from magicsoup_tpu.ops import diffusion as _diff
 from magicsoup_tpu.parallel import tiled
 from magicsoup_tpu.util import random_genome
-from magicsoup_tpu.world import _diffuse_and_permeate, _enzymatic_activity
+from magicsoup_tpu.world import _diffuse_and_permeate, _get_activity_fn
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
@@ -52,7 +52,7 @@ def test_sharded_step_matches_unsharded():
     n_dev = jnp.asarray(world.n_cells, dtype=jnp.int32)
 
     # unsharded reference result
-    ref_mm, ref_cm = _enzymatic_activity(
+    ref_mm, ref_cm = _get_activity_fn(det=False, pallas=False)(
         world.molecule_map,
         world._cell_molecules,
         world._positions_dev,

@@ -471,3 +471,22 @@ def test_cell_molecule_column_and_add():
 
     world.add_cell_molecules([], mol_idx=2, delta=1.0)  # no-op
     np.testing.assert_allclose(world.cell_molecule_column(2), want, rtol=1e-6)
+
+
+def test_spawn_cells_overflow_subsamples_without_mutating_input():
+    # more genomes than free pixels: a random (seeded) subset is spawned
+    # and the CALLER'S list is left untouched (the reference shuffles the
+    # caller's list in place — world.py:570-574 — which silently changes
+    # selection semantics for the caller)
+    world = ms.World(chemistry=_chem(), map_size=4, seed=5)  # 16 pixels
+    genomes = _genomes(30, s=100, seed=20)
+    before = list(genomes)
+    idxs = world.spawn_cells(genomes)
+    assert genomes == before  # input not mutated
+    assert len(idxs) == 16  # every pixel filled
+    assert world.n_cells == 16
+    # the spawned genomes are a subset of the provided ones
+    assert set(world.cell_genomes) <= set(before)
+    # spawning into a full map is a no-op
+    assert world.spawn_cells(_genomes(3, s=100, seed=21)) == []
+    assert world.n_cells == 16
