@@ -268,13 +268,63 @@ def recombinations(
     lens = np.fromiter(
         (len(a) + len(b) for a, b in seq_pairs), dtype=np.int64, count=len(seq_pairs)
     )
-    nprng = np.random.default_rng(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
-    n_breaks = nprng.poisson(p * lens)
-    sel = np.nonzero(n_breaks > 0)[0]
+    sel, counts = _poisson_select(lens, p, seed)
     if len(sel) == 0:
         return []
     sub = [seq_pairs[int(i)] for i in sel]
-    counts = n_breaks[sel].astype(np.int64)
+    return _recombinations_selected(sub, counts, sel, seed, n_threads)
+
+
+def recombinations_indexed(
+    genomes: list[str],
+    pair_idxs: np.ndarray,
+    p: float,
+    seed: int,
+    n_threads: int = 0,
+) -> list[tuple[str, str, int]]:
+    """
+    :func:`recombinations` over index pairs into a genome list, avoiding
+    the materialization of one string-pair tuple per candidate pair —
+    with ~2.4 neighbor pairs per cell and a per-pair break probability of
+    ~1e-4, building the pair list costs more than the recombination
+    itself.  Draws the identical Poisson stream (pair-list order), so
+    ``recombinations(pairs, ...)`` and
+    ``recombinations_indexed(genomes, idxs, ...)`` produce the same
+    result for the same pairs.  Returned index = row into ``pair_idxs``.
+    """
+    if len(pair_idxs) == 0:
+        return []
+    lens = np.fromiter(
+        (len(g) for g in genomes), dtype=np.int64, count=len(genomes)
+    )
+    pair_lens = lens[pair_idxs[:, 0]] + lens[pair_idxs[:, 1]]
+    sel, counts = _poisson_select(pair_lens, p, seed)
+    if len(sel) == 0:
+        return []
+    sub = [
+        (genomes[int(a)], genomes[int(b)])
+        for a, b in pair_idxs[sel]
+    ]
+    return _recombinations_selected(sub, counts, sel, seed, n_threads)
+
+
+def _poisson_select(
+    lens: np.ndarray, p: float, seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pre-draw Poisson(p*len) counts; return (selected idxs, their counts)"""
+    nprng = np.random.default_rng(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
+    n_breaks = nprng.poisson(p * lens)
+    sel = np.nonzero(n_breaks > 0)[0]
+    return sel, n_breaks[sel].astype(np.int64)
+
+
+def _recombinations_selected(
+    sub: list[tuple[str, str]],
+    counts: np.ndarray,
+    sel: np.ndarray,
+    seed: int,
+    n_threads: int,
+) -> list[tuple[str, str, int]]:
     orig = sel.astype(np.int64)  # RNG streams keyed by original index
     lib = get_lib()
     if lib is None:

@@ -215,19 +215,18 @@ def copy_params(
     )
 
 
+def compact_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Array:
+    """Gather rows by a full-capacity permutation, zero rank >= n_keep —
+    the one implementation of stable compaction-on-kill (SURVEY.md §7
+    design delta 1), shared by every per-cell tensor."""
+    out = arr[perm]
+    keep = (jnp.arange(perm.shape[0]) < n_keep).reshape(
+        (-1,) + (1,) * (out.ndim - 1)
+    )
+    return jnp.where(keep, out, jnp.zeros((), dtype=out.dtype))
+
+
 @jax.jit
 def permute_params(state: CellParams, perm: jax.Array, n_keep: jax.Array) -> CellParams:
-    """
-    Gather rows by a full-capacity permutation and zero everything at
-    rank >= n_keep — compaction-on-kill with static shapes (SURVEY.md §7
-    design delta 1).
-    """
-    ranks = jnp.arange(perm.shape[0])
-    keep = ranks < n_keep
-
-    def gather(s: jax.Array) -> jax.Array:
-        out = s[perm]
-        mask = keep.reshape((-1,) + (1,) * (out.ndim - 1))
-        return jnp.where(mask, out, jnp.zeros((), dtype=out.dtype))
-
-    return CellParams(*(gather(s) for s in state))
+    """:func:`compact_rows` over all nine parameter tensors."""
+    return CellParams(*(compact_rows(s, perm, n_keep) for s in state))

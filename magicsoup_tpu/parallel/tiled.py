@@ -118,19 +118,17 @@ def halo_diffuse(
             return out_
 
         def det_total(arr):
-            # per-tile f64 partial -> all-gather -> FIXED tree over tiles:
-            # a psum's all-reduce order is backend/topology-chosen, which
-            # would break the deterministic mode's bit-identity (and
-            # differ from the single-device global tree)
-            from magicsoup_tpu.ops.detmath import tree_reduce
-
-            with jax.enable_x64(True):
-                part = tree_reduce(
-                    arr.reshape(arr.shape[0], -1).astype(jnp.float64),
-                    1, jnp.add, 0.0,
-                )  # (mols,) f64
-                parts = jax.lax.all_gather(part, axis)  # (tiles, mols)
-                return tree_reduce(parts, 0, jnp.add, 0.0)  # f64
+            # all-gather the tile rows and run the SAME global fixed-tree
+            # reduction as the single-device path (sum_hw downcasts its
+            # f64 tree to f32) — partial per-tile trees cannot reproduce
+            # the global fold-in-half tree's pairings, and a psum's
+            # all-reduce order is backend/topology-chosen, so replicating
+            # the rows is the only construction that makes the sharded
+            # fixup bit-identical to the single-device one.  Deterministic
+            # mode is a correctness mode; the extra gather (one map copy
+            # per device) is its price.
+            rows_all = jax.lax.all_gather(arr, axis, axis=1, tiled=True)
+            return _diff.sum_hw(rows_all)  # (mols,) f32
 
         if det:
             # f64 accumulation + fixed trees + soft division, matching
@@ -142,8 +140,7 @@ def halo_diffuse(
                 ).astype(jnp.float32)
             total_after = det_total(out)
             fix = _diff.det_div(
-                (total_before - total_after).astype(jnp.float32),
-                jnp.float32(m * m),
+                total_before - total_after, jnp.float32(m * m)
             )
         else:
             # f64-tree totals in fast mode too (cancellation — see

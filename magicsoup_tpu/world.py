@@ -23,6 +23,7 @@ TPU-first architecture (SURVEY.md §7):
 - **explicit seeding**: one ``seed`` drives placement, token maps and
   mutations (the reference draws everything from process-global RNGs).
 """
+import functools
 import pickle
 import random
 from pathlib import Path
@@ -36,8 +37,18 @@ from magicsoup_tpu.genetics import Genetics
 from magicsoup_tpu.kinetics import Kinetics
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import diffusion as _diff
-from magicsoup_tpu.ops.integrate import default_deterministic, integrate_signals
-from magicsoup_tpu.ops.params import pad_idxs, pad_pow2
+from magicsoup_tpu.ops.integrate import (
+    CellParams,
+    default_deterministic,
+    integrate_signals,
+)
+from magicsoup_tpu.ops.params import (
+    compact_rows,
+    copy_params,
+    pad_idxs,
+    pad_pow2,
+    permute_params,
+)
 from magicsoup_tpu.util import randstr
 
 _MIN_CAPACITY = 64
@@ -81,11 +92,11 @@ _activity_fns: dict = {}  # keyed by (det, pallas); built lazily
 
 
 def _get_activity_fn(det: bool, pallas: bool):
-    key = (det, pallas)
+    # the Pallas kernel has no deterministic variant; World.__init__
+    # rejects the combination, so pallas keys are det-independent
+    key = (False, True) if pallas else (det, False)
     if key not in _activity_fns:
         if pallas:
-            import functools
-
             from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
 
             interpret = jax.default_backend() != "tpu"
@@ -100,10 +111,7 @@ def _get_activity_fn(det: bool, pallas: bool):
     return _activity_fns[key]
 
 
-import functools as _functools
-
-
-@_functools.partial(jax.jit, static_argnames=("det",))
+@functools.partial(jax.jit, static_argnames=("det",))
 def _diffuse_and_permeate(
     molecule_map: jax.Array,
     cell_molecules: jax.Array,
@@ -163,31 +171,42 @@ def _add_at(
 
 
 @jax.jit
-def _spill_molecules(
+def _kill_update(
     molecule_map: jax.Array,
     cell_molecules: jax.Array,
+    params: CellParams,
     positions: jax.Array,
     idxs: jax.Array,  # (b_pad,); padding OOB
     valid: jax.Array,  # (b_pad,) bool
-) -> jax.Array:
-    """Killed cells dump their contents onto their pixel
-    (reference world.py:520-525)."""
+    perm: jax.Array,  # (cap,) stable compaction permutation
+    n_keep: jax.Array,  # scalar int
+) -> tuple[jax.Array, jax.Array, CellParams]:
+    """Fused kill step: killed cells dump their contents onto their pixel
+    (reference world.py:520-525), then cell rows and all kinetic parameter
+    tensors are compacted by one permutation.  One dispatch — a remote
+    accelerator pays per-call latency, so the three updates ride together.
+    """
     pos = positions[idxs]  # OOB clamps; masked below
     spill = cell_molecules[idxs] * valid[:, None]  # (b, mols)
-    return molecule_map.at[:, pos[:, 0], pos[:, 1]].add(spill.T)
+    new_map = molecule_map.at[:, pos[:, 0], pos[:, 1]].add(spill.T)
+    new_cm = compact_rows(cell_molecules, perm, n_keep)
+    return new_map, new_cm, permute_params(params, perm, n_keep)
 
 
 @jax.jit
-def _divide_molecules(
+def _divide_update(
     cell_molecules: jax.Array,
+    params: CellParams,
     parent_idxs: jax.Array,  # (b_pad,); padding OOB
     child_idxs: jax.Array,  # (b_pad,); padding OOB
-) -> jax.Array:
-    """Molecules are shared evenly among both descendants
-    (reference world.py:467-470)."""
+) -> tuple[jax.Array, CellParams]:
+    """Fused divide step: molecules are shared evenly among both
+    descendants (reference world.py:467-470) and the children inherit the
+    parents' kinetic parameter rows — one dispatch."""
     half = cell_molecules[parent_idxs] * 0.5
     cm = cell_molecules.at[parent_idxs].set(half, mode="drop")
-    return cm.at[child_idxs].set(half, mode="drop")
+    cm = cm.at[child_idxs].set(half, mode="drop")
+    return cm, copy_params(params, parent_idxs, child_idxs)
 
 
 @jax.jit
@@ -199,16 +218,6 @@ def _set_prefix(
     """Overwrite rows 0..n-1 with static shapes (no per-n recompiles)"""
     keep = (jnp.arange(cell_molecules.shape[0]) < n)[:, None]
     return jnp.where(keep, values, cell_molecules)
-
-
-@jax.jit
-def _permute_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Array:
-    """Stable compaction: gather rows by permutation, zero rank >= n_keep"""
-    out = arr[perm]
-    keep = (jnp.arange(perm.shape[0]) < n_keep).reshape(
-        (-1,) + (1,) * (out.ndim - 1)
-    )
-    return jnp.where(keep, out, jnp.zeros((), dtype=out.dtype))
 
 
 class World:
@@ -314,6 +323,12 @@ class World:
         # "Numeric modes"): deterministic = bit-reproducible across
         # backends, fast = backend-native lowerings
         self.deterministic = default_deterministic()
+        if self.use_pallas and self.deterministic:
+            raise ValueError(
+                "use_pallas is not supported in deterministic mode: the"
+                " kernel has no bit-reproducible variant; unset"
+                " MAGICSOUP_TPU_DETERMINISTIC or use the XLA integrator"
+            )
 
         self.genetics = Genetics(
             start_codons=start_codons,
@@ -611,22 +626,37 @@ class World:
         that list (reference world.py:247-285; vectorized via an occupancy
         grid instead of pairwise distances).
         """
-        if len(cell_idxs) == 0:
-            return []
-        from_idxs = np.array(sorted(set(cell_idxs)), dtype=np.int64)
+        pairs = self._neighbor_pairs(cell_idxs, nghbr_idxs)
+        return list(zip(pairs[:, 0].tolist(), pairs[:, 1].tolist()))
+
+    def _neighbor_pairs(
+        self,
+        cell_idxs: list[int] | None,
+        nghbr_idxs: list[int] | None = None,
+    ) -> np.ndarray:
+        """:meth:`get_neighbors` as a (k, 2) int64 array, smaller index
+        first, sorted; ``cell_idxs=None`` means the whole population"""
+        n = self.n_cells
+        if cell_idxs is None:
+            from_idxs = np.arange(n, dtype=np.int64)
+        else:
+            if len(cell_idxs) == 0:
+                return np.zeros((0, 2), dtype=np.int64)
+            from_idxs = np.array(sorted(set(cell_idxs)), dtype=np.int64)
         if nghbr_idxs is None:
-            to_member = np.zeros(self.n_cells, dtype=bool)
-            to_member[from_idxs] = True
+            to_member = None if cell_idxs is None else np.zeros(n, dtype=bool)
+            if to_member is not None:
+                to_member[from_idxs] = True
         else:
             if len(nghbr_idxs) == 0:
-                return []
-            to_member = np.zeros(self.n_cells, dtype=bool)
+                return np.zeros((0, 2), dtype=np.int64)
+            to_member = np.zeros(n, dtype=bool)
             to_member[list(set(nghbr_idxs))] = True
 
         m = self.map_size
         grid = np.full((m, m), -1, dtype=np.int64)
-        pos = self._np_positions[: self.n_cells]
-        grid[pos[:, 0], pos[:, 1]] = np.arange(self.n_cells)
+        pos = self._np_positions[:n]
+        grid[pos[:, 0], pos[:, 1]] = np.arange(n)
 
         fp = pos[from_idxs]  # (k, 2)
         dx = np.array([-1, -1, -1, 0, 0, 1, 1, 1])
@@ -635,15 +665,17 @@ class World:
         ny = (fp[:, 1][:, None] + dy[None, :]) % m
         cand = grid[nx, ny]  # (k, 8)
         src = np.broadcast_to(from_idxs[:, None], cand.shape)
-        valid = (cand >= 0) & to_member[np.clip(cand, 0, None)] & (cand != src)
+        valid = cand >= 0
+        if to_member is not None:
+            valid &= to_member[np.clip(cand, 0, None)] & (cand != src)
         a = src[valid]
         b = cand[valid]
         lo = np.minimum(a, b)
         hi = np.maximum(a, b)
         # 1D-encoded unique (np.unique(axis=0) goes through a slow
         # void-dtype view; this is ~100x faster at 10k cells)
-        enc = np.unique(lo * np.int64(self.n_cells) + hi)
-        return list(zip((enc // self.n_cells).tolist(), (enc % self.n_cells).tolist()))
+        enc = np.unique(lo * np.int64(n) + hi)
+        return np.stack([enc // n, enc % n], axis=1)
 
     # ------------------------------------------------------------------ #
     # cell lifecycle                                                     #
@@ -862,10 +894,12 @@ class World:
 
         p_pad = pad_idxs(np.asarray(parent_idxs), oob=self._capacity)
         c_pad = pad_idxs(np.asarray(child_idxs), oob=self._capacity)
-        self._cell_molecules = _divide_molecules(
-            self._cell_molecules, jnp.asarray(p_pad), jnp.asarray(c_pad)
+        self._cell_molecules, self.kinetics.params = _divide_update(
+            self._cell_molecules,
+            self.kinetics.params,
+            jnp.asarray(p_pad),
+            jnp.asarray(c_pad),
         )
-        self.kinetics.copy_cell_params(from_idxs=parent_idxs, to_idxs=child_idxs)
 
         return list(zip(parent_idxs, child_idxs))
 
@@ -895,13 +929,6 @@ class World:
         idxs_pad = pad_idxs(kill, oob=self._capacity)
         valid = np.zeros(len(idxs_pad), dtype=bool)
         valid[: len(kill)] = True
-        self._molecule_map = _spill_molecules(
-            self._molecule_map,
-            self._cell_molecules,
-            self._positions_dev,
-            jnp.asarray(idxs_pad),
-            jnp.asarray(valid),
-        )
         pos = self._np_positions[kill]
         self._np_cell_map[pos[:, 0], pos[:, 1]] = False
 
@@ -914,10 +941,18 @@ class World:
         ).astype(np.int32)
         n_keep = int(keep_mask.sum())
 
-        self._cell_molecules = _permute_rows(
-            self._cell_molecules, jnp.asarray(perm), jnp.asarray(n_keep)
+        self._molecule_map, self._cell_molecules, self.kinetics.params = (
+            _kill_update(
+                self._molecule_map,
+                self._cell_molecules,
+                self.kinetics.params,
+                self._positions_dev,
+                jnp.asarray(idxs_pad),
+                jnp.asarray(valid),
+                jnp.asarray(perm),
+                jnp.asarray(n_keep),
+            )
         )
-        self.kinetics.permute_cells(perm, n_keep)
         self._np_positions = self._np_positions[perm]
         self._np_positions[n_keep:] = 0
         self._np_lifetimes = self._np_lifetimes[perm]
@@ -1044,16 +1079,16 @@ class World:
     def recombinate_cells(self, cell_idxs: list[int] | None = None, p: float = 1e-7):
         """Recombinate genomes of neighboring cells, then update changed
         cells."""
-        idxs = list(range(self.n_cells)) if cell_idxs is None else cell_idxs
-        nghbrs = self.get_neighbors(cell_idxs=idxs)
-        pairs = [(self.cell_genomes[a], self.cell_genomes[b]) for a, b in nghbrs]
+        pair_arr = self._neighbor_pairs(cell_idxs=cell_idxs)
         seed = int(self._nprng.integers(2**63))
-        mutated = _engine.recombinations(pairs, p=p, seed=seed)
+        mutated = _engine.recombinations_indexed(
+            self.cell_genomes, pair_arr, p=p, seed=seed
+        )
         genome_idx_pairs = []
         for c0, c1, idx in mutated:
-            c0_i, c1_i = nghbrs[idx]
-            genome_idx_pairs.append((c0, c0_i))
-            genome_idx_pairs.append((c1, c1_i))
+            c0_i, c1_i = pair_arr[idx]
+            genome_idx_pairs.append((c0, int(c0_i)))
+            genome_idx_pairs.append((c1, int(c1_i)))
         self.update_cells(genome_idx_pairs=genome_idx_pairs)
 
     # ------------------------------------------------------------------ #
@@ -1118,6 +1153,18 @@ class World:
         # compat defaults for pickles from before these attributes existed
         self.__dict__.setdefault("use_pallas", False)
         self.__dict__.setdefault("deterministic", default_deterministic())
+        if self.use_pallas and self.deterministic:
+            # same incompatibility __init__ rejects; a restored world must
+            # not silently break the bit-reproducibility contract, and the
+            # numeric mode is the stronger promise — drop the kernel
+            import warnings
+
+            warnings.warn(
+                "restored world had use_pallas=True but deterministic mode"
+                " is on; the kernel has no bit-reproducible variant, so"
+                " use_pallas is disabled"
+            )
+            self.use_pallas = False
         self.__dict__.setdefault("_mm_cache", None)
         self.__dict__.setdefault("_cm_cache", None)
         self.__dict__.setdefault("_mesh", None)

@@ -34,6 +34,21 @@ def test_halo_diffuse_matches_single_device():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
 
 
+def test_halo_diffuse_det_bit_identical_to_single_device():
+    # the deterministic mode's contract is exact bit-identity, not
+    # allclose: the sharded fixup gathers the rows and reuses the
+    # single-device reduction tree (tiled.py det_total)
+    mesh = tiled.make_mesh(8)
+    rng = np.random.default_rng(2)
+    # non-pow2 map size: 24 rows over 8 tiles -> 3x24-pixel tiles
+    mm = jnp.asarray(rng.random((3, 24, 24), dtype=np.float32) * 10)
+    kernels = jnp.asarray(_diff.diffusion_kernels([0.1, 1.0, 0.3]))
+    ref = np.asarray(_diff.diffuse(mm, kernels, det=True))
+    mm_sharded = jax.device_put(mm, tiled.map_sharding(mesh))
+    out = np.asarray(tiled.halo_diffuse(mm_sharded, kernels, mesh, det=True))
+    assert out.tobytes() == ref.tobytes()
+
+
 def test_halo_diffuse_single_tile_mesh():
     mesh = tiled.make_mesh(1)
     rng = np.random.default_rng(1)
