@@ -33,13 +33,12 @@ from magicsoup_tpu.containers import Chemistry, Molecule, Protein
 from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
 from magicsoup_tpu.ops.params import (
     TokenTables,
-    compute_cell_params,
+    compute_and_scatter_params,
     copy_params,
     flat_to_dense,
     pad_idxs,
     pad_pow2,
     permute_params,
-    scatter_params,
     unset_params,
 )
 
@@ -484,10 +483,13 @@ class Kinetics:
         dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=np.int32)
         dense_pad[:b] = dense
         idxs = pad_idxs(cell_idxs, oob=self.max_cells)
-        batch = compute_cell_params(
-            jnp.asarray(dense_pad), self.tables, self._abs_temp_arr
+        self.params = compute_and_scatter_params(
+            self.params,
+            jnp.asarray(dense_pad),
+            self.tables,
+            self._abs_temp_arr,
+            jnp.asarray(idxs),
         )
-        self.params = scatter_params(self.params, batch, jnp.asarray(idxs))
 
     def set_cell_params(
         self,

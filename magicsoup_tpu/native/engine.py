@@ -215,13 +215,10 @@ def point_mutations(
     if len(seqs) == 0:
         return []
     lens = np.fromiter((len(s) for s in seqs), dtype=np.int64, count=len(seqs))
-    nprng = np.random.default_rng(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
-    n_muts = nprng.poisson(p * lens)
-    sel = np.nonzero(n_muts > 0)[0]
+    sel, counts = _poisson_select(lens, p, seed)
     if len(sel) == 0:
         return []
     sub = [seqs[int(i)] for i in sel]
-    counts = n_muts[sel].astype(np.int64)
     orig = sel.astype(np.int64)  # RNG streams keyed by original index
     lib = get_lib()
     if lib is None:

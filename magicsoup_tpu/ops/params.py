@@ -194,6 +194,23 @@ def scatter_params(
 
 
 @jax.jit
+def compute_and_scatter_params(
+    state: CellParams,
+    dense: jax.Array,
+    tables: TokenTables,
+    abs_temp: jax.Array,
+    cell_idxs: jax.Array,
+) -> CellParams:
+    """:func:`compute_cell_params` + :func:`scatter_params` as ONE
+    program — the hot spawn/update path pays per-dispatch latency on
+    remote accelerators, and fusing also keeps the batch tensors from
+    materializing in HBM."""
+    return scatter_params(
+        state, compute_cell_params(dense, tables, abs_temp), cell_idxs
+    )
+
+
+@jax.jit
 def unset_params(state: CellParams, cell_idxs: jax.Array) -> CellParams:
     """Zero parameter rows at cell_idxs (OOB = dropped)."""
     return CellParams(
