@@ -69,6 +69,15 @@ def _build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _setup_compile_cache(jax) -> None:
+    """Persistent compile cache: pad-size variants recompile across
+    invocations otherwise (expensive through a remote compile service).
+    Shared with performance/profile_step.py."""
+    jax.config.update("jax_compilation_cache_dir", "/tmp/magicsoup_jax_cache")
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 def _child_main(args: argparse.Namespace) -> None:
     """The real measurement; runs in a subprocess so a backend hang or
     init failure never poisons the parent's retry loop."""
@@ -76,11 +85,7 @@ def _child_main(args: argparse.Namespace) -> None:
 
     import jax
 
-    # persistent compile cache: pad-size variants recompile across bench
-    # invocations otherwise (expensive through a remote compile service)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/magicsoup_jax_cache")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _setup_compile_cache(jax)
 
     import magicsoup_tpu as ms
     from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY

@@ -37,7 +37,20 @@ def main() -> None:
                     help="capture a jax.profiler trace of the timed steps")
     args = ap.parse_args()
 
+    # fail fast when the (possibly tunneled) backend is unreachable (a
+    # half-down tunnel hangs the first jax use forever), and share
+    # bench.py's probe + persistent-compile-cache setup so tunnel-failure
+    # handling lives in one place
+    from bench import _probe_backend, _setup_compile_cache
+
+    ok, probe_err = _probe_backend(timeout_s=120.0)
+    if not ok:
+        sys.exit(f"backend probe failed:\n{probe_err}")
+
     import jax
+
+    _setup_compile_cache(jax)
+
     import numpy as np
 
     import magicsoup_tpu as ms
