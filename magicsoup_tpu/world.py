@@ -89,6 +89,7 @@ def _make_enzymatic_activity(integrator):
 
 
 _activity_fns: dict = {}  # keyed by (det, pallas); built lazily
+_activity_col_fns: dict = {}  # same keys; activity + column slice fused
 
 
 def _get_activity_fn(det: bool, pallas: bool):
@@ -109,6 +110,29 @@ def _get_activity_fn(det: bool, pallas: bool):
 
         _activity_fns[key] = _make_enzymatic_activity(integrator)
     return _activity_fns[key]
+
+
+def _get_activity_col_fn(det: bool, pallas: bool):
+    """The activity step with one molecule column sliced out in the SAME
+    program (traced column index, so one compile covers all columns) —
+    saves the separate slice dispatch when a selection threshold will be
+    fetched right after the step."""
+    key = (False, True) if pallas else (det, False)
+    if key not in _activity_col_fns:
+        activity = _get_activity_fn(det, pallas)
+
+        @jax.jit
+        def fn(molecule_map, cell_molecules, positions, n_cells, params, col):
+            new_map, new_cm = activity(
+                molecule_map, cell_molecules, positions, n_cells, params
+            )
+            column = jax.lax.dynamic_index_in_dim(
+                new_cm, col, axis=1, keepdims=False
+            )
+            return new_map, new_cm, column
+
+        _activity_col_fns[key] = fn
+    return _activity_col_fns[key]
 
 
 @functools.partial(jax.jit, static_argnames=("det",))
@@ -180,33 +204,39 @@ def _kill_update(
     valid: jax.Array,  # (b_pad,) bool
     perm: jax.Array,  # (cap,) stable compaction permutation
     n_keep: jax.Array,  # scalar int
-) -> tuple[jax.Array, jax.Array, CellParams]:
+) -> tuple[jax.Array, jax.Array, CellParams, jax.Array]:
     """Fused kill step: killed cells dump their contents onto their pixel
-    (reference world.py:520-525), then cell rows and all kinetic parameter
-    tensors are compacted by one permutation.  One dispatch — a remote
-    accelerator pays per-call latency, so the three updates ride together.
+    (reference world.py:520-525), then cell rows, all kinetic parameter
+    tensors and the device position mirror are compacted by one
+    permutation.  One dispatch — a remote accelerator pays per-call
+    latency, so the four updates ride together.
     """
     pos = positions[idxs]  # OOB clamps; masked below
     spill = cell_molecules[idxs] * valid[:, None]  # (b, mols)
     new_map = molecule_map.at[:, pos[:, 0], pos[:, 1]].add(spill.T)
     new_cm = compact_rows(cell_molecules, perm, n_keep)
-    return new_map, new_cm, permute_params(params, perm, n_keep)
+    new_pos = compact_rows(positions, perm, n_keep)
+    return new_map, new_cm, permute_params(params, perm, n_keep), new_pos
 
 
 @jax.jit
 def _divide_update(
     cell_molecules: jax.Array,
     params: CellParams,
+    positions: jax.Array,  # (cap, 2) int32
     parent_idxs: jax.Array,  # (b_pad,); padding OOB
     child_idxs: jax.Array,  # (b_pad,); padding OOB
-) -> tuple[jax.Array, CellParams]:
+    child_pos: jax.Array,  # (b_pad, 2) int32; padding rows ignored
+) -> tuple[jax.Array, CellParams, jax.Array]:
     """Fused divide step: molecules are shared evenly among both
-    descendants (reference world.py:467-470) and the children inherit the
-    parents' kinetic parameter rows — one dispatch."""
+    descendants (reference world.py:467-470), the children inherit the
+    parents' kinetic parameter rows, and the device position mirror gets
+    the child pixels — one dispatch."""
     half = cell_molecules[parent_idxs] * 0.5
     cm = cell_molecules.at[parent_idxs].set(half, mode="drop")
     cm = cm.at[child_idxs].set(half, mode="drop")
-    return cm, copy_params(params, parent_idxs, child_idxs)
+    new_pos = positions.at[child_idxs].set(child_pos, mode="drop")
+    return cm, copy_params(params, parent_idxs, child_idxs), new_pos
 
 
 @jax.jit
@@ -434,15 +464,14 @@ class World:
         """
         return self._host_cell_molecules()[: self.n_cells]
 
-    def _slice_column_async(self, mol_idx: int) -> jax.Array:
-        """Dispatch the (static-capacity) column slice and start its
-        device→host copy; returns the in-flight device array."""
-        col = self._cell_molecules[:, mol_idx]
+    def _record_col_prefetch(self, mol_idx: int, col: jax.Array):
+        """Start the device→host copy of an in-flight column slice and
+        remember it for :meth:`cell_molecule_column` pickup."""
         try:
             col.copy_to_host_async()
         except AttributeError:  # non-jax array stand-ins in tests
             pass
-        return col
+        self._col_prefetch = (self._cell_molecules, mol_idx, col)
 
     def prefetch_cell_molecule_column(self, mol_idx: int):
         """
@@ -453,8 +482,7 @@ class World:
         A later :meth:`cell_molecule_column` for the same state picks up
         the in-flight copy instead of starting a fresh one.
         """
-        self._col_prefetch = (self._cell_molecules, mol_idx,
-                              self._slice_column_async(mol_idx))
+        self._record_col_prefetch(mol_idx, self._cell_molecules[:, mol_idx])
 
     def cell_molecule_column(self, mol_idx: int) -> np.ndarray:
         """
@@ -474,7 +502,7 @@ class World:
         ):
             col = pf[2]
         else:
-            col = self._slice_column_async(mol_idx)
+            col = self._cell_molecules[:, mol_idx]
         self._col_prefetch = None
         return np.asarray(col)[: self.n_cells]
 
@@ -890,16 +918,26 @@ class World:
         self._np_divisions[child_idxs] = self._np_divisions[parent_idxs]
         self._np_divisions[descendant_idxs] += 1
         self._np_lifetimes[descendant_idxs] = 0
-        self._sync_positions()
 
         p_pad = pad_idxs(np.asarray(parent_idxs), oob=self._capacity)
         c_pad = pad_idxs(np.asarray(child_idxs), oob=self._capacity)
-        self._cell_molecules, self.kinetics.params = _divide_update(
+        pos_pad = np.zeros((len(c_pad), 2), dtype=np.int32)
+        pos_pad[: len(child_idxs)] = child_pos_arr
+        (
             self._cell_molecules,
             self.kinetics.params,
+            self._positions_dev,
+        ) = _divide_update(
+            self._cell_molecules,
+            self.kinetics.params,
+            self._positions_dev,
             jnp.asarray(p_pad),
             jnp.asarray(c_pad),
+            jnp.asarray(pos_pad),
         )
+        # keep the device mirror pinned to the mesh placement (the jitted
+        # kernel's inferred out-sharding may differ)
+        self._positions_dev = self._place_cells(self._positions_dev)
 
         return list(zip(parent_idxs, child_idxs))
 
@@ -941,25 +979,30 @@ class World:
         ).astype(np.int32)
         n_keep = int(keep_mask.sum())
 
-        self._molecule_map, self._cell_molecules, self.kinetics.params = (
-            _kill_update(
-                self._molecule_map,
-                self._cell_molecules,
-                self.kinetics.params,
-                self._positions_dev,
-                jnp.asarray(idxs_pad),
-                jnp.asarray(valid),
-                jnp.asarray(perm),
-                jnp.asarray(n_keep),
-            )
+        (
+            self._molecule_map,
+            self._cell_molecules,
+            self.kinetics.params,
+            self._positions_dev,
+        ) = _kill_update(
+            self._molecule_map,
+            self._cell_molecules,
+            self.kinetics.params,
+            self._positions_dev,
+            jnp.asarray(idxs_pad),
+            jnp.asarray(valid),
+            jnp.asarray(perm),
+            jnp.asarray(n_keep),
         )
+        # keep the device mirror pinned to the mesh placement (the jitted
+        # kernel's inferred out-sharding may differ)
+        self._positions_dev = self._place_cells(self._positions_dev)
         self._np_positions = self._np_positions[perm]
         self._np_positions[n_keep:] = 0
         self._np_lifetimes = self._np_lifetimes[perm]
         self._np_lifetimes[n_keep:] = 0
         self._np_divisions = self._np_divisions[perm]
         self._np_divisions[n_keep:] = 0
-        self._sync_positions()
 
         kill_set = set(kill.tolist())
         self.cell_genomes = [
@@ -1008,18 +1051,36 @@ class World:
     def _activity_fn(self):
         return _get_activity_fn(self.deterministic, self.use_pallas)
 
-    def enzymatic_activity(self):
+    def enzymatic_activity(self, prefetch_column: int | None = None):
         """Catalyze reactions and transport for one time step; updates
-        ``molecule_map`` and ``cell_molecules``."""
+        ``molecule_map`` and ``cell_molecules``.
+
+        With ``prefetch_column``, that molecule's intracellular column is
+        sliced inside the same program and its device→host copy starts
+        immediately (one dispatch instead of activity + slice) — a later
+        :meth:`cell_molecule_column` for it picks up the in-flight copy.
+        """
         if self.n_cells == 0:
             return
-        self._molecule_map, self._cell_molecules = self._activity_fn()(
+        if prefetch_column is None:
+            self._molecule_map, self._cell_molecules = self._activity_fn()(
+                self._molecule_map,
+                self._cell_molecules,
+                self._positions_dev,
+                self._n_cells_dev(),
+                self.kinetics.params,
+            )
+            return
+        fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
+        self._molecule_map, self._cell_molecules, col = fn(
             self._molecule_map,
             self._cell_molecules,
             self._positions_dev,
             self._n_cells_dev(),
             self.kinetics.params,
+            jnp.asarray(prefetch_column, dtype=jnp.int32),
         )
+        self._record_col_prefetch(prefetch_column, col)
 
     def diffuse_molecules(self):
         """Let molecules diffuse over the map and permeate membranes for

@@ -51,10 +51,10 @@ def sim_step(
             world.spawn_cells(genomes=genomes)
 
     with timeit("activity"):
-        world.enzymatic_activity()
-        # start the ATP-column device→host copy now: it overlaps the
+        # the ATP column is sliced inside the activity program and its
+        # device→host copy starts immediately: it overlaps the
         # integrator's device time and the request's network round trip
-        world.prefetch_cell_molecule_column(atp_idx)
+        world.enzymatic_activity(prefetch_column=atp_idx)
 
     # ONE device fetch drives both selections, and only the ATP column is
     # transferred: killing only compacts rows (it does not change

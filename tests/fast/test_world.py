@@ -4,6 +4,7 @@ churn, molecule conservation laws (the reference's de-facto integration
 suite, tests/fast/test_world.py:253-507), physics semantics, and
 persistence round-trips.
 """
+import pickle
 import random
 from pathlib import Path
 
@@ -471,6 +472,28 @@ def test_cell_molecule_column_and_add():
 
     world.add_cell_molecules([], mol_idx=2, delta=1.0)  # no-op
     np.testing.assert_allclose(world.cell_molecule_column(2), want, rtol=1e-6)
+
+
+def test_enzymatic_activity_prefetch_column():
+    # the fused activity+slice program must hand out the POST-activity
+    # column (a slice of the stale buffer would feed selection thresholds
+    # one-step-old values) and must bitwise match the two-dispatch path
+    world = _world()
+    world.spawn_cells(_genomes(9, s=500, seed=13))
+    ref = pickle.loads(pickle.dumps(world))
+
+    world.enzymatic_activity(prefetch_column=2)
+    col = world.cell_molecule_column(2)
+    np.testing.assert_array_equal(
+        col, np.asarray(world._cell_molecules)[:9, 2]
+    )
+
+    ref.enzymatic_activity()
+    ref.prefetch_cell_molecule_column(2)
+    np.testing.assert_array_equal(col, ref.cell_molecule_column(2))
+    np.testing.assert_array_equal(
+        np.asarray(world._cell_molecules), np.asarray(ref._cell_molecules)
+    )
 
 
 def test_spawn_cells_overflow_subsamples_without_mutating_input():
