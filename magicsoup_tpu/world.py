@@ -92,10 +92,14 @@ _activity_fns: dict = {}  # keyed by (det, pallas); built lazily
 _activity_col_fns: dict = {}  # same keys; activity + column slice fused
 
 
-def _get_activity_fn(det: bool, pallas: bool):
+def _variant_key(det: bool, pallas: bool) -> tuple[bool, bool]:
     # the Pallas kernel has no deterministic variant; World.__init__
     # rejects the combination, so pallas keys are det-independent
-    key = (False, True) if pallas else (det, False)
+    return (False, True) if pallas else (det, False)
+
+
+def _get_activity_fn(det: bool, pallas: bool):
+    key = _variant_key(det, pallas)
     if key not in _activity_fns:
         if pallas:
             from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
@@ -117,7 +121,7 @@ def _get_activity_col_fn(det: bool, pallas: bool):
     program (traced column index, so one compile covers all columns) —
     saves the separate slice dispatch when a selection threshold will be
     fetched right after the step."""
-    key = (False, True) if pallas else (det, False)
+    key = _variant_key(det, pallas)
     if key not in _activity_col_fns:
         activity = _get_activity_fn(det, pallas)
 
@@ -173,6 +177,33 @@ def _pickup_molecules(
     new_map = molecule_map.at[:, xs, ys].add(-pickup)
     new_cm = cell_molecules.at[new_idxs].add(pickup.T, mode="drop")
     return new_map, new_cm
+
+
+@functools.partial(jax.jit, static_argnames=("det",))
+def _degrade_diffuse_permeate(
+    molecule_map: jax.Array,
+    cell_molecules: jax.Array,
+    positions: jax.Array,
+    n_cells: jax.Array,
+    degrad_factors: jax.Array,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+    det: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Degradation + diffusion + permeation fused into one program (the
+    jitted callees inline); same order as the separate methods."""
+    molecule_map, cell_molecules = _diff.degrade(
+        molecule_map, cell_molecules, degrad_factors
+    )
+    return _diffuse_and_permeate(
+        molecule_map,
+        cell_molecules,
+        positions,
+        n_cells,
+        kernels,
+        perm_factors,
+        det=det,
+    )
 
 
 @jax.jit
@@ -1104,6 +1135,26 @@ class World:
         """Degrade molecules everywhere by one time step"""
         self._molecule_map, self._cell_molecules = _diff.degrade(
             self._molecule_map, self._cell_molecules, self._degrad_factors
+        )
+
+    def degrade_and_diffuse_molecules(self):
+        """:meth:`degrade_molecules` followed by :meth:`diffuse_molecules`
+        as ONE device program — identical math and order, one dispatch
+        instead of two (per-dispatch latency matters on remote
+        accelerators).  Convenience for per-step loops."""
+        if self.n_cells == 0:
+            self.degrade_molecules()
+            self.diffuse_molecules()
+            return
+        self._molecule_map, self._cell_molecules = _degrade_diffuse_permeate(
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            self._n_cells_dev(),
+            self._degrad_factors,
+            self._diff_kernels,
+            self._perm_factors,
+            det=self.deterministic,
         )
 
     def increment_cell_lifetimes(self):

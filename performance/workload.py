@@ -81,8 +81,7 @@ def sim_step(
         world.mutate_cells()
 
     with timeit("wrapUp"):
-        world.degrade_molecules()
-        world.diffuse_molecules()
+        world.degrade_and_diffuse_molecules()
         world.increment_cell_lifetimes()
         if sync:
             # a VALUE fetch, not block_until_ready: remote-tunneled

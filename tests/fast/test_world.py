@@ -474,6 +474,28 @@ def test_cell_molecule_column_and_add():
     np.testing.assert_allclose(world.cell_molecule_column(2), want, rtol=1e-6)
 
 
+def test_degrade_and_diffuse_matches_separate_calls():
+    # the fused wrapup program must be bitwise the separate methods
+    world = _world()
+    world.spawn_cells(_genomes(8, s=400, seed=17))
+    ref = pickle.loads(pickle.dumps(world))
+
+    world.degrade_and_diffuse_molecules()
+    ref.degrade_molecules()
+    ref.diffuse_molecules()
+    np.testing.assert_array_equal(
+        np.asarray(world._molecule_map), np.asarray(ref._molecule_map)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(world._cell_molecules), np.asarray(ref._cell_molecules)
+    )
+
+    # 0-cell world: map-only path
+    world.kill_cells()
+    world.degrade_and_diffuse_molecules()
+    assert np.isfinite(np.asarray(world._molecule_map)).all()
+
+
 def test_enzymatic_activity_prefetch_column():
     # the fused activity+slice program must hand out the POST-activity
     # column (a slice of the stale buffer would feed selection thresholds
