@@ -5,7 +5,7 @@ parameter tensors, and the signal integrator.
 Parity reference: `python/magicsoup/kinetics.py:292-992`.  Same state
 semantics — 9 tensors over (c cells, p proteins, s = 2 * n_molecules
 signals): ``Ke, Kmf, Kmb, Vmax`` (c,p) f32, ``Kmr`` (c,p,s) f32,
-``N, Nf, Nb, A`` (c,p,s) i32 — and the same token->parameter sampling
+``N, Nf, Nb, A`` (c,p,s) i16 — and the same token->parameter sampling
 distributions (Km/Vmax lognormal with rejection, signs 50/50, hill
 1..5 at 52/26/13/6/3%, uniformly-mapped reaction/transport/effector
 vectors, token 0 = empty).
@@ -30,7 +30,11 @@ import numpy as np
 
 from magicsoup_tpu.constants import ProteinSpecType
 from magicsoup_tpu.containers import Chemistry, Molecule, Protein
-from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
+from magicsoup_tpu.ops.integrate import (
+    INT_PARAM_DTYPE,
+    CellParams,
+    integrate_signals,
+)
 from magicsoup_tpu.ops.params import (
     TokenTables,
     compute_and_scatter_params,
@@ -381,17 +385,17 @@ class Kinetics:
             return jnp.zeros(shape, dtype=dtype)
 
         f32 = lambda *shape: _zeros(*shape, dtype=jnp.float32)  # noqa: E731
-        i32 = lambda *shape: _zeros(*shape, dtype=jnp.int32)  # noqa: E731
+        i16 = lambda *shape: _zeros(*shape, dtype=INT_PARAM_DTYPE)  # noqa: E731
         return CellParams(
             Ke=f32(c, p),
             Kmf=f32(c, p),
             Kmb=f32(c, p),
             Kmr=f32(c, p, s),
             Vmax=f32(c, p),
-            N=i32(c, p, s),
-            Nf=i32(c, p, s),
-            Nb=i32(c, p, s),
-            A=i32(c, p, s),
+            N=i16(c, p, s),
+            Nf=i16(c, p, s),
+            Nb=i16(c, p, s),
+            A=i16(c, p, s),
         )
 
     def _resize(self, c: int, p: int):
@@ -569,7 +573,19 @@ class Kinetics:
         # compat defaults for pickles from before these attributes existed
         self.__dict__.setdefault("max_doms", 1)
         self.__dict__.setdefault("cell_sharding", None)
-        self.params = CellParams(*(jnp.asarray(t) for t in state["params"]))
+        # cast to the canonical dtypes so worlds pickled with i32 integer
+        # tensors share compiled programs with fresh ones; saturating like
+        # the assembly's narrow(), not wrapping
+        def narrow(t: jax.Array) -> jax.Array:
+            return jnp.clip(t, -32768, 32767).astype(INT_PARAM_DTYPE)
+
+        restored = CellParams(*(jnp.asarray(t) for t in state["params"]))
+        self.params = restored._replace(
+            N=narrow(restored.N),
+            Nf=narrow(restored.Nf),
+            Nb=narrow(restored.Nb),
+            A=narrow(restored.A),
+        )
         self.tables = TokenTables(*(jnp.asarray(t) for t in state["tables"]))
         self._abs_temp_arr = jnp.asarray(state["_abs_temp_arr"])
 

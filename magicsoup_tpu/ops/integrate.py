@@ -60,6 +60,14 @@ def default_deterministic() -> bool:
     return os.environ.get("MAGICSOUP_TPU_DETERMINISTIC") == "1"
 
 
+# the four integer tensors are stored i16: they are 4 of the 5 big
+# (c,p,s) tensors and the HBM-bound integrator re-reads them every pass,
+# so narrow storage cuts its memory traffic ~40%.  Values are domain sums
+# of stoichiometry*sign / hill*sign and only approach +-2^15 for ~80kb
+# genomes; the assembly saturates instead of wrapping
+INT_PARAM_DTYPE = jnp.int16
+
+
 class CellParams(NamedTuple):
     """The 9 per-cell kinetic parameter tensors (c cells, p proteins,
     s signals = 2 * n_molecules; see reference kinetics.py:323-337)."""
@@ -69,10 +77,10 @@ class CellParams(NamedTuple):
     Kmb: jax.Array  # (c,p) f32 backward Michaelis constants
     Kmr: jax.Array  # (c,p,s) f32 regulatory Km^hill per signal
     Vmax: jax.Array  # (c,p) f32 maximum velocities
-    N: jax.Array  # (c,p,s) i32 net stoichiometry
-    Nf: jax.Array  # (c,p,s) i32 forward (substrate) stoichiometry, >= 0
-    Nb: jax.Array  # (c,p,s) i32 backward (product) stoichiometry, >= 0
-    A: jax.Array  # (c,p,s) i32 allosteric hill exponents (+-)
+    N: jax.Array  # (c,p,s) i16 net stoichiometry
+    Nf: jax.Array  # (c,p,s) i16 forward (substrate) stoichiometry, >= 0
+    Nb: jax.Array  # (c,p,s) i16 backward (product) stoichiometry, >= 0
+    A: jax.Array  # (c,p,s) i16 allosteric hill exponents (+-)
 
 
 def _pow(

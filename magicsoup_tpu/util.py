@@ -29,12 +29,12 @@ _WILDCARDS = {"N": "TCGA", "R": "AG", "Y": "CT"}
 
 
 def round_down(d: float, to: int = 3) -> int:
-    """Round down to declared integer multiple"""
+    """Largest multiple of ``to`` that is <= ``d``"""
     return int(d // to) * to
 
 
 def closest_value(values: Iterable[float], key: float) -> float:
-    """Get closest value to key in values"""
+    """The element of ``values`` nearest to ``key``"""
     return min(values, key=lambda v: abs(v - key))
 
 
