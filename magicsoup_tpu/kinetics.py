@@ -484,7 +484,7 @@ class Kinetics:
             n_doms_cap=self.max_doms,
         )
         b_pad = pad_pow2(b)
-        dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=np.int32)
+        dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=dense.dtype)
         dense_pad[:b] = dense
         idxs = pad_idxs(cell_idxs, oob=self.max_cells)
         self.params = compute_and_scatter_params(

@@ -66,7 +66,7 @@ def flat_to_dense(
 ) -> tuple[np.ndarray, int]:
     """
     Vectorized scatter of the genome engine's flat buffers into one dense
-    int32 tensor (b, n_prots_cap, n_doms_cap, 5) holding
+    int16 tensor (b, n_prots_cap, n_doms_cap, 5) holding
     ``[dom_type, i0, i1, i2, i3]`` per domain (0 = padding).
 
     Returns the dense tensor and the (possibly padded) domain capacity.
@@ -77,7 +77,10 @@ def flat_to_dense(
     if n_doms_cap is None:
         n_doms_cap = pad_pow2(max_doms, minimum=1)
 
-    dense = np.zeros((b, n_prots_cap, n_doms_cap, 5), dtype=np.int32)
+    # i16 is enough: entries are the domain type (1..3) and token indices
+    # (<= 3904 two-codon tokens); halves the host->device bytes of the
+    # spawn path's biggest buffer
+    dense = np.zeros((b, n_prots_cap, n_doms_cap, 5), dtype=np.int16)
     if len(doms) == 0:
         return dense, n_doms_cap
 
@@ -110,7 +113,7 @@ def _nanmean0(x: jax.Array, axis: int) -> jax.Array:
 
 @partial(jax.jit, static_argnames=())
 def compute_cell_params(
-    dense: jax.Array,  # (b, p, d, 5) i32 [dom_type, i0, i1, i2, i3]
+    dense: jax.Array,  # (b, p, d, 5) i16 [dom_type, i0, i1, i2, i3]
     tables: TokenTables,
     abs_temp: jax.Array,
 ) -> CellParams:
