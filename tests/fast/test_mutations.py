@@ -142,3 +142,22 @@ def test_mutation_streams_are_batch_independent():
     other = [seqs[j] if j == some_idx else "A" * len(seqs[j]) for j in range(len(seqs))]
     solo = {i: s for s, i in engine.point_mutations(other, 5e-3, 0.4, 0.66, seed=7)}
     assert solo[some_idx] == full[some_idx]
+
+
+def test_recombinations_indexed_matches_pair_list():
+    # recombinations_indexed draws the identical Poisson stream and
+    # per-pair RNG streams as the pair-list API for the same pairs
+    genomes = _genomes(40, 600, 23)
+    rng = random.Random(3)
+    pair_idxs = np.array(
+        [(rng.randrange(40), rng.randrange(40)) for _ in range(200)],
+        dtype=np.int64,
+    )
+    pairs = [(genomes[a], genomes[b]) for a, b in pair_idxs]
+    old = engine.recombinations(pairs, p=1e-4, seed=9)
+    new = engine.recombinations_indexed(genomes, pair_idxs, p=1e-4, seed=9)
+    assert len(old) > 0  # 200 pairs x 1200 nt x 1e-4 -> ~24 expected
+    assert old == new
+
+    # empty input short-circuits
+    assert engine.recombinations_indexed(genomes, np.zeros((0, 2), int), p=1.0, seed=1) == []

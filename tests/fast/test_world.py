@@ -302,6 +302,16 @@ def test_get_neighbors():
     assert (0, 2) in world.get_neighbors(cell_idxs=[0, 2])
 
 
+def test_neighbor_pairs_whole_population_fast_path():
+    # _neighbor_pairs(None) skips the membership masks; it must produce
+    # exactly the pairs of the explicit full index list
+    world = _world(map_size=24)
+    world.spawn_cells(_genomes(60, s=30, seed=8))
+    explicit = world.get_neighbors(cell_idxs=list(range(world.n_cells)))
+    fast = world._neighbor_pairs(None)
+    assert [(int(a), int(b)) for a, b in fast] == explicit
+
+
 def test_mutate_and_recombinate_cells():
     world = _world()
     world.spawn_cells(_genomes(30, s=500, seed=4))
