@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from magicsoup_tpu.constants import ProteinSpecType
+from magicsoup_tpu.util import fetch_host
 from magicsoup_tpu.containers import Chemistry, Molecule, Protein
 from magicsoup_tpu.ops.integrate import (
     INT_PARAM_DTYPE,
@@ -563,7 +564,7 @@ class Kinetics:
         # shardings are bound to live devices; restored instances are
         # unsharded until a mesh-placed World re-sets cell_sharding
         state["cell_sharding"] = None
-        state["params"] = CellParams(*(np.asarray(t) for t in self.params))
+        state["params"] = CellParams(*(fetch_host(t) for t in self.params))
         state["tables"] = TokenTables(*(np.asarray(t) for t in self.tables))
         state["_abs_temp_arr"] = np.asarray(self._abs_temp_arr)
         return state

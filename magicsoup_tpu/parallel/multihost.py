@@ -1,0 +1,65 @@
+"""
+Multi-host entry for tile-sharded worlds (SURVEY.md §7 phase 8).
+
+The reference is single-process (SURVEY.md §5: no NCCL/MPI backend
+exists); here scaling past one host uses JAX's distributed runtime: every
+host runs the SAME program (classic SPMD), the coordination service wires
+the hosts together, and the XLA collectives in
+:mod:`magicsoup_tpu.parallel.tiled` then run over ICI within a slice and
+DCN between slices — `halo_diffuse`'s 1D ring layout puts a contiguous
+band of map rows on each host, so exactly two 1-pixel row halos per host
+cross DCN per diffusion step.
+
+Usage (identical script on every host):
+
+    from magicsoup_tpu.parallel import multihost, tiled
+
+    multihost.initialize()          # TPU pods: auto-detected
+    mesh = multihost.global_mesh()  # 1D mesh over ALL hosts' devices
+    world = ms.World(chemistry=..., seed=7, mesh=mesh)
+
+Because every stochastic decision in the framework is driven by the
+World's seed on the HOST (placement, token maps, mutations — see
+`magicsoup_tpu/world.py`), all processes compute identical host-side
+decisions and stay in lockstep without any extra communication; only
+device collectives cross the network.
+
+Tested without TPU hardware by running two coordinated CPU processes
+(`tests/fast/test_multihost.py`) — the cross-process collectives take the
+same code path DCN traffic does.
+"""
+import jax
+from jax.sharding import Mesh
+
+from magicsoup_tpu.parallel import tiled
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """
+    Join this process to the distributed runtime.  On TPU pods all
+    arguments are auto-detected from the environment; elsewhere (e.g. the
+    CPU-emulation test) pass them explicitly.  Must be called before the
+    first JAX computation.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh() -> Mesh:
+    """
+    1D mesh over every device of every participating process, in process
+    order — each host owns a contiguous band of map rows, so ring halos
+    are ICI-local except at the two host boundaries.  (Post-initialize,
+    ``jax.devices()`` is the global device list, so the single-host mesh
+    constructor already builds the global mesh; host arrays placed with a
+    global sharding — ``World`` does this for all its state — materialize
+    only each process's addressable shards.)
+    """
+    return tiled.make_mesh()

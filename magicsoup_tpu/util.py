@@ -123,6 +123,20 @@ def reverse_complement(seq: str) -> str:
 _COMPLEMENT = str.maketrans("ACTG", "TGAC")
 
 
+def fetch_host(arr) -> "np.ndarray":  # noqa: F821 - numpy imported lazily
+    """Device array -> host numpy, including global arrays whose shards
+    live on other processes (multi-host meshes): every process computes
+    the same host-side decisions from the same full snapshot, so the
+    non-addressable shards are all-gathered over the network."""
+    import numpy as np
+
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def dist_1d(a: int, b: int, m: int) -> int:
     """Distance between `a` and `b` on a circular 1D line of size `m`"""
     d0 = abs(a - b)

@@ -49,7 +49,7 @@ from magicsoup_tpu.ops.params import (
     pad_pow2,
     permute_params,
 )
-from magicsoup_tpu.util import randstr
+from magicsoup_tpu.util import fetch_host as _fetch_host, randstr
 
 _MIN_CAPACITY = 64
 
@@ -468,7 +468,7 @@ class World:
         identity comparison is an exact invalidation test)."""
         cache = self._mm_cache
         if cache is None or cache[0] is not self._molecule_map:
-            cache = (self._molecule_map, np.asarray(self._molecule_map))
+            cache = (self._molecule_map, _fetch_host(self._molecule_map))
             self._mm_cache = cache
         return cache[1]
 
@@ -476,7 +476,7 @@ class World:
         """Cached host snapshot of the full-capacity cell molecule buffer"""
         cache = self._cm_cache
         if cache is None or cache[0] is not self._cell_molecules:
-            cache = (self._cell_molecules, np.asarray(self._cell_molecules))
+            cache = (self._cell_molecules, _fetch_host(self._cell_molecules))
             self._cm_cache = cache
         return cache[1]
 
@@ -498,6 +498,10 @@ class World:
     def _record_col_prefetch(self, mol_idx: int, col: jax.Array):
         """Start the device→host copy of an in-flight column slice and
         remember it for :meth:`cell_molecule_column` pickup."""
+        if not getattr(col, "is_fully_addressable", True):
+            # multi-host: the local-shard copy would be discarded by the
+            # process_allgather fetch anyway — skip the dead transfer
+            return
         try:
             col.copy_to_host_async()
         except AttributeError:  # non-jax array stand-ins in tests
@@ -535,7 +539,7 @@ class World:
         else:
             col = self._cell_molecules[:, mol_idx]
         self._col_prefetch = None
-        return np.asarray(col)[: self.n_cells]
+        return _fetch_host(col)[: self.n_cells]
 
     def add_cell_molecules(self, cell_idxs: list[int], mol_idx: int, delta: float):
         """Add ``delta`` to one molecule of the given cells on device —
@@ -610,7 +614,7 @@ class World:
             [self._np_divisions, np.zeros(grow, dtype=np.int32)]
         )
         cm = np.zeros((cap, self.n_molecules), dtype=np.float32)
-        cm[: self._capacity] = np.asarray(self._cell_molecules)
+        cm[: self._capacity] = _fetch_host(self._cell_molecules)
         self._cell_molecules = self._place_cells(cm)
         self._capacity = cap
         self._sync_positions()
@@ -1244,8 +1248,8 @@ class World:
     def __getstate__(self) -> dict:
         state = self.__dict__.copy()
         # device arrays -> numpy for portable pickles
-        state["_cell_molecules"] = np.asarray(self._cell_molecules)
-        state["_molecule_map"] = np.asarray(self._molecule_map)
+        state["_cell_molecules"] = _fetch_host(self._cell_molecules)
+        state["_molecule_map"] = _fetch_host(self._molecule_map)
         state["_diff_kernels"] = np.asarray(self._diff_kernels)
         state["_perm_factors"] = np.asarray(self._perm_factors)
         state["_degrad_factors"] = np.asarray(self._degrad_factors)
@@ -1322,9 +1326,9 @@ class World:
         statedir = Path(statedir)
         statedir.mkdir(parents=True, exist_ok=True)
         n = self.n_cells
-        np.save(statedir / "cell_molecules.npy", np.asarray(self._cell_molecules)[:n])
+        np.save(statedir / "cell_molecules.npy", _fetch_host(self._cell_molecules)[:n])
         np.save(statedir / "cell_map.npy", self._np_cell_map)
-        np.save(statedir / "molecule_map.npy", np.asarray(self._molecule_map))
+        np.save(statedir / "molecule_map.npy", _fetch_host(self._molecule_map))
         np.save(statedir / "cell_lifetimes.npy", self._np_lifetimes[:n])
         np.save(statedir / "cell_positions.npy", self._np_positions[:n])
         np.save(statedir / "cell_divisions.npy", self._np_divisions[:n])
