@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement capture: runs every performance harness
+# sequentially (NEVER in parallel — concurrent jobs contaminate each
+# other's timings through the shared chip and tunnel, see
+# performance/README.md) and tees the results into logs/.
+#
+#   bash scripts/capture_tpu_numbers.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-logs/tpu-$(date +%Y%m%d-%H%M%S)}"
+mkdir -p "$OUT"
+
+echo "== backend probe" | tee "$OUT/capture.log"
+if ! timeout 120 python -c "import jax; print(jax.devices())" >>"$OUT/capture.log" 2>&1; then
+    echo "backend unreachable; aborting" | tee -a "$OUT/capture.log"
+    exit 1
+fi
+
+run() {
+    name="$1"; shift
+    echo "== $name: $*" | tee -a "$OUT/capture.log"
+    timeout 1800 "$@" >"$OUT/$name.log" 2>&1
+    echo "rc=$? (tail)" | tee -a "$OUT/capture.log"
+    tail -5 "$OUT/$name.log" | tee -a "$OUT/capture.log"
+}
+
+run bench          python bench.py
+run profile_step   python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
+run integrator     python performance/integrator_bench.py
+run check          python performance/check.py
+
+echo "done; logs in $OUT" | tee -a "$OUT/capture.log"
