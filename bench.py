@@ -196,7 +196,9 @@ def main() -> None:
         _child_main(args)
         return
 
-    budget_s = float(os.environ.get("MAGICSOUP_BENCH_RETRY_BUDGET", "900"))
+    # 30 min default: the tunnel has been observed down for multi-hour
+    # stretches, and a successful first probe costs nothing
+    budget_s = float(os.environ.get("MAGICSOUP_BENCH_RETRY_BUDGET", "1800"))
     attempt_timeout_s = float(
         os.environ.get("MAGICSOUP_BENCH_ATTEMPT_TIMEOUT", "1800")
     )
