@@ -105,3 +105,61 @@ def test_set_cell_params_idempotent():
     world._update_cell_params(genomes=genomes, idxs=list(range(world.n_cells)))
     for before, after in zip(params_before, kin.params):
         np.testing.assert_allclose(np.asarray(after), before, rtol=1e-6)
+
+
+def test_random_op_sequence_keeps_state_consistent():
+    # seeded fuzz over the full lifecycle API: after every operation the
+    # host/device mirrors and index bookkeeping must agree exactly
+    world = ms.World(chemistry=CHEMISTRY, map_size=24, seed=31)
+    rng = random.Random(31)
+
+    def check():
+        n = world.n_cells
+        assert len(world.cell_genomes) == n
+        assert len(world.cell_labels) == n
+        assert int(world._np_cell_map.sum()) == n
+        pos = world.cell_positions
+        # occupied pixels match positions, one cell per pixel
+        enc = pos[:, 0] * world.map_size + pos[:, 1]
+        assert len(np.unique(enc)) == n
+        assert world._np_cell_map[pos[:, 0], pos[:, 1]].all()
+        # device position mirror in lockstep with the host copy
+        np.testing.assert_array_equal(
+            np.asarray(world._positions_dev), world._np_positions
+        )
+        cm = np.asarray(world.cell_molecules)
+        mm = np.asarray(world.molecule_map)
+        assert np.isfinite(cm).all() and (cm >= 0).all()
+        assert np.isfinite(mm).all() and (mm >= 0).all()
+
+    def spawn():
+        world.spawn_cells([random_genome(s=300, rng=rng) for _ in range(20)])
+
+    def kill_some():
+        if world.n_cells:
+            k = rng.randrange(world.n_cells)
+            world.kill_cells(rng.sample(range(world.n_cells), k=min(k, 30)))
+
+    def divide_some():
+        if world.n_cells:
+            world.divide_cells(
+                rng.sample(range(world.n_cells), k=min(10, world.n_cells))
+            )
+
+    ops = [
+        spawn,
+        kill_some,
+        divide_some,
+        lambda: world.move_cells(),
+        lambda: world.reposition_cells(),
+        lambda: world.mutate_cells(p=1e-3),
+        lambda: world.recombinate_cells(p=1e-5),
+        lambda: world.enzymatic_activity(),
+        lambda: world.degrade_and_diffuse_molecules(),
+        lambda: world.increment_cell_lifetimes(),
+    ]
+    spawn()
+    check()
+    for i in range(120):
+        rng.choice(ops)()
+        check()
