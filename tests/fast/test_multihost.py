@@ -41,11 +41,14 @@ kernels = np.asarray(_diff.diffusion_kernels([0.1, 1.0, 0.3]))
 
 mm_g = jax.device_put(mm, tiled.map_sharding(mesh))
 out = tiled.halo_diffuse(mm_g, jax.numpy.asarray(kernels), mesh)
+out_det = tiled.halo_diffuse(mm_g, jax.numpy.asarray(kernels), mesh, det=True)
 
 from jax.experimental import multihost_utils
 full = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+full_det = np.asarray(multihost_utils.process_allgather(out_det, tiled=True))
 if proc_id == 0:
     np.save(os.path.join(outdir, "out.npy"), full)
+    np.save(os.path.join(outdir, "out_det.npy"), full_det)
 
 # the documented workflow: a mesh-placed World, same script on every
 # host, seed-driven lockstep through a full lifecycle step
@@ -120,6 +123,12 @@ def test_two_process_halo_diffusion_matches_single_process(tmp_path):
 
     got = np.load(tmp_path / "out.npy")
     np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    # deterministic mode: BIT-identical across process counts (the
+    # fixup's row all-gather crossed processes in the 2-process run)
+    ref_det = np.asarray(_diff.diffuse(jnp.asarray(mm), kernels, det=True))
+    got_det = np.load(tmp_path / "out_det.npy")
+    assert got_det.tobytes() == ref_det.tobytes()
 
     # the mesh-placed World ran a full lifecycle step across 2 processes
     # in seed-driven lockstep; its trajectory must match the SAME seeded
