@@ -6,14 +6,23 @@ zeros stay zero, dtype stability, and world-level reproducibility.
 import random
 
 import numpy as np
+import pytest
 
 import magicsoup_tpu as ms
 from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
 from magicsoup_tpu.util import random_genome
 
 
-def test_long_simulation_stays_sane():
+@pytest.mark.parametrize("deterministic", [False, True])
+def test_long_simulation_stays_sane(deterministic, monkeypatch):
+    # both numeric modes must satisfy the same invariants: the
+    # deterministic mode swaps every reduction/transcendental for the
+    # fixed-order detmath constructions (BITREPRO.md), and only a long
+    # churned run exercises its guards at scale
+    if deterministic:
+        monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
     world = ms.World(chemistry=CHEMISTRY, map_size=32, seed=13)
+    assert world.deterministic is deterministic
     rng = random.Random(13)
     world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(100)])
     nprng = np.random.default_rng(13)
