@@ -62,6 +62,11 @@ def _build_parser() -> argparse.ArgumentParser:
         help="use the VMEM-tiled Pallas integrator kernel",
     )
     ap.add_argument(
+        "--det",
+        action="store_true",
+        help="run in the deterministic (bit-reproducible) numeric mode",
+    )
+    ap.add_argument(
         "--_child",
         action="store_true",
         help=argparse.SUPPRESS,  # internal: actually run the measurement
@@ -82,6 +87,10 @@ def _child_main(args: argparse.Namespace) -> None:
     """The real measurement; runs in a subprocess so a backend hang or
     init failure never poisons the parent's retry loop."""
     import random
+
+    if args.det:
+        # the numeric mode is read from the env when a World is built
+        os.environ["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
 
     import jax
 
@@ -147,13 +156,14 @@ def _child_main(args: argparse.Namespace) -> None:
     dt = (time.perf_counter() - t0) / args.steps
 
     steps_per_s = 1.0 / dt
+    mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
     print(
         json.dumps(
             {
                 "metric": (
                     f"sim steps/sec ({args.n_cells} cells, "
                     f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
-                    "run_simulation workload)"
+                    f"run_simulation workload){mode}"
                 ),
                 "value": round(steps_per_s, 4),
                 "unit": "steps/s",
