@@ -202,6 +202,11 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
 def main() -> None:
     ap = _build_parser()
     args = ap.parse_args()
+    if args.det and args.pallas:
+        ap.error(
+            "--det and --pallas are mutually exclusive: the Pallas kernel"
+            " has no bit-reproducible variant"
+        )
     if args._child:
         _child_main(args)
         return

@@ -26,9 +26,12 @@ inert.
 Two numeric modes (the ``det`` static argument, default from
 ``MAGICSOUP_TPU_DETERMINISTIC=1``):
 
-- **fast** (default): backend-native ``pow``/``prod``/``sum`` reductions —
-  XLA picks the best lowering per target.  Measured ~2x faster than the
-  deterministic mode on TPU v5e at benchmark shapes.
+- **fast** (default): signal products in log space — ``prod(X^N)`` as
+  ``exp(sum(N * log X))`` fused into single reductions over the narrow
+  integer tensors (SURVEY.md §7 design delta 3), with zero signals
+  carried as a finite log sentinel so the reference's 0/NaN/Inf
+  semantics survive.  The step is HBM-bound, and this form never
+  materializes a (c,p,s) float intermediate.
 - **deterministic**: the fixed-order constructions from
   :mod:`magicsoup_tpu.ops.detmath` (integer powers by square-and-multiply,
   fixed binary reduction trees), which produce bit-identical results on
@@ -107,15 +110,52 @@ def _div(a: jax.Array, b: jax.Array, det: bool) -> jax.Array:
     return det_div(a, b) if det else a / b
 
 
+# stand-in for log(0): large-negative but finite, so 0 * LOG0 == 0 keeps
+# N=0 terms neutral (no 0 * -Inf = NaN), while one N>0 term at X=0 drags
+# the log-space sum far below f32 exp underflow.  Margin: the largest
+# positive counterweight is sum_s 32767 * log(MAX) ~ s * 2.7e6, so -1e12
+# dominates for any s below ~370k signals; the all-zeros extreme
+# (32767 * s * LOG0 ~ 1e18 at s=28) stays well inside f32 range
+LOG0 = -1e12
+
+
+def _safe_log(X: jax.Array) -> jax.Array:
+    """log(X) with X clamped into (0, MAX]: X=0 (and any NaN) maps to the
+    LOG0 sentinel, X=Inf to log(MAX) — so a non-finite concentration
+    saturates like the reference's NaN->0 / Inf->MAX scrubs instead of
+    poisoning the log-space sum with 0 * Inf = NaN."""
+    return jnp.where(X > 0.0, jnp.log(jnp.minimum(X, MAX)), LOG0)
+
+
+def _prod_pow(logX: jax.Array, N: jax.Array) -> jax.Array:
+    """``prod_s(X^N)`` per (cell, protein) as ``exp(sum_s N*logX)`` — one
+    fused multiply-reduce over the narrow integer exponent tensor with NO
+    (c,p,s) float intermediate (SURVEY.md §7 design delta 3).  The
+    integrator is HBM-bound, so each avoided materialization is won
+    wall-clock.  Overflow saturates to MAX like the reference's Inf
+    scrub; a zero signal with a positive exponent underflows the sum to
+    exp(-huge) = 0, matching the reference's 0*Inf -> NaN -> 0 scrub;
+    negative/NaN results cannot arise (exp is nonnegative, all inputs
+    finite)."""
+    e = jnp.sum(N.astype(jnp.float32) * logX[:, None, :], axis=2)
+    xx = jnp.exp(e)
+    return jnp.where(jnp.isinf(xx), MAX, xx)
+
+
 def _multiply_signals(
     X: jax.Array, N: jax.Array, det: bool = False
 ) -> tuple[jax.Array, jax.Array]:
     """
     ``prod_s(X^N)`` per (cell, protein) with the reference's zero/NaN/Inf
-    handling (kinetics.py:894-918): signals with N<=0 are masked to 0 before
-    the power so 0^0=1 keeps them neutral; NaN/negative results are scrubbed
-    to 0, Inf to MAX.  Also returns which proteins are involved at all.
+    handling (kinetics.py:894-918), plus which proteins are involved at
+    all.  Fast mode goes through the log-space :func:`_prod_pow`;
+    deterministic mode keeps square-and-multiply integer powers and
+    fixed-order reduction trees (exp/log are not bit-identical across
+    backends, repeated multiplies are).
     """
+    prots = jnp.any(N > 0, axis=2)  # (c,p)
+    if not det:
+        return _prod_pow(_safe_log(X), N), prots
     M = N > 0  # (c,p,s)
     x = jnp.where(M, X[:, None, :], 0.0)
     # all callers pass Nf/Nb, which are >= 0 by construction
@@ -123,7 +163,7 @@ def _multiply_signals(
     xx = jnp.where(jnp.isnan(xx), 0.0, xx)
     xx = jnp.where(xx < 0.0, 0.0, xx)
     xx = jnp.where(jnp.isinf(xx), MAX, xx)
-    return xx, jnp.sum(M, axis=2) > 0
+    return xx, prots
 
 
 def _velocities(
@@ -173,24 +213,36 @@ def _quotient(X: jax.Array, p: CellParams, det: bool = False) -> jax.Array:
     return jnp.nan_to_num(jnp.clip(q, EPS, MAX), nan=1.0)
 
 
-def _negative_adjusted_nv(
-    NV: jax.Array, X: jax.Array, det: bool = False
+def _negative_factors(
+    X: jax.Array, N: jax.Array, V: jax.Array, det: bool = False
 ) -> jax.Array:
-    """Slow proteins down so no signal is removed below zero
-    (reference kinetics.py:861-879)."""
+    """Per-protein slow-down factors F_min (c,p) so no signal is removed
+    below zero (reference kinetics.py:861-879).  Works on the narrow
+    integer N and the (c,p) velocities directly; the velocity-weighted
+    stoichiometry N*V is an elementwise expression XLA re-fuses into each
+    reduction, so the (c,p,s) float tensor never lands in HBM."""
+    NV = N.astype(jnp.float32) * V[:, :, None]  # (c,p,s), fused
     removed = _sum1(jnp.clip(-NV, min=0.0), det)  # (c,s)
     F = _div(X, removed, det)  # may be NaN/Inf where nothing is removed
     F = jnp.where(F > 1.0, 1.0, F)
-    M_rm = NV < 0.0  # (c,p,s)
-    F_prots = jnp.where(M_rm, F[:, None, :], 1.0)
-    F_min = jnp.min(F_prots, axis=2)  # (c,p); min is order-independent
-    return NV * F_min[:, :, None]
+    F_prots = jnp.where(NV < 0.0, F[:, None, :], 1.0)
+    return jnp.min(F_prots, axis=2)  # (c,p); min is order-independent
+
+
+def _weighted_dx(
+    X0: jax.Array, N: jax.Array, W: jax.Array, det: bool = False
+) -> jax.Array:
+    """``X0 + sum_p N*W`` — scatter per-protein velocity weights W (c,p)
+    back onto the signals through the stoichiometry, again with the
+    float (c,p,s) product fused into the reduction."""
+    return X0 + _sum1(N.astype(jnp.float32) * W[:, :, None], det)
 
 
 def _equilibrium_adjusted_x(
     X0: jax.Array,
     X1: jax.Array,
-    NV: jax.Array,
+    N: jax.Array,
+    W: jax.Array,
     V: jax.Array,
     p: CellParams,
     det: bool = False,
@@ -200,7 +252,9 @@ def _equilibrium_adjusted_x(
     quotient does not overshoot Ke (reference kinetics.py:808-859).  The
     reference early-exits when no protein needs adjustment; here all 4
     increments always run with masked updates — identical fixed point,
-    no host sync.
+    no host sync.  ``W`` are the negative-adjusted per-protein velocity
+    weights (V*F_min); ``V`` the unadjusted velocities driving the
+    impact threshold.
     """
     has_impact = jnp.abs(V) > 0.1
     is_fwd = V > 0.0
@@ -230,7 +284,7 @@ def _equilibrium_adjusted_x(
         F = jnp.where(apply & v_too_low, F + increment, F)
         F = jnp.clip(F, 0.0, 1.0)
 
-        X_new = X0 + _sum1(NV * F[:, :, None], det)
+        X_new = _weighted_dx(X0, N, W * F, det)
         X_new = jnp.where(X_new < 0.0, 0.0, X_new)
         X1 = jnp.where(apply, X_new, X1)
 
@@ -242,11 +296,10 @@ def _integrate_part(
 ) -> jax.Array:
     """One trim pass (reference kinetics.py:753-769)."""
     V = _velocities(X0, adj_vmax, p, det)  # (c,p)
-    NV = p.N.astype(jnp.float32) * V[:, :, None]  # (c,p,s)
-    NV_adj = _negative_adjusted_nv(NV, X0, det)
-    X1 = X0 + _sum1(NV_adj, det)
+    W = V * _negative_factors(X0, p.N, V, det)  # (c,p)
+    X1 = _weighted_dx(X0, p.N, W, det)
     X1 = jnp.where(X1 < 0.0, 0.0, X1)  # small fp errors can give -1e-7
-    return _equilibrium_adjusted_x(X0, X1, NV_adj, V, p, det)
+    return _equilibrium_adjusted_x(X0, X1, p.N, W, V, p, det)
 
 
 @partial(jax.jit, static_argnames=("det",))

@@ -728,9 +728,11 @@ class World:
         ny = (fp[:, 1][:, None] + dy[None, :]) % m
         cand = grid[nx, ny]  # (k, 8)
         src = np.broadcast_to(from_idxs[:, None], cand.shape)
-        valid = cand >= 0
+        # cand != src guards degenerate torus wraps (map_size <= 2), where
+        # a Moore offset can land back on the cell's own pixel
+        valid = (cand >= 0) & (cand != src)
         if to_member is not None:
-            valid &= to_member[np.clip(cand, 0, None)] & (cand != src)
+            valid &= to_member[np.clip(cand, 0, None)]
         a = src[valid]
         b = cand[valid]
         lo = np.minimum(a, b)

@@ -408,9 +408,8 @@ def _single_pass(X0, p) -> np.ndarray:
     reference's `_MockedKinetics.integrate_signals` (test_kinetics.py:87-97)."""
     X = jnp.asarray(np.asarray(X0, dtype=np.float32))
     V = integ._velocities(X, p.Vmax, p)
-    NV = p.N.astype(jnp.float32) * V[:, :, None]
-    NV_adj = integ._negative_adjusted_nv(NV, X)
-    X1 = np.array(X + jnp.sum(NV_adj, axis=1))
+    W = V * integ._negative_factors(X, p.N, V)
+    X1 = np.array(integ._weighted_dx(X, p.N, W))
     X1[X1 < 0.0] = 0.0
     return X1
 
@@ -670,6 +669,29 @@ def test_multiply_signals_golden():
     assert xx[3, 1] == 0.0
 
 
+def test_multiply_signals_nonfinite_x_saturates():
+    # an Inf (or NaN) concentration must saturate like the reference's
+    # NaN->0 / Inf->MAX scrubs — not poison the whole cell with NaN
+    # (regression: the log-space fast path once passed Inf through log)
+    X = np.array(
+        [[np.inf, 2.0, 3.0], [np.nan, 2.0, 3.0]], dtype=np.float32
+    )
+    N = np.array(
+        [[[0, 1, 2], [1, 1, 0]], [[0, 1, 2], [1, 1, 0]]], dtype=np.int32
+    )
+    for det in (False, True):
+        xx, _ = integ._multiply_signals(jnp.asarray(X), jnp.asarray(N), det)
+        xx = np.asarray(xx)
+        assert np.isfinite(xx).all(), (det, xx)
+        # Inf plays no part where its N is 0
+        assert xx[0, 0] == pytest.approx(2.0 * 9.0, rel=1e-5)
+        # Inf with N>0 saturates (huge but finite; only true Inf clamps
+        # to MAX, same as the pow/prod path)
+        assert 0.0 <= xx[0, 1] < np.inf
+        # NaN behaves like an absent (zero) signal under N>0
+        assert xx[1, 1] == 0.0
+
+
 def test_get_quotient_golden():
     # Q -> Ke golden values incl. MAX/MAX, x/0 and 0/x clamps (ref :1780)
     X = np.array([
@@ -786,9 +808,16 @@ def test_get_negative_adjusted_nv_golden():
         [[-10, 10, 0, 0], [0, 0, -100, 100], [0, 0, 0, 0]],
         [[-5, 5, 0, 0], [0, 0, -10, 10], [0, 0, 10, -10]],
     ], dtype=np.float32)
-    NV_adj = np.asarray(
-        integ._negative_adjusted_nv(jnp.asarray(NV), jnp.asarray(X0))
+    # NV entries are integer multiples, so NV with unit velocities feeds
+    # the (N, V) form of the new API directly
+    F_min = np.asarray(
+        integ._negative_factors(
+            jnp.asarray(X0),
+            jnp.asarray(NV.astype(np.int32)),
+            jnp.ones(NV.shape[:2], dtype=np.float32),
+        )
     )
+    NV_adj = NV * F_min[:, :, None]
     X1 = X0 + NV_adj.sum(1)
 
     np.testing.assert_allclose(
@@ -842,10 +871,11 @@ def test_get_equilibrium_adjusted_x_golden():
                          np.zeros((c, p)), N)
     NV = N.astype(np.float32) * V[:, :, None]
     X1 = X0 + NV.sum(1)
+    # no negative-adjustment in this golden case: the weights W equal V
     X2 = np.asarray(
         integ._equilibrium_adjusted_x(
-            jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(NV),
-            jnp.asarray(V), params,
+            jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(N),
+            jnp.asarray(V), jnp.asarray(V), params,
         )
     )
     np.testing.assert_allclose(X2[0], [5.0, 5.0, 0.0, 10.0], atol=1e-4)
@@ -925,8 +955,8 @@ def test_equilibrium_early_stop_matches_literal_port():
         )
         ours = np.asarray(
             integ._equilibrium_adjusted_x(
-                jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(NV),
-                jnp.asarray(V), params,
+                jnp.asarray(X0), jnp.asarray(X1), jnp.asarray(N),
+                jnp.asarray(V), jnp.asarray(V), params,
             )
         )
         want = _literal_equilibrium_adjusted_x(X0, X1, NV, V, Ke, Nf, Nb)

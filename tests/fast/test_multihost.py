@@ -83,11 +83,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_halo_diffusion_matches_single_process(tmp_path):
+def _run_children(script, tmp_path):
     port = _free_port()
-    script = tmp_path / "child.py"
-    script.write_text(_CHILD)
-
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(port), str(tmp_path)],
@@ -107,6 +104,26 @@ def test_two_process_halo_diffusion_matches_single_process(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    return outs, procs
+
+
+def test_two_process_halo_diffusion_matches_single_process(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+
+    # the probed free port can be grabbed by another process before the
+    # coordinator binds it (TOCTOU); retry the whole run on bind failure
+    for attempt in range(3):
+        outs, procs = _run_children(script, tmp_path)
+        if all(p.returncode == 0 for p in procs):
+            break
+        bind_failed = any(
+            p.returncode != 0
+            and ("already in use" in out or "Failed to bind" in out)
+            for p, out in zip(procs, outs)
+        )
+        if not bind_failed or attempt == 2:
+            break
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"child {i} failed:\n{out[-3000:]}"
 
