@@ -67,6 +67,16 @@ def _build_parser() -> argparse.ArgumentParser:
         help="run in the deterministic (bit-reproducible) numeric mode",
     )
     ap.add_argument(
+        "--classic",
+        action="store_true",
+        help="measure only the classic serial loop (skip the pipelined driver)",
+    )
+    ap.add_argument(
+        "--lag",
+        default="auto",
+        help="pipeline depth for the pipelined driver: 'auto' or an int",
+    )
+    ap.add_argument(
         "--_child",
         action="store_true",
         help=argparse.SUPPRESS,  # internal: actually run the measurement
@@ -153,7 +163,43 @@ def _child_main(args: argparse.Namespace) -> None:
     # remote-tunneled backends)
     float(world._molecule_map[0, 0, 0])
     float(world._cell_molecules[0, 0])
-    dt = (time.perf_counter() - t0) / args.steps
+    dt = dt_classic = (time.perf_counter() - t0) / args.steps
+
+    extra = {}
+    if not args.classic and not args.pallas:
+        # The device-resident pipelined driver (magicsoup_tpu/stepper.py):
+        # same canonical workload, selection and placement on device, host
+        # genome bookkeeping replayed asynchronously — no device->host
+        # fetch on the step critical path.  This is the headline number;
+        # the serial loop above is reported alongside as
+        # classic_steps_per_s.
+        st = ms.PipelinedStepper(
+            world,
+            mol_name="ATP",
+            kill_below=1.0,
+            divide_above=5.0,
+            divide_cost=4.0,
+            target_cells=args.n_cells,
+            genome_size=args.genome_size,
+            lag="auto" if args.lag == "auto" else int(args.lag),
+        )
+        for _ in range(max(args.warmup, 3)):
+            st.step()
+        st.drain()
+        t0 = time.perf_counter()
+        n_pipe = args.steps * 4
+        for _ in range(n_pipe):
+            st.step()
+        st.drain()  # all outputs arrived + replayed
+        dt_pipe = (time.perf_counter() - t0) / n_pipe
+        st.flush()
+        extra = {
+            "classic_steps_per_s": round(1.0 / dt, 4),
+            "pipeline_stats": {
+                k: int(v) for k, v in st.stats.items()
+            },
+        }
+        dt = dt_pipe
 
     steps_per_s = 1.0 / dt
     mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
@@ -169,9 +215,13 @@ def _child_main(args: argparse.Namespace) -> None:
                 "unit": "steps/s",
                 "vs_baseline": round(steps_per_s * BASELINE_S_PER_STEP, 4),
                 "device_rtt_ms": round(rtt_ms, 1),
+                # the serial loop's throughput with its one per-step fetch
+                # subtracted — the co-located-hardware proxy the pipelined
+                # driver is judged against ("raw within 20% of rtt-free")
                 "rtt_free_steps_per_s": round(
-                    1.0 / max(dt - rtt_ms / 1e3, 1e-9), 4
+                    1.0 / max(dt_classic - rtt_ms / 1e3, 1e-9), 4
                 ),
+                **extra,
             }
         )
     )

@@ -31,6 +31,7 @@ from magicsoup_tpu.factories import (
 from magicsoup_tpu.genetics import Genetics
 from magicsoup_tpu.kinetics import Kinetics
 from magicsoup_tpu.mutations import point_mutations, recombinations
+from magicsoup_tpu.stepper import PipelinedStepper
 from magicsoup_tpu.util import codons, random_genome, randstr, variants
 from magicsoup_tpu.world import World
 
@@ -46,6 +47,7 @@ __all__ = [
     "GenomeFact",
     "Kinetics",
     "Molecule",
+    "PipelinedStepper",
     "Protein",
     "RegulatoryDomain",
     "RegulatoryDomainFact",

@@ -456,6 +456,30 @@ class Kinetics:
     # parameter assembly                                                 #
     # ------------------------------------------------------------------ #
 
+    def build_dense_tokens(
+        self,
+        prot_counts: np.ndarray,
+        prots: np.ndarray,
+        doms: np.ndarray,
+    ) -> np.ndarray:
+        """Flat genome-engine buffers -> the dense (b, p, d, 5) token
+        tensor at the CURRENT protein/domain capacities, growing them
+        (grow-only, pow2) first if the batch needs more — the one
+        implementation of the capacity rule, shared by the normal set
+        path and the pipelined stepper's in-program spawn."""
+        max_prots = int(prot_counts.max()) if len(prot_counts) else 0
+        if max_prots > self.max_proteins:
+            self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
+        # grow-only domain capacity: a per-batch capacity would recompile
+        # `compute_cell_params` for every distinct batch shape
+        max_doms = int(prots[:, 3].max()) if len(prots) else 1
+        self.max_doms = max(self.max_doms, pad_pow2(max_doms, minimum=1))
+        dense, _ = flat_to_dense(
+            prot_counts, prots, doms, n_prots_cap=self.max_proteins,
+            n_doms_cap=self.max_doms,
+        )
+        return dense
+
     def set_cell_params_flat(
         self,
         cell_idxs: np.ndarray | list[int],
@@ -473,17 +497,7 @@ class Kinetics:
         b = len(cell_idxs)
         if b == 0:
             return
-        max_prots = int(prot_counts.max()) if len(prot_counts) else 0
-        if max_prots > self.max_proteins:
-            self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
-        # grow-only domain capacity: a per-batch capacity would recompile
-        # `compute_cell_params` for every distinct batch shape
-        max_doms = int(prots[:, 3].max()) if len(prots) else 1
-        self.max_doms = max(self.max_doms, pad_pow2(max_doms, minimum=1))
-        dense, _ = flat_to_dense(
-            prot_counts, prots, doms, n_prots_cap=self.max_proteins,
-            n_doms_cap=self.max_doms,
-        )
+        dense = self.build_dense_tokens(prot_counts, prots, doms)
         b_pad = pad_pow2(b)
         dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=dense.dtype)
         dense_pad[:b] = dense

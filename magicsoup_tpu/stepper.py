@@ -1,0 +1,913 @@
+"""
+Device-resident pipelined step driver: the whole selection workload step —
+activity, threshold selection, kill, divide (with on-device child
+placement), spawn, degradation/diffusion/permeation — runs as ONE fused
+device program per step, and the host processes each step's small output
+record (selection masks, child/spawn placements) asynchronously, a few
+steps behind the device.
+
+Why: the classic :class:`magicsoup_tpu.world.World` loop fetches a
+selection column every step and decides kill/divide on the host, so one
+device->host round trip sits on every step's critical path — on a remote
+accelerator that RTT bounds steps/s at 1/RTT no matter how fast the
+kernels get, and even co-located it serializes host bookkeeping with
+device compute.  Here the device never waits for the host: selection is
+evaluated on device, placement is resolved on device, and the host's
+genome bookkeeping (string mutation, recombination, translation) runs
+concurrently on a replay of the trajectory, pushing refreshed kinetic
+parameters back a few steps later.
+
+The reference (mRcSchwering/magic-soup) has no counterpart — its loop is
+strictly serial (`performance/run_simulation.py:61-100`).  This is the
+TPU-native design SURVEY.md §7 asks for, generalized to the outer loop.
+
+Semantics vs the serial loop (all deltas are documented, bounded, and
+seed-reproducible at a fixed ``lag``):
+
+- **Phenotype lag.** Mutations and recombinations are drawn from the
+  replayed state of step ``t`` and their re-translated parameters reach
+  the device a few steps later (the pipeline depth, typically 2-6).  The
+  genome history itself is exact and serial — only the genotype ->
+  phenotype refresh trails, as in asynchronous evolution.
+- **Spawn-decision lag.**  Population top-up (``target_cells``) reacts to
+  the replayed population count, so it also trails by the pipeline depth.
+- **Slot (not compacted) indices between flushes.**  Killed rows stay in
+  place as dead slots until a compaction step folds them out; cell
+  indices visible to the host replay are therefore row ids, not the
+  reference's densely-compacted indices.  :meth:`flush` compacts and
+  syncs everything back into the attached :class:`World`, restoring the
+  reference's dense-index view.
+- **Bounded placement.**  Child/spawn placement resolves conflicts in
+  ``n_rounds`` vectorized rounds (lowest row wins, like the host path);
+  a candidate still conflicted after the last round does not divide that
+  step.  Divisions are also bounded per step (``max_divisions``) and by
+  remaining slot capacity; drops are counted in :attr:`stats`.
+
+Determinism: with ``lag`` set to an integer the dispatch/replay schedule
+is fixed, so a given seed reproduces the trajectory exactly;
+``lag="auto"`` adapts to measured readiness (faster, not reproducible).
+"""
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.native import engine as _engine
+from magicsoup_tpu.ops import diffusion as _diff
+from magicsoup_tpu.ops.integrate import CellParams, _integrate_signals_jit
+from magicsoup_tpu.ops.params import (
+    compact_rows,
+    compute_cell_params,
+    copy_params,
+    permute_params,
+    scatter_params,
+)
+from magicsoup_tpu.util import (
+    fetch_host as _fetch_host,
+    moore_pairs,
+    random_genome,
+    randstr,
+)
+
+# numpy on purpose: a module-level jnp array would initialise the XLA
+# backend at import time, which breaks jax.distributed.initialize() in
+# multi-host programs importing this package
+_MOORE_DX = np.asarray([-1, -1, -1, 0, 0, 1, 1, 1], dtype=np.int32)
+_MOORE_DY = np.asarray([-1, 0, 1, -1, 1, -1, 0, 1], dtype=np.int32)
+
+
+class StepOutputs(NamedTuple):
+    """The per-step device->host record (a few tens of KB)."""
+
+    kill: jax.Array  # (cap,) bool — rows killed this step
+    parents: jax.Array  # (max_div,) i32 rows that divided (cap = none)
+    child_pos: jax.Array  # (max_div, 2) i32 child pixels
+    n_placed: jax.Array  # i32 — number of successful divisions
+    n_candidates: jax.Array  # i32 — division candidates before clamps
+    spawn_ok: jax.Array  # (b_spawn,) bool — which queued spawns landed
+    spawn_pos: jax.Array  # (b_spawn, 2) i32 spawn pixels
+    n_rows: jax.Array  # i32 — high-water row count after the step
+    n_alive: jax.Array  # i32 — live cells after the step
+
+
+class DeviceState(NamedTuple):
+    """All device-resident simulation state threaded step to step."""
+
+    mm: jax.Array  # (mols, m, m) molecule map
+    cm: jax.Array  # (cap, mols) intracellular molecules
+    pos: jax.Array  # (cap, 2) i32 positions
+    occ: jax.Array  # (m, m) bool pixel occupancy
+    alive: jax.Array  # (cap,) bool
+    n_rows: jax.Array  # i32 high-water row count (rows >= n_rows unused)
+    key: jax.Array  # PRNG key for on-device placement draws
+
+
+def _resolve_conflicts(
+    want: jax.Array, tx: jax.Array, ty: jax.Array, m: int
+) -> jax.Array:
+    """Among concurrent requests for target pixels, the lowest slot wins
+    (mirrors the host path's sorted sequential semantics,
+    world.py:_place_in_neighborhood)."""
+    n = want.shape[0]
+    slots = jnp.arange(n, dtype=jnp.int32)
+    target = tx * m + ty
+    winner = jnp.full((m * m,), n, dtype=jnp.int32)
+    winner = winner.at[jnp.where(want, target, m * m)].min(
+        jnp.where(want, slots, n), mode="drop"
+    )
+    return want & (winner[target] == slots)
+
+
+def _occupy(occ: jax.Array, win: jax.Array, tx: jax.Array, ty: jax.Array):
+    m = occ.shape[0]
+    return occ.at[
+        jnp.where(win, tx, m), jnp.where(win, ty, m)
+    ].set(True, mode="drop")
+
+
+def _place_moore(
+    key: jax.Array,
+    occ: jax.Array,
+    pos: jax.Array,
+    cand: jax.Array,
+    n_rounds: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Place one free Moore-neighborhood pixel per candidate row, no two
+    on the same pixel (reference rust/world.rs:59-97; host counterpart
+    world.py:_place_in_neighborhood).  Returns (placed, child_pos, occ)."""
+    cap = cand.shape[0]
+    m = occ.shape[0]
+    placed = jnp.zeros_like(cand)
+    cpos = jnp.zeros_like(pos)
+    rows = jnp.arange(cap, dtype=jnp.int32)
+
+    def body(_, carry):
+        key, occ, placed, cpos = carry
+        key, sub = jax.random.split(key)
+        pending = cand & ~placed
+        nx = (pos[:, 0:1] + _MOORE_DX[None, :]) % m  # (cap, 8)
+        ny = (pos[:, 1:2] + _MOORE_DY[None, :]) % m
+        free = ~occ[nx, ny] & pending[:, None]
+        n_free = free.sum(axis=1)
+        r = (jax.random.uniform(sub, (cap,)) * n_free).astype(jnp.int32)
+        opt_rank = jnp.cumsum(free, axis=1) - 1
+        sel = jnp.argmax(free & (opt_rank == r[:, None]), axis=1)
+        tx = nx[rows, sel]
+        ty = ny[rows, sel]
+        want = pending & (n_free > 0)
+        win = _resolve_conflicts(want, tx, ty, m)
+        occ = _occupy(occ, win, tx, ty)
+        cpos = jnp.where(
+            win[:, None], jnp.stack([tx, ty], axis=1), cpos
+        )
+        return key, occ, placed | win, cpos
+
+    _, occ, placed, cpos = jax.lax.fori_loop(
+        0, n_rounds, body, (key, occ, placed, cpos)
+    )
+    return placed, cpos, occ
+
+
+def _place_global(
+    key: jax.Array, occ: jax.Array, valid: jax.Array, n_rounds: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Place each valid slot on a uniformly random FREE pixel (rejection
+    sampling over the whole torus — the conditional distribution over
+    free pixels is uniform, like the host spawn path).  Slots still
+    conflicted after the last round are dropped (host retries later)."""
+    b = valid.shape[0]
+    m = occ.shape[0]
+    placed = jnp.zeros_like(valid)
+    spos = jnp.zeros((b, 2), dtype=jnp.int32)
+
+    def body(_, carry):
+        key, occ, placed, spos = carry
+        key, sub = jax.random.split(key)
+        xy = jax.random.randint(sub, (b, 2), 0, m, dtype=jnp.int32)
+        tx, ty = xy[:, 0], xy[:, 1]
+        want = valid & ~placed & ~occ[tx, ty]
+        win = _resolve_conflicts(want, tx, ty, m)
+        occ = _occupy(occ, win, tx, ty)
+        spos = jnp.where(win[:, None], xy, spos)
+        return key, occ, placed | win, spos
+
+    _, occ, placed, spos = jax.lax.fori_loop(
+        0, n_rounds, body, (key, occ, placed, spos)
+    )
+    return placed, spos, occ
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("det", "max_div", "n_rounds", "compact", "has_spawn"),
+)
+def _pipeline_step(
+    state: DeviceState,
+    params: CellParams,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+    degrad_factors: jax.Array,
+    mol_idx: jax.Array,  # i32 — selection molecule column
+    kill_below: jax.Array,
+    divide_above: jax.Array,
+    divide_cost: jax.Array,
+    spawn_dense: jax.Array | None,  # (b_spawn, p, d, 5) i16 or None
+    spawn_valid: jax.Array | None,  # (b_spawn,) bool
+    tables: Any,  # TokenTables (only read when has_spawn)
+    abs_temp: jax.Array,
+    *,
+    det: bool,
+    max_div: int,
+    n_rounds: int,
+    compact: bool,
+    has_spawn: bool,
+) -> tuple[DeviceState, CellParams, StepOutputs]:
+    """One fused workload step (spawn -> activity -> select -> kill ->
+    divide -> degrade/diffuse/permeate [-> compact]) — a single dispatch,
+    no host round trip."""
+    mm, cm, pos, occ, alive, n_rows, key = state
+    cap, n_mols = cm.shape
+    m = occ.shape[0]
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    key, k_spawn, k_div = jax.random.split(key, 3)
+    mol_onehot = (jnp.arange(n_mols, dtype=jnp.int32) == mol_idx).astype(
+        jnp.float32
+    )
+
+    # ---- 0. spawn queued newcomers ------------------------------------
+    if has_spawn:
+        b_spawn = spawn_valid.shape[0]
+        budget = cap - n_rows
+        valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
+        spawn_ok, spawn_pos, occ = _place_global(k_spawn, occ, valid, n_rounds)
+        srank = jnp.cumsum(spawn_ok) - 1
+        srow = jnp.where(spawn_ok, n_rows + srank, cap).astype(jnp.int32)
+        sx, sy = spawn_pos[:, 0], spawn_pos[:, 1]
+        pickup = mm[:, sx, sy] * 0.5 * spawn_ok[None, :]  # (mols, b)
+        mm = mm.at[:, sx, sy].add(-pickup)
+        cm = cm.at[srow].set(pickup.T, mode="drop")
+        pos = pos.at[srow].set(spawn_pos, mode="drop")
+        alive = alive.at[srow].set(True, mode="drop")
+        params = scatter_params(
+            params, compute_cell_params(spawn_dense, tables, abs_temp), srow
+        )
+        n_rows = n_rows + spawn_ok.sum(dtype=jnp.int32)
+    else:
+        spawn_ok = jnp.zeros((1,), dtype=bool)
+        spawn_pos = jnp.zeros((1, 2), dtype=jnp.int32)
+
+    # ---- 1. enzymatic activity ----------------------------------------
+    xs, ys = pos[:, 0], pos[:, 1]
+    ext = mm[:, xs, ys].T  # (cap, mols)
+    X1 = _integrate_signals_jit(
+        jnp.concatenate([cm, ext], axis=1), params, det
+    )
+    alive_c = alive[:, None]
+    cm = jnp.where(alive_c, X1[:, :n_mols], cm)
+    mm = mm.at[:, xs, ys].add(
+        jnp.where(alive_c, X1[:, n_mols:] - ext, 0.0).T
+    )
+
+    # ---- 2. selection + kill ------------------------------------------
+    atp = jnp.einsum("cm,m->c", cm, mol_onehot)
+    kill = alive & (atp < kill_below)
+    spill = jnp.where(kill[:, None], cm, 0.0)
+    mm = mm.at[:, xs, ys].add(spill.T)
+    cm = jnp.where(kill[:, None], 0.0, cm)
+    occ = occ.at[
+        jnp.where(kill, xs, m), jnp.where(kill, ys, m)
+    ].set(False, mode="drop")
+    alive = alive & ~kill
+
+    # ---- 3. divide -----------------------------------------------------
+    cand = alive & (atp > divide_above)
+    n_candidates = cand.sum(dtype=jnp.int32)
+    budget = jnp.minimum(max_div, cap - n_rows)
+    cand = cand & ((jnp.cumsum(cand) - 1) < budget)
+    # every attempting candidate pays the division cost, whether or not a
+    # free pixel is found — exactly the canonical workload's order
+    # (performance/workload.py:69-75 subtracts before divide_cells)
+    cm = cm - (jnp.where(cand, divide_cost, 0.0)[:, None] * mol_onehot)
+    placed, cpos, occ = _place_moore(k_div, occ, pos, cand, n_rounds)
+    crank = jnp.cumsum(placed) - 1
+    crow = jnp.where(placed, n_rows + crank, cap).astype(jnp.int32)
+    half = jnp.where(placed[:, None], cm * 0.5, cm)
+    cm = half.at[crow].add(
+        jnp.where(placed[:, None], half, 0.0), mode="drop"
+    )
+    pos = pos.at[crow].set(cpos, mode="drop")
+    alive = alive.at[crow].set(True, mode="drop")
+    p_idx = jnp.nonzero(placed, size=max_div, fill_value=cap)[0].astype(
+        jnp.int32
+    )
+    c_idx = jnp.where(
+        p_idx < cap, n_rows + jnp.arange(max_div, dtype=jnp.int32), cap
+    )
+    params = copy_params(params, p_idx, c_idx)
+    n_placed = placed.sum(dtype=jnp.int32)
+    n_rows = n_rows + n_placed
+
+    # ---- 4. degrade + diffuse + permeate ------------------------------
+    mm = mm * degrad_factors[:, None, None]
+    cm = cm * degrad_factors[None, :]
+    mm = _diff.diffuse(mm, kernels, det=det)
+    xs, ys = pos[:, 0], pos[:, 1]
+    ext = mm[:, xs, ys].T
+    new_cm, new_ext = _diff.permeate(cm, ext, perm_factors, det=det)
+    alive_c = alive[:, None]
+    cm = jnp.where(alive_c, new_cm, cm)
+    mm = mm.at[:, xs, ys].add(jnp.where(alive_c, new_ext - ext, 0.0).T)
+
+    # ---- 5. optional compaction ---------------------------------------
+    child_pos_out = cpos[jnp.clip(p_idx, 0, cap - 1)]
+    if compact:
+        # stable sort of ~alive: live rows keep order, dead fold out.
+        # np.argsort(~alive, kind="stable") on the host replay produces
+        # the IDENTICAL permutation (stability makes it unique), so the
+        # host needs no extra fetch to follow.
+        perm = jnp.argsort(~alive, stable=True).astype(jnp.int32)
+        n_keep = alive.sum(dtype=jnp.int32)
+        cm = compact_rows(cm, perm, n_keep)
+        pos = compact_rows(pos, perm, n_keep)
+        params = permute_params(params, perm, n_keep)
+        alive = rows < n_keep
+        n_rows = n_keep
+
+    out = StepOutputs(
+        kill=kill,
+        parents=p_idx,
+        child_pos=child_pos_out,
+        n_placed=n_placed,
+        n_candidates=n_candidates,
+        spawn_ok=spawn_ok,
+        spawn_pos=spawn_pos,
+        n_rows=n_rows,
+        n_alive=alive.sum(dtype=jnp.int32),
+    )
+    new_state = DeviceState(
+        mm=mm, cm=cm, pos=pos, occ=occ, alive=alive, n_rows=n_rows, key=key
+    )
+    return new_state, params, out
+
+
+@jax.jit
+def _compact_program(
+    state: DeviceState, params: CellParams, perm: jax.Array, n_keep: jax.Array
+) -> tuple[DeviceState, CellParams]:
+    """Standalone compaction (used by :meth:`PipelinedStepper.flush`)."""
+    return (
+        DeviceState(
+            mm=state.mm,
+            cm=compact_rows(state.cm, perm, n_keep),
+            pos=compact_rows(state.pos, perm, n_keep),
+            occ=state.occ,
+            alive=jnp.arange(state.alive.shape[0]) < n_keep,
+            n_rows=n_keep,
+            key=state.key,
+        ),
+        permute_params(params, perm, n_keep),
+    )
+
+
+class _Pending(NamedTuple):
+    """One dispatched step awaiting host replay."""
+
+    out: StepOutputs
+    spawn_genomes: list  # genomes queued into this dispatch (b_spawn order)
+    spawn_labels: list
+    compacted: bool
+    change_seq: int  # genome-change counter at dispatch time
+
+
+class PipelinedStepper:
+    """
+    Pipelined driver for the canonical selection workload over a
+    :class:`World` (see module docstring for the execution model and its
+    documented deltas vs the serial loop).
+
+    Parameters:
+        world: The world to drive.  Must not be mesh-placed (the sharded
+            step keeps the classic loop); its current population becomes
+            the starting state.
+        mol_name: Molecule whose intracellular amount drives selection
+            (``"ATP"`` in the canonical workload).
+        kill_below: Kill cells below this amount.
+        divide_above: Divide cells above this amount...
+        divide_cost: ...at this cost, paid before sharing.
+        target_cells: Population size to top up to with random genomes
+            (``None`` disables spawning).
+        genome_size: Size of top-up genomes.
+        lag: Pipeline depth.  An integer fixes the schedule (seed-exact
+            reproducibility); ``"auto"`` processes outputs as their
+            transfers complete, bounded by ``max_lag``.
+        max_divisions: Static per-step division budget (slot allocation
+            is bounded so the step program compiles once).
+        spawn_block: Static per-step spawn budget.
+        n_rounds: Conflict-resolution rounds for on-device placement.
+        p_mutation / p_indel / p_del / p_recombination: Mutation
+            parameters (reference defaults).
+        compact_headroom: Compact when fewer than this many free rows
+            are estimated to remain (default 256).
+        auto_grow: Double the world's slot capacity (a rare full
+            pipeline drain) when the live population crowds it; with
+            ``False`` the allocation clamps instead and drops are
+            counted in :attr:`stats`.
+    """
+
+    def __init__(
+        self,
+        world,
+        *,
+        mol_name: str = "ATP",
+        kill_below: float = 1.0,
+        divide_above: float = 5.0,
+        divide_cost: float = 4.0,
+        target_cells: int | None = None,
+        genome_size: int = 500,
+        lag: int | str = "auto",
+        max_lag: int = 8,
+        max_divisions: int = 2048,
+        spawn_block: int = 1024,
+        n_rounds: int = 4,
+        p_mutation: float = 1e-6,
+        p_indel: float = 0.4,
+        p_del: float = 0.66,
+        p_recombination: float = 1e-7,
+        compact_headroom: int | None = None,
+        auto_grow: bool = True,
+    ):
+        if world._mesh is not None:
+            raise ValueError(
+                "PipelinedStepper drives single-device worlds; mesh-placed"
+                " worlds keep the classic loop"
+            )
+        self.world = world
+        self.kin = world.kinetics
+        self.mol_idx = world.chemistry.molname_2_idx[mol_name]
+        self.kill_below = float(kill_below)
+        self.divide_above = float(divide_above)
+        self.divide_cost = float(divide_cost)
+        self.target_cells = target_cells
+        self.genome_size = genome_size
+        if lag != "auto" and (not isinstance(lag, int) or lag < 0):
+            raise ValueError("lag must be 'auto' or a non-negative int")
+        self.lag = lag
+        self.max_lag = max_lag if lag == "auto" else max(int(lag), 1)
+        self.max_divisions = max_divisions
+        self.spawn_block = spawn_block
+        self.n_rounds = n_rounds
+        self.p_mutation = p_mutation
+        self.p_indel = p_indel
+        self.p_del = p_del
+        self.p_recombination = p_recombination
+        self.compact_headroom = (
+            compact_headroom if compact_headroom is not None else 256
+        )
+        self.auto_grow = auto_grow
+        self.stats = {
+            "steps": 0,
+            "replayed": 0,
+            "compactions": 0,
+            "growths": 0,
+            "divisions": 0,
+            "division_drops": 0,
+            "kills": 0,
+            "spawned": 0,
+            "spawn_drops": 0,
+            "pushes": 0,
+        }
+
+        # constant device scalars, built once — jnp.asarray per dispatch
+        # would put five tiny host->device transfers on the very critical
+        # path this driver exists to clear
+        self._mol_idx_dev = jnp.asarray(self.mol_idx, dtype=jnp.int32)
+        self._kill_below_dev = jnp.asarray(self.kill_below, dtype=jnp.float32)
+        self._divide_above_dev = jnp.asarray(
+            self.divide_above, dtype=jnp.float32
+        )
+        self._divide_cost_dev = jnp.asarray(
+            self.divide_cost, dtype=jnp.float32
+        )
+        self._abs_temp_dev = jnp.asarray(world.abs_temp, dtype=jnp.float32)
+
+        self._rng = np.random.default_rng(world._rng.randrange(2**63))
+        self._pending: list[_Pending] = []
+        self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
+        self._push_buffer: list[tuple[list, list]] = []  # deferred pushes
+        self._compact_outstanding = False
+        self._growth_hist: list[int] = []  # recent per-step row growth
+        self._change_seq = 0  # bumps on every genome-change batch CREATED
+        self._dispatched_seq = 0  # highest batch seq actually DISPATCHED
+        self._attach(jax.random.PRNGKey(world._rng.randrange(2**31)))
+
+    def _attach(self, key: jax.Array) -> None:
+        """(Re)build device + replay state from the attached world —
+        used at construction and after a capacity growth."""
+        w = self.world
+        self._cap = w._capacity
+        self._state = DeviceState(
+            mm=w._molecule_map,
+            cm=w._cell_molecules,
+            pos=w._positions_dev,
+            occ=jnp.asarray(w._np_cell_map),
+            alive=jnp.arange(self._cap) < w.n_cells,
+            n_rows=jnp.asarray(w.n_cells, dtype=jnp.int32),
+            key=key,
+        )
+        # host replay state (row-indexed, append-only between compactions)
+        self._genomes: list = list(w.cell_genomes) + [""] * (
+            self._cap - w.n_cells
+        )
+        self._labels: list = list(w.cell_labels) + [""] * (
+            self._cap - w.n_cells
+        )
+        self._lifetimes = np.zeros(self._cap, dtype=np.int32)
+        self._lifetimes[: w.n_cells] = w.cell_lifetimes
+        self._divisions = np.zeros(self._cap, dtype=np.int32)
+        self._divisions[: w.n_cells] = w.cell_divisions
+        self._positions = w._np_positions.copy()
+        self._alive = np.zeros(self._cap, dtype=bool)
+        self._alive[: w.n_cells] = True
+        self._n_rows = w.n_cells
+        # per-row: change counter of the last genome change whose params
+        # the device may not have had when older in-flight steps were
+        # dispatched (-1 = device params match the genome)
+        self._last_change = np.full(self._cap, -1, dtype=np.int64)
+
+    def _grow_capacity(self) -> None:
+        """Drain, sync into the world, double its slot capacity, and
+        reattach — the pipelined analog of the classic loop's amortized
+        pow2 growth (a rare full pipeline bubble)."""
+        key = self._state.key
+        self.flush()
+        self.world._ensure_capacity(self.world._capacity + 1)
+        self._attach(key)
+        self.stats["growths"] += 1
+
+    # -------------------------------------------------------------- #
+    # dispatch side                                                  #
+    # -------------------------------------------------------------- #
+
+    def step(self) -> None:
+        """Dispatch one workload step and replay any arrived outputs."""
+        self._drain(block=False)
+
+        # Compaction scheduling is a prediction: the replayed row count
+        # lags the device, so project forward with the recent per-step
+        # growth (x2 margin).  A mis-prediction is safe — the device
+        # clamps allocations at capacity and the drops are counted.
+        g_est = max(self._growth_hist[-8:], default=0)
+        g_est = max(g_est, 32)
+
+        # compaction cannot free more than the dead rows; when the LIVE
+        # population itself crowds the capacity (>7/8 full), grow (drain
+        # + double + reattach, like the classic loop's pow2 growth)
+        if self.auto_grow:
+            grow_at = max(2 * g_est, self._cap // 8)
+            if self._cap - int(self._alive.sum()) < grow_at:
+                self.drain()
+                if self._cap - int(self._alive.sum()) < grow_at:
+                    self._grow_capacity()
+
+        projected = (
+            self._n_rows
+            + (len(self._pending) + 1) * 2 * g_est
+            + len(self._spawn_queue)
+        )
+        compact = (
+            not self._compact_outstanding
+            and projected + self.compact_headroom > self._cap
+        )
+
+        # spawn batch for this dispatch
+        spawn = self._spawn_queue[: self.spawn_block]
+        self._spawn_queue = self._spawn_queue[len(spawn) :]
+        has_spawn = len(spawn) > 0
+        spawn_dense = spawn_valid = None
+        if has_spawn:
+            genomes = [g for g, _ in spawn]
+            prot_counts, prots, doms = (
+                self.world.genetics.translate_genomes_flat(genomes)
+            )
+            dense = self.kin.build_dense_tokens(prot_counts, prots, doms)
+            pad = np.zeros(
+                (self.spawn_block,) + dense.shape[1:], dtype=dense.dtype
+            )
+            pad[: len(spawn)] = dense
+            spawn_dense = jnp.asarray(pad)
+            valid = np.zeros(self.spawn_block, dtype=bool)
+            valid[: len(spawn)] = True
+            spawn_valid = jnp.asarray(valid)
+
+        self._state, self.kin.params, out = _pipeline_step(
+            self._state,
+            self.kin.params,
+            self.world._diff_kernels,
+            self.world._perm_factors,
+            self.world._degrad_factors,
+            self._mol_idx_dev,
+            self._kill_below_dev,
+            self._divide_above_dev,
+            self._divide_cost_dev,
+            spawn_dense,
+            spawn_valid,
+            self.kin.tables,
+            self._abs_temp_dev,
+            det=self.world.deterministic,
+            max_div=self.max_divisions,
+            n_rounds=self.n_rounds,
+            compact=compact,
+            has_spawn=has_spawn,
+        )
+        for arr in out:
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        self._pending.append(
+            _Pending(
+                out=out,
+                spawn_genomes=[g for g, _ in spawn],
+                spawn_labels=[l for _, l in spawn],
+                compacted=compact,
+                # what the device saw: only DISPATCHED pushes — a batch
+                # still held in the compaction buffer is invisible to it
+                change_seq=self._dispatched_seq,
+            )
+        )
+        if compact:
+            self._compact_outstanding = True
+        self.stats["steps"] += 1
+        self._drain(block=False)
+
+    # -------------------------------------------------------------- #
+    # replay side                                                    #
+    # -------------------------------------------------------------- #
+
+    def drain(self) -> None:
+        """Block until every dispatched step has been replayed (the
+        device may still be ahead on programs, but all outputs are in
+        and the host state is caught up)."""
+        self._drain(block=True)
+
+    def _ready(self, pend: _Pending) -> bool:
+        try:
+            return all(a.is_ready() for a in pend.out)
+        except AttributeError:
+            return False
+
+    def _drain(self, block: bool) -> None:
+        while self._pending:
+            if self.lag == "auto":
+                must = block or len(self._pending) > self.max_lag
+                if not must and not self._ready(self._pending[0]):
+                    break
+            elif not block and len(self._pending) < max(self.lag, 1):
+                # fixed lag: replay on schedule only, NEVER on readiness —
+                # push timing is part of the trajectory, so reproducibility
+                # requires a transfer-speed-independent schedule
+                break
+            self._replay(self._pending.pop(0))
+
+    def _replay(self, pend: _Pending) -> None:
+        out = pend.out
+        kill = np.asarray(out.kill)
+        parents = np.asarray(out.parents)
+        n_placed = int(out.n_placed)
+        child_pos = np.asarray(out.child_pos)
+        spawn_ok = np.asarray(out.spawn_ok)
+        spawn_pos = np.asarray(out.spawn_pos)
+
+        # 0. spawns (allocation order matches the device: queue order)
+        n_spawned = 0
+        if pend.spawn_genomes:
+            for i, (g, lab) in enumerate(
+                zip(pend.spawn_genomes, pend.spawn_labels)
+            ):
+                if not spawn_ok[i]:
+                    continue
+                row = self._n_rows + n_spawned
+                n_spawned += 1
+                self._genomes[row] = g
+                self._labels[row] = lab
+                self._lifetimes[row] = 0
+                self._divisions[row] = 0
+                self._positions[row] = spawn_pos[i]
+                self._alive[row] = True
+            self._n_rows += n_spawned
+            self.stats["spawned"] += n_spawned
+            self.stats["spawn_drops"] += len(pend.spawn_genomes) - n_spawned
+
+        # 1. kills
+        self._alive[kill] = False
+        self.stats["kills"] += int(kill.sum())
+
+        # 2. divisions (parents ascending; children appended in order).
+        # The device copied the parent's params as of this step's
+        # DISPATCH; if the parent's genome changed in a replay since,
+        # that copy is stale and the child needs its own push — without
+        # it the child would keep the old phenotype forever.
+        repush: dict[int, str] = {}
+        for i in range(n_placed):
+            p = int(parents[i])
+            row = self._n_rows + i
+            self._genomes[row] = self._genomes[p]
+            self._labels[row] = self._labels[p]
+            self._divisions[p] += 1
+            self._divisions[row] = self._divisions[p]
+            self._lifetimes[p] = 0
+            self._lifetimes[row] = 0
+            self._positions[row] = child_pos[i]
+            self._alive[row] = True
+            if self._last_change[p] > pend.change_seq:
+                repush[row] = self._genomes[row]
+            else:
+                self._last_change[row] = self._last_change[p]
+        self._n_rows += n_placed
+        self.stats["divisions"] += n_placed
+        self.stats["division_drops"] += int(out.n_candidates) - n_placed
+
+        # 3. lifetimes
+        self._lifetimes[: self._n_rows][
+            self._alive[: self._n_rows]
+        ] += 1
+
+        # 4. compaction replay (same stable permutation as the device)
+        if pend.compacted:
+            perm = np.argsort(~self._alive, kind="stable")
+            n_keep = int(self._alive.sum())
+            self._apply_perm(perm, n_keep)
+            self._compact_outstanding = False
+            self.stats["compactions"] += 1
+            # remap deferred pushes and this step's child refreshes
+            # through the permutation, then release the deferred ones
+            inv = np.empty(self._cap, dtype=np.int64)
+            inv[perm] = np.arange(self._cap)
+            repush = {int(inv[r]): g for r, g in repush.items()}
+            for genomes, rows, seq in self._push_buffer:
+                self._dispatch_push(
+                    genomes, [int(inv[r]) for r in rows], seq
+                )
+            self._push_buffer = []
+
+        self.stats["replayed"] += 1
+        self._growth_hist.append(n_spawned + n_placed)
+        if len(self._growth_hist) > 64:
+            del self._growth_hist[:32]
+
+        # 5. evolution on the replayed state (+ stale-child refreshes)
+        self._recombinate_and_mutate(repush)
+
+        # 6. population top-up (reacts with pipeline lag, documented)
+        if self.target_cells is not None:
+            n_alive = int(self._alive.sum())
+            missing = (
+                self.target_cells
+                - n_alive
+                - len(self._spawn_queue)
+                - sum(len(p.spawn_genomes) for p in self._pending)
+            )
+            if missing > 0:
+                rng = self.world._rng
+                self._spawn_queue.extend(
+                    (
+                        random_genome(s=self.genome_size, rng=rng),
+                        randstr(n=12, rng=rng),
+                    )
+                    for _ in range(missing)
+                )
+
+    def _apply_perm(self, perm: np.ndarray, n_keep: int) -> None:
+        self._genomes = [self._genomes[i] for i in perm]
+        self._labels = [self._labels[i] for i in perm]
+        self._lifetimes = self._lifetimes[perm]
+        self._divisions = self._divisions[perm]
+        self._positions = self._positions[perm]
+        self._last_change = self._last_change[perm]
+        self._alive = np.zeros(self._cap, dtype=bool)
+        self._alive[:n_keep] = True
+        for i in range(n_keep, self._cap):
+            self._genomes[i] = ""
+            self._labels[i] = ""
+        self._lifetimes[n_keep:] = 0
+        self._divisions[n_keep:] = 0
+        self._positions[n_keep:] = 0
+        self._last_change[n_keep:] = -1
+        self._n_rows = n_keep
+
+    def _recombinate_and_mutate(self, repush: dict[int, str] | None = None) -> None:
+        rows = np.nonzero(self._alive)[0]
+        changed: dict[int, str] = dict(repush or {})
+
+        # recombination among Moore neighbors (workload order: first)
+        if len(rows) > 1 and self.p_recombination > 0:
+            pairs_k = moore_pairs(
+                self._positions[rows], self.world.map_size
+            )
+            if len(pairs_k):
+                pair_rows = rows[pairs_k]
+                seed = int(self._rng.integers(2**63))
+                for g0, g1, k in _engine.recombinations_indexed(
+                    self._genomes, pair_rows, p=self.p_recombination,
+                    seed=seed,
+                ):
+                    r0, r1 = pair_rows[k]
+                    changed[int(r0)] = g0
+                    changed[int(r1)] = g1
+                for r, g in changed.items():
+                    self._genomes[r] = g
+
+        # point mutations (on the post-recombination genomes)
+        if len(rows) and self.p_mutation > 0:
+            seqs = [self._genomes[int(r)] for r in rows]
+            seed = int(self._rng.integers(2**63))
+            for g, i in _engine.point_mutations(
+                seqs, p=self.p_mutation, p_indel=self.p_indel,
+                p_del=self.p_del, seed=seed,
+            ):
+                r = int(rows[i])
+                self._genomes[r] = g
+                changed[r] = g
+
+        if changed:
+            rows_c = sorted(changed)
+            genomes_c = [changed[r] for r in rows_c]
+            self._change_seq += 1
+            self._last_change[rows_c] = self._change_seq
+            if self._compact_outstanding:
+                # row ids shift at the in-flight compaction; hold the
+                # push until its replay provides the permutation
+                self._push_buffer.append(
+                    (genomes_c, rows_c, self._change_seq)
+                )
+            else:
+                self._dispatch_push(genomes_c, rows_c, self._change_seq)
+
+    def _dispatch_push(
+        self, genomes: list[str], rows: list[int], seq: int
+    ) -> None:
+        """Re-translate changed genomes and scatter their parameters —
+        the phenotype refresh that trails the genome history.  Rows that
+        died since the genome change receive stale parameters; those rows
+        are alive-masked everywhere and fold out at the next compaction,
+        so the write is harmless."""
+        self.world._update_cell_params(genomes=genomes, idxs=rows)
+        self._dispatched_seq = max(self._dispatched_seq, seq)
+        self.stats["pushes"] += 1
+
+    # -------------------------------------------------------------- #
+    # flush                                                          #
+    # -------------------------------------------------------------- #
+
+    def flush(self) -> None:
+        """Drain the pipeline, compact, and sync everything back into the
+        attached :class:`World` (dense reference-style indices again)."""
+        self._drain(block=True)
+        n_keep = int(self._alive.sum())
+        if self._n_rows != n_keep or not self._alive[:n_keep].all():
+            perm = np.argsort(~self._alive, kind="stable")
+            self._state, self.kin.params = _compact_program(
+                self._state,
+                self.kin.params,
+                jnp.asarray(perm.astype(np.int32)),
+                jnp.asarray(n_keep, dtype=jnp.int32),
+            )
+            self._apply_perm(perm, n_keep)
+
+        w = self.world
+        w.n_cells = n_keep
+        w.cell_genomes = [self._genomes[i] for i in range(n_keep)]
+        w.cell_labels = [self._labels[i] for i in range(n_keep)]
+        w._np_positions = self._positions.copy()
+        w._np_lifetimes = self._lifetimes.copy()
+        w._np_divisions = self._divisions.copy()
+        cmap = np.zeros((w.map_size, w.map_size), dtype=bool)
+        live = self._positions[:n_keep]
+        cmap[live[:, 0], live[:, 1]] = True
+        w._np_cell_map = cmap
+        w._molecule_map = self._state.mm
+        w._cell_molecules = self._state.cm
+        w._positions_dev = self._state.pos
+        w._mm_cache = None
+        w._cm_cache = None
+
+    def check_consistency(self) -> None:
+        """Assert device and replayed-host state agree (test helper; costs
+        full fetches — do not call in hot loops)."""
+        occ = np.asarray(_fetch_host(self._state.occ))
+        pos = np.asarray(_fetch_host(self._state.pos))
+        alive_dev = np.asarray(_fetch_host(self._state.alive))
+        n_rows_dev = int(self._state.n_rows)
+        assert n_rows_dev == self._n_rows, (n_rows_dev, self._n_rows)
+        assert (alive_dev == self._alive).all()
+        live = np.nonzero(self._alive)[0]
+        assert (pos[live] == self._positions[live]).all()
+        want_occ = np.zeros_like(occ)
+        want_occ[self._positions[live, 0], self._positions[live, 1]] = True
+        assert (occ == want_occ).all()
+        assert len(np.unique(
+            self._positions[live, 0].astype(np.int64) * occ.shape[0]
+            + self._positions[live, 1]
+        )) == len(live)

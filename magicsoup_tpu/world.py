@@ -700,6 +700,12 @@ class World:
         """:meth:`get_neighbors` as a (k, 2) int64 array, smaller index
         first, sorted; ``cell_idxs=None`` means the whole population"""
         n = self.n_cells
+        if cell_idxs is None and nghbr_idxs is None:
+            # whole-population fast path: one shared implementation with
+            # the pipelined stepper's recombination replay
+            from magicsoup_tpu.util import moore_pairs
+
+            return moore_pairs(self._np_positions[:n], self.map_size)
         if cell_idxs is None:
             from_idxs = np.arange(n, dtype=np.int64)
         else:

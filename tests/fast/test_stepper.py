@@ -1,0 +1,255 @@
+"""
+Tests for the device-resident pipelined step driver
+(:mod:`magicsoup_tpu.stepper`): invariants over a full pipelined run,
+mass conservation, host-replay/device-state agreement, seed
+reproducibility at fixed lag, and forced mid-run compaction.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.util import moore_pairs
+
+_MOLS = [
+    ms.Molecule("stp-a", 10e3),
+    ms.Molecule("stp-atp", 8e3, half_life=100_000),
+    ms.Molecule("stp-c", 4e3, permeability=0.3),
+]
+_REACTIONS = [([_MOLS[0]], [_MOLS[1]]), ([_MOLS[1]], [_MOLS[2]])]
+
+
+def _chem():
+    return ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS)
+
+
+def _world(seed=7, map_size=32, n_cells=120, **kwargs):
+    rng = random.Random(seed)
+    world = ms.World(chemistry=_chem(), map_size=map_size, seed=seed, **kwargs)
+    world.spawn_cells(
+        [ms.random_genome(s=300, rng=rng) for _ in range(n_cells)]
+    )
+    return world
+
+
+def _run(stepper, n):
+    for _ in range(n):
+        stepper.step()
+    stepper.flush()
+
+
+def test_moore_pairs_matches_world_neighbors():
+    world = _world(seed=3, n_cells=60)
+    got = moore_pairs(world.cell_positions, world.map_size)
+    want = np.asarray(
+        world._neighbor_pairs(None), dtype=np.int64
+    ).reshape(-1, 2)
+    assert got.tolist() == want.tolist()
+
+
+def test_pipelined_run_invariants_and_flush_consistency():
+    world = _world(seed=7)
+    st = PipelinedStepper(
+        world,
+        mol_name="stp-atp",
+        kill_below=0.2,
+        divide_above=2.5,
+        divide_cost=1.0,
+        target_cells=120,
+        genome_size=300,
+        lag=2,
+        p_mutation=1e-4,
+        p_recombination=1e-5,
+    )
+    for i in range(25):
+        st.step()
+        if i % 10 == 9:
+            st._drain(block=True)
+            st.check_consistency()
+    st.flush()
+    st.check_consistency()
+
+    n = world.n_cells
+    assert n > 0
+    assert len(world.cell_genomes) == n == len(world.cell_labels)
+    mm = world._host_molecule_map()
+    assert np.isfinite(mm).all() and (mm >= 0).all()
+    cm = world.cell_molecules
+    assert np.isfinite(cm).all() and (cm >= 0).all()
+    # positions unique, on-map, and exactly the occupied pixels
+    pos = world.cell_positions
+    enc = pos[:, 0].astype(np.int64) * world.map_size + pos[:, 1]
+    assert len(np.unique(enc)) == n
+    assert world.cell_map.sum() == n
+    assert world.cell_map[pos[:, 0], pos[:, 1]].all()
+    # the classic loop can take over after a flush
+    world.enzymatic_activity()
+    world.kill_cells([0])
+    assert world.n_cells == n - 1
+
+
+def test_pipelined_mass_conservation():
+    # no degradation (long half-lives), mutations off: total molecule
+    # mass (map + live cells) is conserved through kill spills, divide
+    # halving, spawn pickup, diffusion and permeation
+    mols = [
+        ms.Molecule("stpc-a", 10e3, half_life=10**12),
+        ms.Molecule("stpc-b", 8e3, half_life=10**12, permeability=0.2),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(5)
+    world = ms.World(chemistry=chem, map_size=24, seed=5)
+    world.spawn_cells([ms.random_genome(s=250, rng=rng) for _ in range(80)])
+
+    def total(w):
+        mm = w._host_molecule_map().astype(np.float64).sum()
+        cm = np.asarray(w.cell_molecules, dtype=np.float64).sum()
+        return mm + cm
+
+    before = total(world)
+    st = PipelinedStepper(
+        world,
+        mol_name="stpc-b",
+        kill_below=0.05,
+        divide_above=2.0,
+        divide_cost=0.0,
+        target_cells=80,
+        genome_size=250,
+        lag=2,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+    _run(st, 15)
+    after = total(world)
+    # reactions conserve nothing; restrict to a transport-only check:
+    # with a 1:1 reaction the SUM over both species is conserved exactly
+    assert after == pytest.approx(before, rel=2e-4)
+    assert st.stats["steps"] == 15 and st.stats["replayed"] == 15
+
+
+def test_pipelined_fixed_lag_is_seed_reproducible():
+    def run():
+        world = _world(seed=11)
+        st = PipelinedStepper(
+            world,
+            mol_name="stp-atp",
+            kill_below=0.2,
+            divide_above=2.5,
+            divide_cost=1.0,
+            target_cells=120,
+            genome_size=300,
+            lag=3,
+            p_mutation=5e-4,
+            p_recombination=1e-5,
+        )
+        _run(st, 20)
+        return (
+            world.n_cells,
+            list(world.cell_genomes),
+            world._host_molecule_map().copy(),
+            np.asarray(world.cell_molecules).copy(),
+        )
+
+    n1, g1, mm1, cm1 = run()
+    n2, g2, mm2, cm2 = run()
+    assert n1 == n2
+    assert g1 == g2
+    assert mm1.tobytes() == mm2.tobytes()
+    assert cm1.tobytes() == cm2.tobytes()
+
+
+def test_pipelined_compaction_under_pressure():
+    # tiny capacity + aggressive division forces mid-run compactions and
+    # division-budget clamps; invariants and replay agreement must hold
+    world = _world(seed=13, map_size=24, n_cells=100)
+    assert world._capacity == 128
+    st = PipelinedStepper(
+        world,
+        mol_name="stp-atp",
+        kill_below=0.3,
+        divide_above=1.5,
+        divide_cost=0.2,
+        target_cells=100,
+        genome_size=300,
+        lag=2,
+        max_divisions=16,
+        spawn_block=16,
+        p_mutation=1e-4,
+        p_recombination=0.0,
+        auto_grow=False,
+    )
+    _run(st, 30)
+    st.check_consistency()
+    assert st.stats["compactions"] >= 1
+    assert world.n_cells <= 128
+    pos = world.cell_positions
+    enc = pos[:, 0].astype(np.int64) * world.map_size + pos[:, 1]
+    assert len(np.unique(enc)) == world.n_cells
+    mm = world._host_molecule_map()
+    assert np.isfinite(mm).all() and (mm >= 0).all()
+
+
+def test_pipelined_phenotypes_match_genomes_after_flush():
+    # children born from in-flight divisions copy the parent's params on
+    # device; if the parent's genome mutated in the replay window, the
+    # child needs its own parameter refresh (regression: without it the
+    # child kept the stale phenotype forever).  After a flush, every live
+    # row's params must equal a fresh re-translation of its genome.
+    world = _world(seed=17, map_size=24, n_cells=100)
+    st = PipelinedStepper(
+        world,
+        mol_name="stp-atp",
+        kill_below=0.2,
+        divide_above=1.8,
+        divide_cost=0.3,
+        target_cells=100,
+        genome_size=300,
+        lag=4,
+        p_mutation=3e-3,  # aggressive: most steps mutate many genomes
+        p_recombination=1e-4,
+    )
+    _run(st, 25)
+    assert st.stats["divisions"] > 0 and st.stats["pushes"] > 0
+
+    def snapshot():
+        p = world.kinetics.params
+        n = world.n_cells
+        out = {f: np.asarray(t)[:n].copy() for f, t in zip(p._fields, p)}
+        # canonicalize INERT protein rows: an empty slot row carries
+        # Ke/Kmr of 0 (capacity-growth zero-fill) or 1 (fresh assembly of
+        # token-0 rows) — behaviorally identical since Vmax=0 and N=A=0
+        inert = (
+            (out["Vmax"] == 0)
+            & (out["N"] == 0).all(axis=2)
+            & (out["A"] == 0).all(axis=2)
+            & (out["Nf"] == 0).all(axis=2)
+            & (out["Nb"] == 0).all(axis=2)
+        )
+        out["Ke"] = np.where(inert, 0.0, out["Ke"])
+        out["Kmf"] = np.where(inert, 0.0, out["Kmf"])
+        out["Kmb"] = np.where(inert, 0.0, out["Kmb"])
+        out["Kmr"] = np.where(inert[:, :, None], 0.0, out["Kmr"])
+        return out
+
+    got = snapshot()
+    world._update_cell_params(
+        genomes=world.cell_genomes, idxs=list(range(world.n_cells))
+    )
+    want = snapshot()
+    for f in got:
+        assert got[f].tobytes() == want[f].tobytes(), f
+
+
+def test_pipelined_rejects_mesh_world():
+    import jax
+
+    from magicsoup_tpu.parallel import tiled
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    mesh = tiled.make_mesh(2)
+    world = ms.World(chemistry=_chem(), map_size=32, seed=1, mesh=mesh)
+    with pytest.raises(ValueError, match="mesh"):
+        PipelinedStepper(world, mol_name="stp-atp")
