@@ -4,10 +4,13 @@ Value objects describing the simulated chemistry and interpreted cell state:
 (:class:`CatalyticDomain`, :class:`TransporterDomain`,
 :class:`RegulatoryDomain`), :class:`Protein` and :class:`Cell`.
 
-Parity reference: `python/magicsoup/containers.py` — the same registry
-semantics (process-global molecule interning, attribute-mismatch errors,
-pickle round-trip via ``__getnewargs__``), dict round-trips with the
-"C"/"T"/"R" type tags, and lazily computed :class:`Cell` views.
+Behavior parity with `python/magicsoup/containers.py` of the reference:
+molecule interning is process-global with attribute-mismatch errors and
+pickle support, domain/protein dict round-trips use the same ``"C"``/
+``"T"``/``"R"`` type tags and spec keys, and :class:`Cell` computes its
+expensive views lazily.  The implementation here is declarative — each
+view class states its spec fields once and shared helpers derive the
+dict round-trip and display strings from that single source.
 """
 import warnings
 from collections import Counter
@@ -19,34 +22,44 @@ if TYPE_CHECKING:
     from magicsoup_tpu.world import World
 
 
+def _kwargs_repr(obj, names: tuple) -> str:
+    """``Cls(a:1,b:'x')``-style repr from attribute names."""
+    body = ",".join(f"{n}:{getattr(obj, n)!r}" for n in names)
+    return f"{type(obj).__name__}({body})"
+
+
+def _species_sum(mols: list["Molecule"]) -> str:
+    """``"2 A + 1 B"``-style species tally (stoichiometry by repetition)."""
+    tally = Counter(str(m) for m in mols)
+    return " + ".join(f"{count} {name}" for name, count in tally.items())
+
+
 class Molecule:
     """
-    A molecule species of the simulated world.
+    One molecule species of the simulated world.
 
     Parameters:
         name: Unique identifier of this molecule species.
-        energy: Energy for 1 mol of this molecule species (in J).
-        half_life: Half life in time steps (see ``World.degrade_molecules``).
-        diffusivity: How fast the species diffuses over the molecule map per
-            step; the ratio a/b of molecules moving to each of the 8 Moore
-            neighbors (a) vs. staying on the pixel (b).  1.0 spreads the pixel
-            evenly over its 3x3 neighborhood in one step.
-        permeability: How fast the species permeates cell membranes per step;
-            the ratio of molecules permeating into the cell vs. staying
-            outside.  1.0 equilibrates cell and pixel in one step.
+        energy: Energy content of 1 mol (J); drives reaction equilibria.
+        half_life: Decay half life in time steps
+            (see ``World.degrade_molecules``).
+        diffusivity: Per-step spread rate over the molecule map — the
+            ratio of molecules moving to each of the 8 Moore neighbors
+            vs. staying put; 1.0 flattens a pixel over its 3x3
+            neighborhood in a single step.
+        permeability: Per-step membrane crossing rate — the ratio of
+            molecules entering a cell vs. staying outside; 1.0
+            equilibrates cell and pixel in a single step.
 
-    Molecules are interned process-wide by name: constructing a second
-    instance with the same name returns the first instance, and mismatching
-    attributes raise a ``ValueError``
-    (reference: `containers.py:91-132`).  Use
-    :meth:`Molecule.from_name` to look up an existing species.
-
-    Default units: mM for concentrations, s per time step, J/mol for energy.
+    Species are interned process-wide by name (reference semantics,
+    `containers.py:91-132`): re-constructing a name yields the original
+    instance, and conflicting attribute values raise ``ValueError``.
+    :meth:`from_name` looks up an existing species.  Conventional units:
+    mM, seconds, Joules.
     """
 
-    _instances: dict[str, "Molecule"] = {}
-
-    _attrs = ("energy", "half_life", "diffusivity", "permeability")
+    _registry: dict[str, "Molecule"] = {}
+    _fields = ("name", "energy", "half_life", "diffusivity", "permeability")
 
     def __new__(
         cls,
@@ -56,49 +69,42 @@ class Molecule:
         diffusivity: float = 0.1,
         permeability: float = 0.0,
     ):
-        if name in cls._instances:
-            prev = cls._instances[name]
-            new_vals = {
-                "energy": energy,
-                "half_life": half_life,
-                "diffusivity": diffusivity,
-                "permeability": permeability,
-            }
-            for key, val in new_vals.items():
-                old = getattr(prev, key)
-                if old != val:
-                    raise ValueError(
-                        f"Trying to instantiate Molecule {name} with {key} {val}."
-                        f" But {name} already exists with {key} {old}"
-                    )
-        else:
-            lowered = name.lower()
-            similar = [k for k in cls._instances if k.lower() == lowered]
-            if similar:
+        interned = cls._registry.get(name)
+        if interned is None:
+            twins = [
+                k for k in cls._registry if k.lower() == name.lower()
+            ]
+            if twins:
                 warnings.warn(
-                    f"Creating new molecule {name}. There are molecules with"
-                    f" similar names: {', '.join(similar)}. Give them identical"
-                    " names if these are the same molecules."
+                    f"Creating new molecule {name}. There are molecules"
+                    f" with similar names: {', '.join(twins)}. Give them"
+                    " identical names if these are the same molecules."
                 )
-            cls._instances[name] = super().__new__(cls)
-        return cls._instances[name]
-
-    @classmethod
-    def from_name(cls, name: str) -> "Molecule":
-        """Get Molecule instance from its name (if already defined)"""
-        if name not in cls._instances:
-            raise ValueError(f"Molecule {name} was not defined yet")
-        return cls._instances[name]
-
-    def __getnewargs__(self):
-        # so pickle can restore interned instances
-        return (
-            self.name,
-            self.energy,
-            self.half_life,
-            self.diffusivity,
-            self.permeability,
+            interned = super().__new__(cls)
+            cls._registry[name] = interned
+            return interned
+        # the mismatch check must live HERE, not in __init__: unpickling
+        # calls __new__ with __getnewargs__ but never __init__, and a
+        # conflicting payload must raise rather than silently desync the
+        # process-global instance
+        interned._verify(
+            name=name,
+            energy=float(energy),
+            half_life=half_life,
+            diffusivity=diffusivity,
+            permeability=permeability,
         )
+        return interned
+
+    def _verify(self, **incoming) -> None:
+        for field, val in incoming.items():
+            have = getattr(self, field)
+            if have != val:
+                raise ValueError(
+                    f"Trying to instantiate Molecule {incoming['name']}"
+                    f" with {field} {val}. But {incoming['name']} already"
+                    f" exists with {field} {have}"
+                )
 
     def __init__(
         self,
@@ -108,32 +114,42 @@ class Molecule:
         diffusivity: float = 0.1,
         permeability: float = 0.0,
     ):
+        if getattr(self, "_sealed", False):
+            # interned instance: __new__ already verified the attributes
+            return
+        # float() matters: an int energy would break the kinetics energy
+        # tensor dtype
         self.name = name
-        self.energy = float(energy)  # int would break kinetics energy tensor
+        self.energy = float(energy)
         self.half_life = half_life
         self.diffusivity = diffusivity
         self.permeability = permeability
-        self._hash = hash(self.name)
+        self._hash = hash(name)
+        self._sealed = True
+
+    @classmethod
+    def from_name(cls, name: str) -> "Molecule":
+        """Look up an already-defined species by name."""
+        try:
+            return cls._registry[name]
+        except KeyError:
+            raise ValueError(f"Molecule {name} was not defined yet") from None
+
+    def __getnewargs__(self):
+        # pickle resolves back through __new__, preserving interning
+        return tuple(getattr(self, f) for f in self._fields)
 
     def __hash__(self) -> int:
         return self._hash
 
-    def __lt__(self, other: "Molecule") -> bool:
-        return self.name < other.name
-
     def __eq__(self, other) -> bool:
         return hash(self) == hash(other)
 
+    def __lt__(self, other: "Molecule") -> bool:
+        return self.name < other.name
+
     def __repr__(self) -> str:
-        kwargs = {
-            "name": self.name,
-            "energy": self.energy,
-            "half_life": self.half_life,
-            "diffusivity": self.diffusivity,
-            "permeability": self.permeability,
-        }
-        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
-        return f"{type(self).__name__}({','.join(args)})"
+        return _kwargs_repr(self, self._fields)
 
     def __str__(self) -> str:
         return self.name
@@ -141,21 +157,19 @@ class Molecule:
 
 class Chemistry:
     """
-    The molecules and reactions available in a simulation.
+    The closed set of molecules and reactions available in a simulation.
 
     Parameters:
         molecules: All :class:`Molecule` species of this simulation.
-        reactions: Possible reactions as tuples ``(substrates, products)``,
-            both lists of :class:`Molecule`.  Every reaction can run in both
-            directions.  Stoichiometric coefficients > 1 are expressed by
-            listing a molecule multiple times.
+        reactions: ``(substrates, products)`` tuples of molecule lists.
+            Reactions are reversible; express a stoichiometric
+            coefficient above 1 by repeating the molecule.
 
-    Duplicate molecules and reactions are removed while preserving order;
-    reactions referencing undefined molecules raise
-    (reference: `containers.py:226-252`).  ``chemistry.mol_2_idx`` /
-    ``chemistry.molname_2_idx`` map molecules / names to their index — the
-    ordering used by every tensor in :class:`World`.  Two chemistries can be
-    combined with ``&``.
+    Duplicates (molecules and reactions, the latter compared as unordered
+    species tallies) are dropped with order preserved, and a reaction
+    naming an unlisted molecule raises.  ``mol_2_idx`` / ``molname_2_idx``
+    give each species its tensor column — the ordering every
+    :class:`World` array uses.  ``a & b`` merges two chemistries.
     """
 
     def __init__(
@@ -163,26 +177,26 @@ class Chemistry:
         molecules: list[Molecule],
         reactions: list[tuple[list[Molecule], list[Molecule]]],
     ):
-        self.molecules = list(dict.fromkeys(molecules))
-        keyed = [(tuple(sorted(s)), tuple(sorted(p))) for s, p in reactions]
-        unique = list(dict.fromkeys(keyed))
-        self.reactions = [(list(s), list(p)) for s, p in unique]
-
         defined = set(molecules)
-        used: set[Molecule] = set()
-        for substrates, products in reactions:
-            used.update(substrates)
-            used.update(products)
-        if used > defined:
-            missing = ", ".join(str(d) for d in used - defined)
+        undefined = {
+            mol
+            for subs, prods in reactions
+            for mol in [*subs, *prods]
+            if mol not in defined
+        }
+        if undefined:
             raise ValueError(
                 "These molecules were not defined but are part of some"
-                f" reactions: {missing}."
+                f" reactions: {', '.join(sorted(str(m) for m in undefined))}."
                 "Please define all molecules."
             )
-
-        self.mol_2_idx = {d: i for i, d in enumerate(self.molecules)}
-        self.molname_2_idx = {d.name: i for i, d in enumerate(self.molecules)}
+        self.molecules = list(dict.fromkeys(molecules))
+        seen = dict.fromkeys(
+            (tuple(sorted(s)), tuple(sorted(p))) for s, p in reactions
+        )
+        self.reactions = [(list(s), list(p)) for s, p in seen]
+        self.mol_2_idx = {m: i for i, m in enumerate(self.molecules)}
+        self.molname_2_idx = {m.name: i for i, m in enumerate(self.molecules)}
 
     def __and__(self, other: "Chemistry") -> "Chemistry":
         return Chemistry(
@@ -191,9 +205,7 @@ class Chemistry:
         )
 
     def __repr__(self) -> str:
-        kwargs = {"molecules": self.molecules, "reactions": self.reactions}
-        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
-        return f"{type(self).__name__}({','.join(args)})"
+        return _kwargs_repr(self, ("molecules", "reactions"))
 
 
 class DomainType(Protocol):
@@ -210,19 +222,73 @@ class DomainType(Protocol):
         ...
 
 
-class CatalyticDomain:
+class _DomainView:
     """
-    Human-readable view of a translated catalytic domain.
+    Shared machinery of the three domain views.  A subclass declares its
+    one-letter ``_tag`` and ``_spec`` — the ordered spec-dict fields,
+    each marked ``True`` when it holds molecule(s) (serialized by name).
+    ``to_dict``/``from_dict`` and ``__repr__`` are derived from that
+    declaration, so the serialized schema lives in exactly one place.
+    """
+
+    _tag = "?"
+    _spec: tuple[tuple[str, bool], ...] = ()
+
+    def _encode(self, value, is_mol: bool):
+        if not is_mol:
+            return value
+        if isinstance(value, Molecule):
+            return value.name
+        # nested containers (e.g. a reaction's (substrates, products)
+        # pair) keep their shape, molecules become names
+        return type(value)(self._encode(v, True) for v in value)
+
+    @classmethod
+    def _decode(cls, value, is_mol: bool):
+        if not is_mol:
+            return value
+        if isinstance(value, str):
+            return Molecule.from_name(name=value)
+        return type(value)(cls._decode(v, True) for v in value)
+
+    def to_dict(self) -> dict:
+        """Serialize as ``{"type": tag, "spec": {...}}``."""
+        spec = {
+            field: self._encode(getattr(self, field), is_mol)
+            for field, is_mol in self._spec
+        }
+        spec["start"] = self.start  # type: ignore[attr-defined]
+        spec["end"] = self.end  # type: ignore[attr-defined]
+        return {"type": self._tag, "spec": spec}
+
+    @classmethod
+    def from_dict(cls, dct: dict):
+        """Rebuild from a spec dict; molecules are resolved by name."""
+        kwargs = {
+            field: cls._decode(dct[field], is_mol)
+            for field, is_mol in cls._spec
+        }
+        return cls(start=dct["start"], end=dct["end"], **kwargs)
+
+
+class CatalyticDomain(_DomainView):
+    """
+    Interpreted view of a catalytic domain: it couples the protein to one
+    reaction of the chemistry.
 
     Parameters:
-        reaction: ``(substrates, products)`` of :class:`Molecule` lists.
-        km: Michaelis-Menten constant of the reaction (mM).
-        vmax: Maximum velocity of the reaction (mmol/s).
-        start: Domain start on the CDS (0-based, included).
-        end: Domain end on the CDS (excluded).
+        reaction: ``(substrates, products)`` molecule lists.
+        km: Michaelis constant of the reaction (mM).
+        vmax: Maximal catalytic rate (mmol/s).
+        start: First position of the domain on its CDS (0-based).
+        end: Position one past the domain's last nucleotide.
 
-    Not meant to be instantiated by users — obtained from ``cell.proteome``.
+    Produced by proteome interpretation (``cell.proteome``), not meant to
+    be built by hand.
     """
+
+    _tag = "C"
+    _spec = (("reaction", True), ("km", False), ("vmax", False))
 
     def __init__(
         self,
@@ -232,67 +298,50 @@ class CatalyticDomain:
         start: int,
         end: int,
     ):
-        self.start = start
-        self.end = end
         self.substrates, self.products = reaction
         self.km = km
         self.vmax = vmax
+        self.start = start
+        self.end = end
 
-    def to_dict(self) -> dict:
-        """Get dict representation of domain"""
-        spec = {
-            "reaction": (
-                [d.name for d in self.substrates],
-                [d.name for d in self.products],
-            ),
-            "km": self.km,
-            "vmax": self.vmax,
-            "start": self.start,
-            "end": self.end,
-        }
-        return {"type": "C", "spec": spec}
-
-    @classmethod
-    def from_dict(cls, dct: dict) -> "CatalyticDomain":
-        """Create instance from dict; molecules are given by name"""
-        lft, rgt = dct["reaction"]
-        return cls(
-            reaction=(
-                [Molecule.from_name(name=d) for d in lft],
-                [Molecule.from_name(name=d) for d in rgt],
-            ),
-            km=dct["km"],
-            vmax=dct["vmax"],
-            start=dct["start"],
-            end=dct["end"],
-        )
+    @property
+    def reaction(self) -> tuple[list[Molecule], list[Molecule]]:
+        return (self.substrates, self.products)
 
     def __repr__(self) -> str:
-        ins = ",".join(str(d) for d in self.substrates)
-        outs = ",".join(str(d) for d in self.products)
-        return f"CatalyticDomain({ins}<->{outs},Km={self.km:.2e},Vmax={self.vmax:.2e})"
+        lhs = ",".join(str(m) for m in self.substrates)
+        rhs = ",".join(str(m) for m in self.products)
+        return (
+            f"CatalyticDomain({lhs}<->{rhs},Km={self.km:.2e},"
+            f"Vmax={self.vmax:.2e})"
+        )
 
     def __str__(self) -> str:
-        subs_cnts = Counter(str(d) for d in self.substrates)
-        prods_cnts = Counter(str(d) for d in self.products)
-        subs_str = " + ".join(f"{d} {k}" for k, d in subs_cnts.items())
-        prods_str = " + ".join(f"{d} {k}" for k, d in prods_cnts.items())
-        return f"{subs_str} <-> {prods_str} | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+        return (
+            f"{_species_sum(self.substrates)} <-> "
+            f"{_species_sum(self.products)}"
+            f" | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+        )
 
 
-class TransporterDomain:
+class TransporterDomain(_DomainView):
     """
-    Human-readable view of a translated transporter domain.
+    Interpreted view of a transporter domain: it moves one species across
+    the cell membrane.
 
     Parameters:
-        molecule: The transported :class:`Molecule`.
-        km: Michaelis-Menten constant of the transport (mM).
-        vmax: Maximum velocity of the transport (mmol/s).
-        is_exporter: Direction in which this domain couples energetically
-            with other domains of the same protein.
-        start: Domain start on the CDS.
-        end: Domain end on the CDS.
+        molecule: The transported species.
+        km: Michaelis constant of the transport (mM).
+        vmax: Maximal transport rate (mmol/s).
+        is_exporter: Orientation of the domain's energetic coupling with
+            its protein siblings.
+        start: First position of the domain on its CDS.
+        end: Position one past the domain's last nucleotide.
     """
+
+    _tag = "T"
+    _spec = (("molecule", True), ("km", False), ("vmax", False),
+             ("is_exporter", False))
 
     def __init__(
         self,
@@ -303,63 +352,49 @@ class TransporterDomain:
         start: int,
         end: int,
     ):
-        self.start = start
-        self.end = end
         self.molecule = molecule
         self.km = km
         self.vmax = vmax
         self.is_exporter = is_exporter
+        self.start = start
+        self.end = end
 
-    def to_dict(self) -> dict:
-        """Get dict representation of domain"""
-        spec = {
-            "molecule": self.molecule.name,
-            "km": self.km,
-            "vmax": self.vmax,
-            "is_exporter": self.is_exporter,
-            "start": self.start,
-            "end": self.end,
-        }
-        return {"type": "T", "spec": spec}
-
-    @classmethod
-    def from_dict(cls, dct: dict) -> "TransporterDomain":
-        """Create instance from dict; molecules are given by name"""
-        return cls(
-            molecule=Molecule.from_name(name=dct["molecule"]),
-            km=dct["km"],
-            vmax=dct["vmax"],
-            is_exporter=dct["is_exporter"],
-            start=dct["start"],
-            end=dct["end"],
-        )
+    def _direction(self) -> str:
+        return "exporter" if self.is_exporter else "importer"
 
     def __repr__(self) -> str:
-        sign = "exporter" if self.is_exporter else "importer"
         return (
             f"TransporterDomain({self.molecule},Km={self.km:.2e},"
-            f"Vmax={self.vmax:.2e},{sign})"
+            f"Vmax={self.vmax:.2e},{self._direction()})"
         )
 
     def __str__(self) -> str:
-        sign = "exporter" if self.is_exporter else "importer"
-        return f"{self.molecule} {sign} | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+        return (
+            f"{self.molecule} {self._direction()}"
+            f" | Km {self.km:.2e} Vmax {self.vmax:.2e}"
+        )
 
 
-class RegulatoryDomain:
+class RegulatoryDomain(_DomainView):
     """
-    Human-readable view of a translated regulatory domain.
+    Interpreted view of a regulatory domain: it modulates its protein's
+    activity in response to an effector species.
 
     Parameters:
-        effector: Effector :class:`Molecule`.
-        hill: Hill coefficient (degree of cooperativity).
-        km: Ligand concentration producing half occupation (mM).
-        is_inhibiting: Whether the domain inhibits (otherwise activates).
-        is_transmembrane: If true the domain reacts to extracellular
-            molecules instead of intracellular ones.
-        start: Domain start on the CDS.
-        end: Domain end on the CDS.
+        effector: The species sensed by this domain.
+        hill: Hill coefficient (cooperativity of binding).
+        km: Effector concentration at half occupation (mM).
+        is_inhibiting: Whether occupation slows the protein down
+            (otherwise it is required for activity).
+        is_transmembrane: Sense the pixel's concentrations instead of
+            the cell's internal ones.
+        start: First position of the domain on its CDS.
+        end: Position one past the domain's last nucleotide.
     """
+
+    _tag = "R"
+    _spec = (("effector", True), ("km", False), ("hill", False),
+             ("is_inhibiting", False), ("is_transmembrane", False))
 
     def __init__(
         self,
@@ -371,66 +406,53 @@ class RegulatoryDomain:
         start: int,
         end: int,
     ):
+        self.effector = effector
+        self.hill = int(hill)
+        self.km = km
+        self.is_inhibiting = is_inhibiting
+        self.is_transmembrane = is_transmembrane
         self.start = start
         self.end = end
-        self.effector = effector
-        self.km = km
-        self.hill = int(hill)
-        self.is_transmembrane = is_transmembrane
-        self.is_inhibiting = is_inhibiting
-
-    def to_dict(self) -> dict:
-        """Get dict representation of domain"""
-        spec = {
-            "effector": self.effector.name,
-            "km": self.km,
-            "hill": self.hill,
-            "is_inhibiting": self.is_inhibiting,
-            "is_transmembrane": self.is_transmembrane,
-            "start": self.start,
-            "end": self.end,
-        }
-        return {"type": "R", "spec": spec}
-
-    @classmethod
-    def from_dict(cls, dct: dict) -> "RegulatoryDomain":
-        """Create instance from dict; molecules are given by name"""
-        return cls(
-            effector=Molecule.from_name(name=dct["effector"]),
-            km=dct["km"],
-            hill=dct["hill"],
-            is_inhibiting=dct["is_inhibiting"],
-            is_transmembrane=dct["is_transmembrane"],
-            start=dct["start"],
-            end=dct["end"],
-        )
 
     def __repr__(self) -> str:
-        loc = "transmembrane" if self.is_transmembrane else "cytosolic"
-        eff = "inhibiting" if self.is_inhibiting else "activating"
-        return f"ReceptorDomain({self.effector},Km={self.km:.2e},hill={self.hill},{loc},{eff})"
+        where = "transmembrane" if self.is_transmembrane else "cytosolic"
+        how = "inhibiting" if self.is_inhibiting else "activating"
+        return (
+            f"ReceptorDomain({self.effector},Km={self.km:.2e},"
+            f"hill={self.hill},{where},{how})"
+        )
 
     def __str__(self) -> str:
-        loc = "[e]" if self.is_transmembrane else "[i]"
-        post = "inhibitor" if self.is_inhibiting else "activator"
-        return f"{self.effector}{loc} {post} | Km {self.km:.2e} Hill {self.hill}"
+        where = "[e]" if self.is_transmembrane else "[i]"
+        how = "inhibitor" if self.is_inhibiting else "activator"
+        return (
+            f"{self.effector}{where} {how}"
+            f" | Km {self.km:.2e} Hill {self.hill}"
+        )
+
+
+_DOMAIN_TAGS: dict[str, type] = {
+    c._tag: c
+    for c in (CatalyticDomain, TransporterDomain, RegulatoryDomain)
+}
 
 
 class Protein:
     """
-    Human-readable view of a translated protein.
+    Interpreted view of one translated protein.
 
     Parameters:
-        domains: Domain views of this protein.
-        cds_start: Start coordinate of its coding region.
-        cds_end: End coordinate of its coding region.
-        is_fwd: Whether the CDS lies on the forward or reverse-complement
-            strand; coordinates always follow the parsing direction, so a
-            reverse CDS maps back to 5'-3' coordinates as ``n - cds_start``.
+        domains: The protein's interpreted domain views.
+        cds_start: Start of its coding region.
+        cds_end: End of its coding region.
+        is_fwd: Strand of the CDS.  Coordinates follow the parsing
+            direction, so a reverse-complement CDS maps back to 5'-3'
+            coordinates as ``n - cds_start``.
     """
 
     def __init__(
-        self, domains: list[DomainType], cds_start: int, cds_end: int, is_fwd: bool
+        self, domains: list[DomainType], cds_start: int, cds_end: int,
+        is_fwd: bool,
     ):
         self.domains = domains
         self.n_domains = len(domains)
@@ -439,7 +461,7 @@ class Protein:
         self.is_fwd = is_fwd
 
     def to_dict(self) -> dict:
-        """Get dict representation of protein"""
+        """Serialize, domains as their tagged dicts."""
         return {
             "domains": [d.to_dict() for d in self.domains],
             "cds_start": self.cds_start,
@@ -449,62 +471,43 @@ class Protein:
 
     @classmethod
     def from_dict(cls, dct: dict) -> "Protein":
-        """
-        Create Protein instance from dict.  Domains are a list of dicts
-        ``{"type": t, "spec": {...}}`` with ``t`` one of ``"C"`` (catalytic),
-        ``"T"`` (transporter), ``"R"`` (regulatory).
-        """
-        type_map = {
-            "C": CatalyticDomain,
-            "T": TransporterDomain,
-            "R": RegulatoryDomain,
-        }
-        doms: list[DomainType] = []
-        for dom in dct["domains"]:
-            dom_cls = type_map.get(dom["type"])
-            if dom_cls is not None:
-                doms.append(dom_cls.from_dict(dom["spec"]))
-        return Protein(
+        """Rebuild from :meth:`to_dict` output; unknown domain type tags
+        are skipped."""
+        return cls(
+            domains=[
+                _DOMAIN_TAGS[d["type"]].from_dict(d["spec"])
+                for d in dct["domains"]
+                if d["type"] in _DOMAIN_TAGS
+            ],
             cds_start=dct["cds_start"],
             cds_end=dct["cds_end"],
             is_fwd=dct["is_fwd"],
-            domains=doms,
         )
 
     def __repr__(self) -> str:
-        kwargs = {
-            "cds_start": self.cds_start,
-            "cds_end": self.cds_end,
-            "domains": self.domains,
-        }
-        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
-        return f"{type(self).__name__}({','.join(args)})"
+        return _kwargs_repr(self, ("cds_start", "cds_end", "domains"))
 
     def __str__(self) -> str:
-        domstrs = [str(d).split(" | ")[0] for d in self.domains]
-        return " | ".join(domstrs)
+        return " | ".join(str(d).split(" | ")[0] for d in self.domains)
 
 
 class Cell:
     """
-    Lazily-evaluated view of one cell and its environment.
+    Lazily-evaluated view of one cell and its surroundings, obtained from
+    ``World.get_cell()``.
 
     Parameters:
         world: Originating :class:`World`.
-        genome: Genome string of this cell.
-        position: Position ``(x, y)`` on the cell map.
-        idx: Current cell index.
-        label: Label of origin, used to track cells.
-        n_steps_alive: Steps this cell lived since its last division.
-        n_divisions: Number of times this cell's ancestors divided.
-        proteome: List of :class:`Protein` (computed lazily).
-        int_molecules: Intracellular concentrations (row of
-            ``world.cell_molecules``; computed lazily).
-        ext_molecules: Extracellular concentrations (pixel of
-            ``world.molecule_map``; computed lazily).
-
-    Obtained from ``World.get_cell()``; the proteome is re-translated from
-    the genome on first access (reference: `containers.py:697-705`).
+        genome: The cell's genome string.
+        position: ``(x, y)`` pixel on the map.
+        idx: The cell's current index.
+        label: Free-form origin marker for tracking lineages.
+        n_steps_alive: Steps since spawn or the last division.
+        n_divisions: Divisions in this cell's ancestry.
+        proteome / int_molecules / ext_molecules: Optionally pre-filled;
+            otherwise computed on first access (the proteome by
+            re-translating the genome, the molecule views from the
+            world's cached host snapshots).
     """
 
     def __init__(
@@ -522,9 +525,9 @@ class Cell:
     ):
         self.world = world
         self.genome = genome
-        self.label = label
         self.position = position
         self.idx = idx
+        self.label = label
         self.n_steps_alive = n_steps_alive
         self.n_divisions = n_divisions
         self._proteome = proteome
@@ -533,14 +536,16 @@ class Cell:
 
     @property
     def int_molecules(self) -> np.ndarray:
+        """This cell's intracellular concentrations (one row of
+        ``world.cell_molecules``, served from the cached host snapshot —
+        a per-cell device fetch would transfer the whole buffer)."""
         if self._int_molecules is None:
-            # the world's cached host snapshot: per-cell device fetches
-            # would transfer the full buffer for every cell
-            self._int_molecules = self.world._host_cell_molecules()[self.idx, :]
+            self._int_molecules = self.world._host_cell_molecules()[self.idx]
         return self._int_molecules
 
     @property
     def ext_molecules(self) -> np.ndarray:
+        """The concentrations on this cell's map pixel."""
         if self._ext_molecules is None:
             x, y = self.position
             self._ext_molecules = self.world._host_molecule_map()[:, x, y]
@@ -548,22 +553,22 @@ class Cell:
 
     @property
     def proteome(self) -> list[Protein]:
+        """Interpreted proteome, re-translated from the genome on first
+        access (reference containers.py:697-705)."""
         if self._proteome is None:
-            (cdss,) = self.world.genetics.translate_genomes(genomes=[self.genome])
-            if len(cdss) > 0:
-                self._proteome = self.world.kinetics.get_proteome(proteome=cdss)
-            else:
-                self._proteome = []
+            (cdss,) = self.world.genetics.translate_genomes(
+                genomes=[self.genome]
+            )
+            self._proteome = (
+                self.world.kinetics.get_proteome(proteome=cdss)
+                if cdss
+                else []
+            )
         return self._proteome
 
     def __repr__(self) -> str:
-        kwargs = {
-            "genome": self.genome,
-            "position": self.position,
-            "idx": self.idx,
-            "label": self.label,
-            "n_steps_alive": self.n_steps_alive,
-            "n_divisions": self.n_divisions,
-        }
-        args = [f"{k}:{repr(d)}" for k, d in kwargs.items()]
-        return f"{type(self).__name__}({','.join(args)})"
+        return _kwargs_repr(
+            self,
+            ("genome", "position", "idx", "label", "n_steps_alive",
+             "n_divisions"),
+        )

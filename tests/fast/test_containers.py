@@ -46,6 +46,18 @@ def test_molecule_pickle_preserves_interning():
     assert x2 is x
 
 
+def test_molecule_pickle_mismatch_raises():
+    # unpickling goes through __new__ but never __init__; a payload that
+    # conflicts with the live registry must raise, not silently mutate
+    # the shared interned instance (regression)
+    x = ms.Molecule("mol-pickle-clash", 5.0)
+    # unpickling executes cls.__new__(cls, *__getnewargs__()) without
+    # __init__ — drive that exact call with a conflicting payload
+    with pytest.raises(ValueError, match="already exists"):
+        ms.Molecule.__new__(ms.Molecule, "mol-pickle-clash", 9.0)
+    assert x.energy == 5.0  # registry untouched
+
+
 def test_molecule_ordering_and_equality():
     a = ms.Molecule("mol-ord-a", 1.0)
     b = ms.Molecule("mol-ord-b", 2.0)

@@ -16,7 +16,12 @@ from magicsoup_tpu.native._pyengine import TranslationTables
 from magicsoup_tpu.util import random_genome, reverse_complement
 
 # (genome, [(cds_start, cds_stop)]) with default start/stop codons,
-# min_cds_size=18; hand-annotated incl. nested/overlapping CDSs
+# min_cds_size=18; hand-annotated incl. nested/overlapping CDSs.
+# PROVENANCE: these golden genomes and their expected coordinates are
+# copied verbatim from the reference's parity oracle
+# (mRcSchwering/magic-soup tests/fast/test_genetics.py:11-59) — the
+# annotations (especially the nested-CDS cases) are the spec, and
+# re-inventing them would lose exactly the edge cases they pin.
 _CDS_CASES: list[tuple[str, list[tuple[int, int]]]] = [
     (
         """
