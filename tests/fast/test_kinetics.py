@@ -185,6 +185,191 @@ def test_regulatory_only_protein_is_inert():
     np.testing.assert_allclose(np.asarray(X1), np.asarray(X), rtol=1e-6)
 
 
+def test_cell_params_multi_transporter_aggregation():
+    # counterpart of reference test_cell_params_with_transporter_domains
+    # (:122): several transporters on one protein aggregate Vmax/Km by
+    # domain mean and stack their stoichiometries per signal
+    kin = _make_kinetics()
+    kin.set_cell_params(
+        cell_idxs=[0],
+        proteomes=[[
+            _prot(
+                _dom(2, 1, 1, 1, 1),  # T(a, fwd), Vmax 1, Km 1
+                _dom(2, 2, 2, 1, 1),  # T(a, fwd), Vmax 2, Km 2
+                _dom(2, 3, 3, 1, 2),  # T(b, fwd), Vmax 3, Km 4
+                _dom(2, 4, 4, 1, 3),  # T(c, fwd), Vmax 4, Km 8
+            )
+        ]],
+    )
+    p = kin.params
+    assert float(p.Vmax[0, 0]) == pytest.approx((1 + 2 + 3 + 4) / 4)
+    #                 a   b   c   d  a' b' c' d'   (' = extracellular)
+    want_n = np.array([-2, -1, -1, 0, 2, 1, 1, 0])
+    assert np.array_equal(np.asarray(p.N[0, 0]), want_n)
+    # transport is energy-neutral regardless of domain count
+    assert float(p.Ke[0, 0]) == pytest.approx(1.0, rel=_TOL)
+    km = (1 + 2 + 4 + 8) / 4
+    assert float(p.Kmf[0, 0]) == pytest.approx(km, rel=_TOL)
+    assert float(p.Kmb[0, 0]) == pytest.approx(km, rel=_TOL)
+
+
+def test_cell_params_importer_exporter_futile_cycle():
+    # an importer and an exporter of the same species cancel to net-zero
+    # N but must SURVIVE in Nf/Nb (the cofactor-preserving split,
+    # reference kinetics.py:595-604) — the cycle still needs the species
+    # present on both sides to run
+    kin = _make_kinetics()
+    kin.set_cell_params(
+        cell_idxs=[0],
+        proteomes=[[
+            _prot(
+                _dom(2, 1, 1, 1, 1),  # T(a, fwd)
+                _dom(2, 1, 1, 2, 1),  # T(a, bwd) — sign token 2 = -1
+            )
+        ]],
+    )
+    p = kin.params
+    assert np.all(np.asarray(p.N[0, 0]) == 0)
+    assert np.array_equal(np.asarray(p.Nf[0, 0]), [1, 0, 0, 0, 1, 0, 0, 0])
+    assert np.array_equal(np.asarray(p.Nb[0, 0]), [1, 0, 0, 0, 1, 0, 0, 0])
+
+
+def test_cell_params_multi_regulatory_aggregation():
+    # counterpart of reference test_cell_params_with_regulatory_domains
+    # (:361): allosteric exponents sum sign*hill per signal, regulatory
+    # Kms average per effector signal and pre-exponentiate by A
+    kin = _make_kinetics()
+    kin.set_cell_params(
+        cell_idxs=[0],
+        proteomes=[[
+            _prot(
+                _dom(1, 1, 2, 1, 1),  # catalytic a <-> b
+                _dom(3, 5, 1, 1, 2),  # reg: +5 on signal 1 (b), Km 1
+                _dom(3, 1, 3, 2, 2),  # reg: -1 on signal 1 (b), Km 4
+                _dom(3, 2, 2, 2, 6),  # reg: -2 on signal 5 (b ext), Km 2
+            )
+        ]],
+    )
+    p = kin.params
+    a = np.asarray(p.A[0, 0])
+    assert np.array_equal(a, [0, 4, 0, 0, 0, -2, 0, 0])
+    # Kmr = mean(Kms of signal-1 domains) ** A = 2.5^4; 2^-2 on signal 5
+    assert float(p.Kmr[0, 0, 1]) == pytest.approx(2.5**4, rel=_TOL)
+    assert float(p.Kmr[0, 0, 5]) == pytest.approx(2.0**-2, rel=_TOL)
+    # regulation leaves the catalytic numbers untouched
+    assert float(p.Vmax[0, 0]) == pytest.approx(1.0)
+    assert float(p.Kmf[0, 0]) == pytest.approx(2.0, rel=_TOL)
+
+
+def test_kmf_kmb_split_at_extreme_ke():
+    # counterpart of the reference's extreme-Ke coverage: stacking many
+    # same-direction catalytic domains drives |dG| past the clamps; the
+    # sampled Km must stay on the SMALLER side and the other side clip
+    kin = _make_kinetics()
+    n_dom = 107  # E = -2000 * 107 -> exp overflows the 1e36 clamp
+    kin.set_cell_params(
+        cell_idxs=[0, 1],
+        proteomes=[
+            [_prot(*[_dom(1, 1, 2, 1, 1)] * n_dom)],  # fwd: Ke -> MAX
+            [_prot(*[_dom(1, 1, 2, 2, 1)] * n_dom)],  # bwd: Ke -> EPS
+        ],
+    )
+    p = kin.params
+    f32 = np.float32
+    assert f32(p.Ke[0, 0]) == f32(MAX)
+    assert float(p.Kmf[0, 0]) == pytest.approx(2.0, rel=_TOL)
+    assert f32(p.Kmb[0, 0]) == f32(MAX)  # 2 * 1e36 clips
+    assert f32(p.Ke[1, 0]) == f32(EPS)
+    assert f32(p.Kmf[1, 0]) == f32(MAX)  # 2 / 1e-36 clips
+    assert float(p.Kmb[1, 0]) == pytest.approx(2.0, rel=_TOL)
+    # the stacked stoichiometry survives in i16
+    assert int(p.N[0, 0, 0]) == -n_dom and int(p.N[0, 0, 1]) == n_dom
+
+    # integration at the clamped equilibria must stay finite/nonnegative
+    X = jnp.asarray(np.full((kin.max_cells, 8), 2.0, dtype=np.float32))
+    for _ in range(3):
+        X = kin.integrate_signals(X)
+        arr = np.asarray(X)
+        assert np.isfinite(arr).all() and (arr >= 0).all()
+
+
+@pytest.mark.parametrize("det", [False, True])
+def test_three_protein_shared_substrate_contention(det):
+    # counterpart of reference test_reduce_velocity_in_multiple_proteins
+    # extended past two proteins (VERDICT round-2 gap): three proteins
+    # drain the same substrate, total demand 2x the available amount, so
+    # every protein is scaled by the SAME factor 0.5
+    X0 = np.array([[6.0, 0.0, 0.0, 0.0]], dtype=np.float32)
+    N = np.array(
+        [[[-2, 2, 0, 0], [-1, 0, 1, 0], [-3, 0, 0, 1]]], dtype=np.int32
+    )
+    V = np.array([[1.0, 4.0, 2.0]], dtype=np.float32)
+    # demand: 2*1 + 1*4 + 3*2 = 12 of signal 0; X = 6 -> F = 0.5
+    F_min = np.asarray(
+        integ._negative_factors(
+            jnp.asarray(X0), jnp.asarray(N), jnp.asarray(V), det
+        )
+    )
+    np.testing.assert_allclose(F_min[0], [0.5, 0.5, 0.5], atol=1e-6)
+    X1 = np.asarray(
+        integ._weighted_dx(
+            jnp.asarray(X0), jnp.asarray(N), jnp.asarray(V * F_min), det
+        )
+    )
+    # scaled production: b += 2*1*0.5, c += 1*4*0.5, d += 1*2*0.5
+    np.testing.assert_allclose(X1[0], [0.0, 1.0, 2.0, 1.0], atol=1e-5)
+
+    # uneven case: protein 2 also needs signal 3 which is scarcer, so its
+    # own factor is smaller while 0 and 1 share the substrate factor
+    X0 = np.array([[12.0, 0.0, 0.0, 1.0]], dtype=np.float32)
+    N = np.array(
+        [[[-2, 2, 0, 0], [-1, 0, 1, 0], [-3, 0, 0, -2]]], dtype=np.int32
+    )
+    # demand on 0: 2+4+6=12 -> F0 = 1 is not limiting (exactly consumed);
+    # demand on 3: 2*2=4 > 1 -> F3 = 0.25 limits protein 2 alone
+    F_min = np.asarray(
+        integ._negative_factors(
+            jnp.asarray(X0), jnp.asarray(N), jnp.asarray(V), det
+        )
+    )
+    np.testing.assert_allclose(F_min[0], [1.0, 1.0, 0.25], atol=1e-6)
+
+
+@pytest.mark.parametrize("det", [False, True])
+def test_regulation_hill_exponent_edges(det):
+    # hill coefficients at the sampled-range limits (1 and 5): hand-math
+    # activation/inhibition factors at representative concentrations
+    c, pn, s = 1, 2, 4
+    N = np.zeros((c, pn, s), dtype=np.int32)
+    N[0, :, 0] = -1
+    N[0, :, 1] = 1
+    A = np.zeros((c, pn, s), dtype=np.int32)
+    A[0, 0, 2] = -5  # max-hill inhibitor on signal 2
+    A[0, 1, 2] = 5  # max-hill activator on signal 2
+    Kmr = np.zeros((c, pn, s), dtype=np.float32)
+    Kmr[0, 0, 2] = 1.0  # Km^A with Km 1
+    Kmr[0, 1, 2] = 1.0
+    p = _raw_params(
+        np.ones((c, pn)), np.ones((c, pn)), np.ones((c, pn)),
+        np.ones((c, pn)), N, Kmr=Kmr, A=A,
+    )
+    X = np.array([[4.0, 0.0, 2.0, 0.0]], dtype=np.float32)
+    V = np.asarray(integ._velocities(jnp.asarray(X), p.Vmax, p, det))
+    kf = 4.0
+    a_cat = kf / (1 + kf)
+    inh = 2.0**-5 / (2.0**-5 + 1.0)
+    act = 2.0**5 / (2.0**5 + 1.0)
+    assert V[0, 0] == pytest.approx(a_cat * inh, rel=1e-4)
+    assert V[0, 1] == pytest.approx(a_cat * act, rel=1e-4)
+
+    # absent effector: the max-hill activator silences its protein, the
+    # max-hill inhibitor leaves it fully active (0^-5 -> Inf -> absent)
+    X = np.array([[4.0, 0.0, 0.0, 0.0]], dtype=np.float32)
+    V = np.asarray(integ._velocities(jnp.asarray(X), p.Vmax, p, det))
+    assert V[0, 0] == pytest.approx(a_cat, rel=1e-4)
+    assert V[0, 1] == pytest.approx(0.0, abs=1e-7)
+
+
 def test_unset_copy_remove_cell_params():
     kin = _make_kinetics()
     kin.set_cell_params(cell_idxs=[0], proteomes=[[_prot(_dom(1, 1, 2, 1, 1))]])
