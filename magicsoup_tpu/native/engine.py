@@ -64,7 +64,21 @@ def _load_lib():
     path = _build_lib()
     if path is None:
         return None
-    lib = ctypes.CDLL(str(path))
+    try:
+        lib = ctypes.CDLL(str(path))
+        return _declare_abi(lib)
+    except (OSError, AttributeError) as err:
+        # e.g. a stale library from an older source revision that lacks a
+        # newly-added symbol (can happen when another process rebuilt
+        # concurrently) — fall back rather than crash
+        warnings.warn(
+            f"Could not load native genome engine ({err});"
+            " falling back to the pure-Python engine."
+        )
+        return None
+
+
+def _declare_abi(lib):
     lib.ms_free.argtypes = [ctypes.c_void_p]
     lib.ms_free.restype = None
     lib.ms_translate_genomes.argtypes = [
@@ -95,6 +109,11 @@ def _load_lib():
         ctypes.POINTER(_i64p), _i64p,
     ]
     lib.ms_recombinations.restype = None
+    lib.ms_neighbor_pairs.argtypes = [
+        _i32p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(_i32p), _i64p,
+    ]
+    lib.ms_neighbor_pairs.restype = None
     return lib
 
 
@@ -303,6 +322,33 @@ def recombinations_indexed(
         for a, b in pair_idxs[sel]
     ]
     return _recombinations_selected(sub, counts, sel, seed, n_threads)
+
+
+def neighbor_pairs(positions: np.ndarray, map_size: int) -> np.ndarray | None:
+    """Unique Moore-adjacent index pairs (smaller first, sorted) among
+    ``(k, 2)`` positions — the C++ occupancy-grid scan (reference
+    rust/world.rs:9-54).  Returns None when the native engine is absent
+    (the caller falls back to the vectorized numpy construction)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    pos = np.ascontiguousarray(positions, dtype=np.int32)
+    out_pairs = _i32p()
+    out_n = ctypes.c_int64()
+    lib.ms_neighbor_pairs(
+        pos.ctypes.data_as(_i32p),
+        len(pos),
+        np.int32(map_size),
+        ctypes.byref(out_pairs),
+        ctypes.byref(out_n),
+    )
+    try:
+        return (
+            np.ctypeslib.as_array(out_pairs, shape=(out_n.value, 2))
+            .astype(np.int64)
+        )
+    finally:
+        lib.ms_free(out_pairs)
 
 
 def _poisson_select(

@@ -478,4 +478,49 @@ void ms_recombinations(const char* data, const int64_t* offsets, int64_t n,
   *out_n = n_out;
 }
 
+// Unique Moore-adjacent pairs among cell positions on the torus
+// (counterpart of the reference's rust/world.rs:9-54 pairwise scan, done
+// with an occupancy grid instead).  positions: (n, 2) int32 row-major.
+// Output pairs (smaller index first) sorted ascending by (lo, hi) —
+// identical order to the numpy fallback's encoded-unique.  Caller frees
+// *out_pairs with ms_free.
+void ms_neighbor_pairs(const int32_t* positions, int64_t n, int32_t map_size,
+                       int32_t** out_pairs, int64_t* out_n) {
+  const int64_t m = map_size;
+  std::vector<int32_t> grid((size_t)(m * m), -1);
+  for (int64_t i = 0; i < n; ++i) {
+    grid[(size_t)(positions[2 * i] * m + positions[2 * i + 1])] = (int32_t)i;
+  }
+  static const int dx[8] = {-1, -1, -1, 0, 0, 1, 1, 1};
+  static const int dy[8] = {-1, 0, 1, -1, 1, -1, 0, 1};
+  std::vector<int32_t> pairs;
+  pairs.reserve((size_t)(n * 3));
+  int32_t nb[8];
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t x = positions[2 * i], y = positions[2 * i + 1];
+    size_t n_nb = 0;
+    for (int k = 0; k < 8; ++k) {
+      int64_t cx = x + dx[k], cy = y + dy[k];
+      if (cx < 0) cx += m; else if (cx >= m) cx -= m;
+      if (cy < 0) cy += m; else if (cy >= m) cy -= m;
+      const int32_t cand = grid[(size_t)(cx * m + cy)];
+      // emit each unordered pair once (from its smaller endpoint);
+      // cand != i guards degenerate wraps at map_size <= 2
+      if (cand > (int32_t)i) nb[n_nb++] = cand;
+    }
+    std::sort(nb, nb + n_nb);
+    // degenerate maps can yield the same partner via several offsets
+    for (size_t k = 0; k < n_nb; ++k) {
+      if (k > 0 && nb[k] == nb[k - 1]) continue;
+      pairs.push_back((int32_t)i);
+      pairs.push_back(nb[k]);
+    }
+  }
+  int32_t* out = (int32_t*)std::malloc(
+      sizeof(int32_t) * std::max<size_t>(2, pairs.size()));
+  std::memcpy(out, pairs.data(), sizeof(int32_t) * pairs.size());
+  *out_pairs = out;
+  *out_n = (int64_t)(pairs.size() / 2);
+}
+
 }  // extern "C"

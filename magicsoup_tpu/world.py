@@ -48,11 +48,11 @@ from magicsoup_tpu.ops.params import (
     pad_idxs,
     pad_pow2,
     permute_params,
+    quantize_rows,
 )
 from magicsoup_tpu.util import fetch_host as _fetch_host, randstr
 
 _MIN_CAPACITY = 64
-
 
 # --------------------------------------------------------------------- #
 # jitted state-update kernels (slot-capacity shapes, OOB idxs dropped)   #
@@ -63,24 +63,32 @@ def _make_enzymatic_activity(integrator):
     """Build the jitted activity step around a signal integrator
     (the XLA one, or the Pallas kernel in interpret/compiled mode)."""
 
-    @jax.jit
+    @functools.partial(jax.jit, static_argnames=("q",))
     def _enzymatic_activity(
         molecule_map: jax.Array,  # (mols, m, m)
         cell_molecules: jax.Array,  # (cap, mols)
         positions: jax.Array,  # (cap, 2) int32; dead slots at (0, 0)
         n_cells: jax.Array,  # scalar int
         params,  # CellParams
+        q: int | None = None,  # live-row prefix (static); None = cap
     ) -> tuple[jax.Array, jax.Array]:
-        """Gather signals, run the MM integrator, scatter back deltas
-        (reference world.py:610-625)."""
+        """Gather signals, run the MM integrator over the live-row
+        prefix, scatter back deltas (reference world.py:610-625)."""
         cap = cell_molecules.shape[0]
-        alive = (jnp.arange(cap) < n_cells)[:, None]  # (cap, 1)
-        xs, ys = positions[:, 0], positions[:, 1]
-        ext = molecule_map[:, xs, ys].T  # (cap, mols)
-        X0 = jnp.concatenate([cell_molecules, ext], axis=1)
-        X1 = integrator(X0, params)
+        if q is None or q >= cap:
+            q = cap
+        cm_q = cell_molecules[:q]
+        params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
+        alive = (jnp.arange(q) < n_cells)[:, None]  # (q, 1)
+        xs, ys = positions[:q, 0], positions[:q, 1]
+        ext = molecule_map[:, xs, ys].T  # (q, mols)
+        X0 = jnp.concatenate([cm_q, ext], axis=1)
+        X1 = integrator(X0, params_q)
         n_mols = cell_molecules.shape[1]
-        new_cm = jnp.where(alive, X1[:, :n_mols], cell_molecules)
+        new_cm_q = jnp.where(alive, X1[:, :n_mols], cm_q)
+        new_cm = jax.lax.dynamic_update_slice_in_dim(
+            cell_molecules, new_cm_q, 0, axis=0
+        )
         delta_ext = jnp.where(alive, X1[:, n_mols:] - ext, 0.0)
         new_map = molecule_map.at[:, xs, ys].add(delta_ext.T)
         return new_map, new_cm
@@ -125,10 +133,14 @@ def _get_activity_col_fn(det: bool, pallas: bool):
     if key not in _activity_col_fns:
         activity = _get_activity_fn(det, pallas)
 
-        @jax.jit
-        def fn(molecule_map, cell_molecules, positions, n_cells, params, col):
+        @functools.partial(jax.jit, static_argnames=("q",))
+        def fn(
+            molecule_map, cell_molecules, positions, n_cells, params, col,
+            q=None,
+        ):
             new_map, new_cm = activity(
-                molecule_map, cell_molecules, positions, n_cells, params
+                molecule_map, cell_molecules, positions, n_cells, params,
+                q=q,
             )
             column = jax.lax.dynamic_index_in_dim(
                 new_cm, col, axis=1, keepdims=False
@@ -883,11 +895,17 @@ class World:
             free = ~cmap[nx, ny]
             has_opts = free.sum(axis=1) > 0
             if not vacate:
-                # divide: pixels only fill up, so no options is terminal;
-                # move: blocked cells retry (a later round may vacate a pixel)
+                # divide: pixels only fill up, so no options is terminal —
+                # drop blocked cells from pending AND the candidate arrays
+                # together (mis-aligned rows once let a blocked cell's
+                # all-occupied neighborhood win a placement, stacking two
+                # cells on one pixel); move: blocked cells retry, a later
+                # round may vacate a pixel
                 pending = pending[has_opts]
-                has_opts = has_opts[has_opts]
-            active = np.nonzero(has_opts)[0]
+                nx, ny, free = nx[has_opts], ny[has_opts], free[has_opts]
+                active = np.arange(len(pending))
+            else:
+                active = np.nonzero(has_opts)[0]
             if len(active) == 0:
                 break
             nx, ny, free = nx[active], ny[active], free[active]
@@ -1105,6 +1123,14 @@ class World:
         """
         if self.n_cells == 0:
             return
+        # live-row prefix for the integrator (dead-slot tax); sharded
+        # worlds skip it — a slice off the sharded cell axis would insert
+        # resharding collectives
+        q = (
+            None
+            if self._cell_sharding is not None
+            else quantize_rows(self.n_cells, self._capacity)
+        )
         if prefetch_column is None:
             self._molecule_map, self._cell_molecules = self._activity_fn()(
                 self._molecule_map,
@@ -1112,6 +1138,7 @@ class World:
                 self._positions_dev,
                 self._n_cells_dev(),
                 self.kinetics.params,
+                q=q,
             )
             return
         fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
@@ -1122,6 +1149,7 @@ class World:
             self._n_cells_dev(),
             self.kinetics.params,
             jnp.asarray(prefetch_column, dtype=jnp.int32),
+            q=q,
         )
         self._record_col_prefetch(prefetch_column, col)
 

@@ -43,8 +43,11 @@ def _run(stepper, n):
 def test_moore_pairs_matches_world_neighbors():
     world = _world(seed=3, n_cells=60)
     got = moore_pairs(world.cell_positions, world.map_size)
+    # oracle: the INDEPENDENT membership-mask path (an explicit index
+    # list), not the whole-population path, which itself delegates to
+    # moore_pairs and would make this comparison vacuous
     want = np.asarray(
-        world._neighbor_pairs(None), dtype=np.int64
+        world._neighbor_pairs(list(range(world.n_cells))), dtype=np.int64
     ).reshape(-1, 2)
     assert got.tolist() == want.tolist()
 
@@ -240,6 +243,43 @@ def test_pipelined_phenotypes_match_genomes_after_flush():
     want = snapshot()
     for f in got:
         assert got[f].tobytes() == want[f].tobytes(), f
+
+
+def test_pipelined_and_classic_phases_compose():
+    # flush() hands state back to the World; classic-API mutations in
+    # between must be picked up by the next step() (regression: the
+    # stepper once kept driving its stale pre-flush snapshot)
+    world = _world(seed=23, n_cells=80)
+    st = PipelinedStepper(
+        world,
+        mol_name="stp-atp",
+        kill_below=0.2,
+        divide_above=2.5,
+        divide_cost=1.0,
+        target_cells=None,
+        lag=2,
+        p_mutation=1e-4,
+        p_recombination=0.0,
+    )
+    _run(st, 5)
+    n_after_flush = world.n_cells
+    world.kill_cells([0])  # classic mutation between pipelined phases
+    assert world.n_cells == n_after_flush - 1
+    st.step()
+    # exactly ONE reattach: later steps must advance the pipeline, not
+    # keep resetting to the flush-time snapshot (regression: the flag
+    # was never cleared, silently discarding each step's physics)
+    assert not st._needs_attach
+    mm_mid = np.asarray(st._state.mm).copy()
+    for _ in range(2):
+        st.step()
+    st.drain()
+    assert (np.asarray(st._state.mm) != mm_mid).any()
+    st.flush()
+    st.check_consistency()
+    assert len(world.cell_genomes) == world.n_cells
+    mm = world._host_molecule_map()
+    assert np.isfinite(mm).all() and (mm >= 0).all()
 
 
 def test_pipelined_rejects_mesh_world():

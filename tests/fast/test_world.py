@@ -150,6 +150,22 @@ def test_divide_cells():
     assert world.cell_map.sum() == 8
 
 
+def test_crowded_divide_never_stacks_cells():
+    # regression: when some divide candidates are fully enclosed and
+    # others are not, a row-alignment bug in the placement rounds once
+    # let a blocked cell win an occupied pixel — two cells on one spot
+    world = ms.World(chemistry=_chem(), map_size=12, seed=5)
+    rng = random.Random(5)
+    world.spawn_cells([ms.random_genome(s=100, rng=rng) for _ in range(80)])
+    for _ in range(4):
+        world.divide_cells(list(range(world.n_cells)))
+        pos = world.cell_positions
+        enc = pos[:, 0].astype(np.int64) * 12 + pos[:, 1]
+        assert len(np.unique(enc)) == world.n_cells
+        assert world.cell_map.sum() == world.n_cells
+        assert world.cell_map[pos[:, 0], pos[:, 1]].all()
+
+
 def test_divide_requires_free_neighborhood():
     world = _world(map_size=8, mol_map_init="zeros")
     world.spawn_cells(_genomes(64, s=50))
