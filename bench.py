@@ -33,7 +33,26 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-BASELINE_S_PER_STEP = 0.03 + (0.30 - 0.03) * (10_000 - 1_000) / (40_000 - 1_000)
+def baseline_s_per_step(n_cells: int) -> float:
+    """The reference's measured CUDA seconds/step as a function of cell
+    count: 0.03 at 1k and 0.30 at 40k cells (both direct measurements,
+    `performance/run_simulation.py:20`), linearly interpolated between —
+    10k cells gives ~0.0923 s/step.  Map size is not in the reference's
+    numbers (both its measurements ran 256^2); treat vs_baseline at other
+    map sizes as indicative only."""
+    frac = (n_cells - 1_000) / (40_000 - 1_000)
+    return 0.03 + (0.30 - 0.03) * frac
+
+
+BASELINE_S_PER_STEP = baseline_s_per_step(10_000)
+
+# named shape presets: the headline, the reference's second headline
+# (40k cells / 256^2 map), and the diffusion-heavy BASELINE.json config
+CONFIGS = {
+    "headline": {"n_cells": 10_000, "map_size": 128},
+    "40k": {"n_cells": 40_000, "map_size": 256},
+    "diffusion": {"n_cells": 10_000, "map_size": 512},
+}
 
 # stderr markers that indicate a transient backend/tunnel failure worth retrying
 _TRANSIENT_MARKERS = (
@@ -50,6 +69,12 @@ _TRANSIENT_MARKERS = (
 
 def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--config",
+        choices=sorted(CONFIGS),
+        default=None,
+        help="named shape preset (overrides --n-cells/--map-size)",
+    )
     ap.add_argument("--n-cells", type=int, default=10_000)
     ap.add_argument("--map-size", type=int, default=128)
     ap.add_argument("--genome-size", type=int, default=500)
@@ -213,7 +238,9 @@ def _child_main(args: argparse.Namespace) -> None:
                 ),
                 "value": round(steps_per_s, 4),
                 "unit": "steps/s",
-                "vs_baseline": round(steps_per_s * BASELINE_S_PER_STEP, 4),
+                "vs_baseline": round(
+                    steps_per_s * baseline_s_per_step(args.n_cells), 4
+                ),
                 "device_rtt_ms": round(rtt_ms, 1),
                 # the serial loop's throughput with its one per-step fetch
                 # subtracted — the co-located-hardware proxy the pipelined
@@ -249,9 +276,16 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     return True, ""
 
 
+def _apply_config(args: argparse.Namespace) -> None:
+    if args.config is not None:
+        for key, val in CONFIGS[args.config].items():
+            setattr(args, key, val)
+
+
 def main() -> None:
     ap = _build_parser()
     args = ap.parse_args()
+    _apply_config(args)
     if args.det and args.pallas:
         ap.error(
             "--det and --pallas are mutually exclusive: the Pallas kernel"

@@ -25,6 +25,9 @@ run() {
 }
 
 run bench          python bench.py
+run bench_40k      python bench.py --config 40k --warmup 4 --steps 8
+run bench_diffusion python bench.py --config diffusion --warmup 4 --steps 8
+run bench_det      python bench.py --det --warmup 4 --steps 8
 run profile_step   python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run integrator     python performance/integrator_bench.py
 run check          python performance/check.py
