@@ -1,0 +1,153 @@
+"""
+Generate `docs/reference.md` — the full public-API reference — from the
+package's docstrings (the reference project renders the same page with
+mkdocstrings' `::: module` directives; this repo generates plain
+markdown so the docs need no extra tooling to read or build):
+
+    python docs/gen_reference.py
+
+The generator walks the declared module list, emits every public class
+(with its constructor signature, class docstring, and public methods /
+properties) and every public function.  Running it is idempotent; CI
+checks the committed page is current (`scripts/test.sh`).
+"""
+import importlib
+import inspect
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# module -> one-line intro (order = page order)
+MODULES = {
+    "magicsoup_tpu.world": (
+        "The main API: `World` stores a simulation's state and provides"
+        " the methods advancing it."
+    ),
+    "magicsoup_tpu.containers": (
+        "Value objects: `Chemistry` (needed to build a `World`),"
+        " `Molecule`, the interpreted domain/protein views, and the"
+        " lazy `Cell` view."
+    ),
+    "magicsoup_tpu.stepper": (
+        "The device-resident pipelined step driver — runs the whole"
+        " selection-workload step as one fused device program and"
+        " replays host bookkeeping asynchronously."
+    ),
+    "magicsoup_tpu.factories": (
+        "Genome synthesis: build nucleotide sequences that encode a"
+        " desired proteome (the inverse of translation)."
+    ),
+    "magicsoup_tpu.genetics": (
+        "Genome -> proteome translation machinery; used by `World`,"
+        " rarely needed directly."
+    ),
+    "magicsoup_tpu.kinetics": (
+        "Reaction-kinetics parameter assembly and the signal"
+        " integrator; used by `World`, rarely needed directly."
+    ),
+    "magicsoup_tpu.mutations": (
+        "Efficient point mutations and recombinations over nucleotide"
+        " sequence strings."
+    ),
+    "magicsoup_tpu.util": "Helper functions.",
+    "magicsoup_tpu.parallel.tiled": (
+        "Tile-sharded world stepping across a TPU device mesh"
+        " (halo-exchange diffusion, sharded cell axis)."
+    ),
+    "magicsoup_tpu.parallel.multihost": (
+        "Multi-host entry: join every host to the distributed runtime"
+        " and build the global mesh."
+    ),
+    "magicsoup_tpu.ops.integrate": (
+        "The reversible Michaelis-Menten integrator as pure jitted"
+        " functions (fast and deterministic numeric modes)."
+    ),
+    "magicsoup_tpu.ops.diffusion": (
+        "Molecule-map physics kernels: diffusion, permeation,"
+        " degradation."
+    ),
+}
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _doc(obj, indent: str = "") -> str:
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return ""
+    return "\n".join(indent + line for line in doc.splitlines())
+
+
+def _is_public(name: str) -> bool:
+    return not name.startswith("_")
+
+
+def _class_section(cls) -> list[str]:
+    out = [f"### `{cls.__name__}{_sig(cls.__init__)}`", "", _doc(cls), ""]
+    members = []
+    for name, member in inspect.getmembers(cls):
+        if not _is_public(name) or name not in vars(cls):
+            continue
+        if inspect.isfunction(member):
+            members.append((name, f"`.{name}{_sig(member)}`", _doc(member)))
+        elif isinstance(member, property):
+            members.append((name, f"`.{name}` *(property)*", _doc(member.fget)))
+        elif isinstance(member, classmethod):
+            fn = member.__func__
+            members.append(
+                (name, f"`.{name}{_sig(fn)}` *(classmethod)*", _doc(fn))
+            )
+    for _, head, doc in sorted(members):
+        out.append(f"- {head}")
+        if doc:
+            out.append("")
+            out.append("\n".join("  " + ln for ln in doc.splitlines()))
+        out.append("")
+    return out
+
+
+def _function_section(fn) -> list[str]:
+    return [f"### `{fn.__name__}{_sig(fn)}`", "", _doc(fn), ""]
+
+
+def generate() -> str:
+    lines = [
+        "# API reference",
+        "",
+        "Generated from docstrings by `docs/gen_reference.py` — edit the",
+        "docstrings, then re-run the generator.",
+        "",
+    ]
+    for modname, intro in MODULES.items():
+        mod = importlib.import_module(modname)
+        lines += [f"## `{modname}`", "", intro, ""]
+        mod_doc = inspect.getdoc(mod)
+        if mod_doc:
+            lines += [mod_doc, ""]
+        classes = [
+            m
+            for _, m in inspect.getmembers(mod, inspect.isclass)
+            if m.__module__ == modname and _is_public(m.__name__)
+        ]
+        functions = [
+            m
+            for _, m in inspect.getmembers(mod, inspect.isfunction)
+            if m.__module__ == modname and _is_public(m.__name__)
+        ]
+        for cls in classes:
+            lines += _class_section(cls)
+        for fn in functions:
+            lines += _function_section(fn)
+    return "\n".join(lines).rstrip() + "\n"
+
+
+if __name__ == "__main__":
+    out = Path(__file__).parent / "reference.md"
+    out.write_text(generate(), encoding="utf-8")
+    print(f"wrote {out} ({len(out.read_text().splitlines())} lines)")

@@ -1,0 +1,26 @@
+"""
+The committed API reference must match the docstrings it is generated
+from — regenerating `docs/reference.md` in memory and diffing keeps the
+page from silently drifting when signatures or docstrings change.
+"""
+import runpy
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[2]
+
+
+def test_api_reference_is_current():
+    mod = runpy.run_path(str(_REPO / "docs" / "gen_reference.py"))
+    want = mod["generate"]()
+    have = (_REPO / "docs" / "reference.md").read_text(encoding="utf-8")
+    assert have == want, (
+        "docs/reference.md is stale — run `python docs/gen_reference.py`"
+    )
+
+
+def test_api_reference_covers_public_api():
+    import magicsoup_tpu as ms
+
+    text = (_REPO / "docs" / "reference.md").read_text(encoding="utf-8")
+    for name in ms.__all__:
+        assert f"`{name}" in text, f"{name} missing from docs/reference.md"
