@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+#
+# Tag-driven release (counterpart of the reference's scripts/release.sh):
+# verifies the version is consistent and the tree is clean, builds the
+# distributables locally as a smoke test, then pushes the tag — CI's
+# wheel job does the authoritative build on the tag.
+#
+#   bash scripts/release.sh
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+py_version=$(grep '^version = ' pyproject.toml | sed 's/version = //; s/"//g')
+init_version=$(grep '^__version__' magicsoup_tpu/__init__.py | sed 's/.*"\(.*\)"/\1/')
+
+if [[ "$py_version" != "$init_version" ]]; then
+    echo "version mismatch: pyproject.toml=$py_version __init__.py=$init_version" >&2
+    exit 1
+fi
+if [[ -n "$(git status --porcelain)" ]]; then
+    echo "working tree not clean; commit first" >&2
+    exit 1
+fi
+if git rev-parse "v$py_version" >/dev/null 2>&1; then
+    echo "tag v$py_version already exists" >&2
+    exit 1
+fi
+
+echo "local build smoke test (sdist + wheel)"
+python -m build
+
+read -r -p "Release as v${py_version}? (y/N) " confirm
+[[ $confirm == [yY] || $confirm == [yY][eE][sS] ]] || exit 1
+
+git tag "v$py_version"
+git push origin "v$py_version"
+echo "pushed v$py_version — CI builds and uploads the artifacts"

@@ -1,0 +1,57 @@
+"""
+Build hooks: compile the C++ genome engine into the wheel so installed
+users skip the first-import self-build (the reference ships its Rust
+engine precompiled via maturin the same way).
+
+The engine is loaded with ctypes from a plain shared library, so the
+"extension" here bypasses the Python-ABI machinery: a custom build_ext
+invokes the exact compiler command the runtime self-build uses and drops
+the artifact at the package path `engine.py` probes.  If no compiler is
+available the wheel is built without the library — the runtime self-build
+(or the pure-Python engine) takes over on first import.
+"""
+import subprocess
+import warnings
+from pathlib import Path
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+
+class CTypesExtension(Extension):
+    pass
+
+
+class build_ctypes_ext(build_ext):
+    def get_ext_filename(self, ext_name: str) -> str:
+        # plain `<name>.so`, no Python-ABI suffix: ctypes loads it by path
+        return str(Path(*ext_name.split("."))) + ".so"
+
+    def build_extension(self, ext) -> None:
+        if not isinstance(ext, CTypesExtension):
+            return super().build_extension(ext)
+        out = Path(self.get_ext_fullpath(ext.name))
+        out.parent.mkdir(parents=True, exist_ok=True)
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+            *ext.sources, "-o", str(out),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        except (subprocess.SubprocessError, FileNotFoundError) as err:
+            warnings.warn(
+                f"building the native genome engine failed ({err}); the"
+                " package will self-build (or use the pure-Python engine)"
+                " at first import"
+            )
+
+
+setup(
+    ext_modules=[
+        CTypesExtension(
+            "magicsoup_tpu.native._libmsgenome",
+            sources=["magicsoup_tpu/native/src/genome.cpp"],
+        )
+    ],
+    cmdclass={"build_ext": build_ctypes_ext},
+)
