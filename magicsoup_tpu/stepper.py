@@ -688,6 +688,12 @@ class PipelinedStepper:
     # replay side                                                    #
     # -------------------------------------------------------------- #
 
+    @property
+    def population(self) -> int:
+        """Live cell count as of the last REPLAYED step — trails the
+        device by the pipeline depth, like all host-visible state."""
+        return int(self._alive.sum())
+
     def drain(self) -> None:
         """Block until every dispatched step has been replayed (the
         device may still be ahead on programs, but all outputs are in

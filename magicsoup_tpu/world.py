@@ -293,6 +293,33 @@ def _set_prefix(
     return jnp.where(keep, values, cell_molecules)
 
 
+def _resolve_device(spec) -> "jax.Device | None":
+    """``None`` | ``"tpu"`` | ``"cpu:1"`` | a ``jax.Device`` -> a concrete
+    device, or None for backend-default placement."""
+    if spec is None:
+        return None
+    if isinstance(spec, jax.Device):
+        return spec
+    platform, _, idx = str(spec).partition(":")
+    try:
+        devices = jax.devices(platform)
+    except RuntimeError as err:
+        raise ValueError(
+            f"device={spec!r}: no {platform!r} backend available ({err})"
+        ) from None
+    try:
+        i = int(idx) if idx else 0
+    except ValueError:
+        raise ValueError(
+            f"device={spec!r}: index {idx!r} is not an integer"
+        ) from None
+    if i < 0 or i >= len(devices):
+        raise ValueError(
+            f"device={spec!r}: only {len(devices)} {platform!r} device(s)"
+        )
+    return devices[i]
+
+
 class World:
     """
     Main API for running the simulation; holds the state and offers methods
@@ -306,9 +333,12 @@ class World:
             (|N(10, 1)|) or ``"zeros"``.
         start_codons: Codons starting a coding sequence.
         stop_codons: Codons stopping a coding sequence.
-        device: Ignored placeholder for reference compatibility — tensors
-            live wherever JAX put them (TPU when available).  Use
-            ``JAX_PLATFORMS`` to pin a backend.
+        device: Where the device-side state lives: ``None`` (backend
+            default — TPU when available), a platform string like
+            ``"cpu"`` / ``"tpu"`` / ``"tpu:1"``, or a ``jax.Device``.
+            Unknown backends raise (the reference silently fell back to
+            CPU, world.py:158-159 — a documented quirk, not copied).
+            Mutually exclusive with ``mesh``.
         batch_size: Optional chunk size when updating cell parameters
             (bounds memory peaks of spawn/update at many cells).
         seed: Seed driving all randomness (placement, token maps,
@@ -342,7 +372,13 @@ class World:
         self._rng = random.Random(seed)
         self._nprng = np.random.default_rng(seed)
 
+        if device is not None and mesh is not None:
+            raise ValueError(
+                "device and mesh are mutually exclusive: a mesh-placed"
+                " world is sharded over the mesh's devices"
+            )
         self.device = device
+        self._device = _resolve_device(device)
         self.batch_size = batch_size
         self.map_size = map_size
         self.abs_temp = abs_temp
@@ -633,14 +669,19 @@ class World:
         self.kinetics.ensure_capacity(n_cells=cap)
 
     def _place_map(self, arr) -> jax.Array:
-        """Host array -> device, sharded over the mesh when one is set"""
+        """Host array -> device: sharded over the mesh when one is set,
+        committed to the selected device when one was requested"""
         if self._map_sharding is not None:
             return jax.device_put(arr, self._map_sharding)
+        if self._device is not None:
+            return jax.device_put(arr, self._device)
         return jnp.asarray(arr)
 
     def _place_cells(self, arr) -> jax.Array:
         if self._cell_sharding is not None:
             return jax.device_put(arr, self._cell_sharding)
+        if self._device is not None:
+            return jax.device_put(arr, self._device)
         return jnp.asarray(arr)
 
     def _sync_positions(self):
@@ -1293,11 +1334,18 @@ class World:
         state.pop("_col_prefetch", None)
         state["_mm_cache"] = None
         state["_cm_cache"] = None
-        # meshes/shardings are bound to live devices — a restored world is
-        # unsharded; pass mesh= again (or device_put) to re-place it
+        # meshes/shardings/devices are bound to live runtimes — a restored
+        # world re-resolves its device string; pass mesh= again (or
+        # device_put) to re-shard
         state["_mesh"] = None
         state["_map_sharding"] = None
         state["_cell_sharding"] = None
+        state["_device"] = None
+        # a jax.Device object is not picklable — persist the request as
+        # its portable string form
+        if isinstance(state.get("device"), jax.Device):
+            dev = state["device"]
+            state["device"] = f"{dev.platform}:{dev.id}"
         return state
 
     def __setstate__(self, state: dict):
@@ -1322,8 +1370,22 @@ class World:
         self.__dict__.setdefault("_mesh", None)
         self.__dict__.setdefault("_map_sharding", None)
         self.__dict__.setdefault("_cell_sharding", None)
-        self._cell_molecules = jnp.asarray(state["_cell_molecules"])
-        self._molecule_map = jnp.asarray(state["_molecule_map"])
+        self.__dict__.setdefault("device", None)
+        try:
+            self._device = _resolve_device(self.device)
+        except ValueError:
+            # restored on a machine without that backend: fall back to
+            # the default placement rather than failing the load
+            import warnings
+
+            warnings.warn(
+                f"restored world requested device={self.device!r} which"
+                " is unavailable here; using the default device"
+            )
+            self.device = None
+            self._device = None
+        self._cell_molecules = self._place_cells(state["_cell_molecules"])
+        self._molecule_map = self._place_map(state["_molecule_map"])
         self._diff_kernels = jnp.asarray(state["_diff_kernels"])
         self._perm_factors = jnp.asarray(state["_perm_factors"])
         self._degrad_factors = jnp.asarray(state["_degrad_factors"])
@@ -1347,11 +1409,31 @@ class World:
         name: str = "world.pkl",
         device: str | None = None,
     ) -> "World":
-        """Restore a world saved with :meth:`save`"""
+        """Restore a world saved with :meth:`save`; ``device`` re-places
+        the restored state (same semantics as the constructor kwarg)."""
+        import warnings
+
         with open(Path(rundir) / name, "rb") as fh:
-            obj: "World" = pickle.load(fh)
+            if device is None:
+                obj: "World" = pickle.load(fh)
+            else:
+                # the caller overrides the placement anyway — the saved
+                # device being unavailable here is expected, not warning-
+                # worthy (the duplicate placement below is one-time load
+                # cost)
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore", message="restored world requested device"
+                    )
+                    obj = pickle.load(fh)
         if device is not None:
             obj.device = device
+            obj._device = _resolve_device(device)
+            obj._molecule_map = obj._place_map(obj._molecule_map)
+            obj._cell_molecules = obj._place_cells(obj._cell_molecules)
+            obj._sync_positions()
+            obj._mm_cache = None
+            obj._cm_cache = None
         return obj
 
     def save_state(self, statedir: Path):

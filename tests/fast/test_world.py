@@ -561,3 +561,47 @@ def test_spawn_cells_overflow_subsamples_without_mutating_input():
     # spawning into a full map is a no-op
     assert world.spawn_cells(_genomes(3, s=100, seed=21)) == []
     assert world.n_cells == 16
+
+
+def test_device_kwarg_places_state(tmp_path):
+    import jax
+
+    dev = jax.devices("cpu")[0]
+    world = ms.World(chemistry=_chem(), map_size=16, seed=1, device="cpu:0")
+    assert world._molecule_map.devices() == {dev}
+    world.spawn_cells([ms.random_genome(s=100) for _ in range(5)])
+    assert world._cell_molecules.devices() == {dev}
+    world.enzymatic_activity()
+    world.degrade_and_diffuse_molecules()
+    assert world._molecule_map.devices() == {dev}
+
+    # unknown backends raise instead of silently falling back
+    with pytest.raises(ValueError, match="backend"):
+        ms.World(chemistry=_chem(), map_size=16, device="definitely-not")
+    with pytest.raises(ValueError, match="device"):
+        ms.World(chemistry=_chem(), map_size=16, device="cpu:99")
+
+    # save/restore keeps the placement request; from_file can override
+    world.save(rundir=tmp_path)
+    w2 = ms.World.from_file(rundir=tmp_path, device="cpu")
+    assert w2._molecule_map.devices() == {dev}
+    assert w2.n_cells == world.n_cells
+
+
+def test_device_object_and_bad_specs(tmp_path):
+    import jax
+
+    dev = jax.devices("cpu")[0]
+    # a concrete Device object works and survives pickling (as a string)
+    world = ms.World(chemistry=_chem(), map_size=16, seed=2, device=dev)
+    world.spawn_cells([ms.random_genome(s=80) for _ in range(3)])
+    world.save(rundir=tmp_path, name="devobj.pkl")
+    w2 = ms.World.from_file(rundir=tmp_path, name="devobj.pkl")
+    assert w2.device == f"{dev.platform}:{dev.id}"
+    assert w2._molecule_map.devices() == {dev}
+
+    # negative and non-numeric indices raise with context
+    with pytest.raises(ValueError, match="device"):
+        ms.World(chemistry=_chem(), map_size=16, device="cpu:-1")
+    with pytest.raises(ValueError, match="device"):
+        ms.World(chemistry=_chem(), map_size=16, device="cpu:x")
