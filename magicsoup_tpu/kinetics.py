@@ -456,6 +456,21 @@ class Kinetics:
     # parameter assembly                                                 #
     # ------------------------------------------------------------------ #
 
+    def ensure_token_capacity(
+        self, prot_counts: np.ndarray, prots: np.ndarray
+    ) -> None:
+        """Grow the protein/domain capacities (grow-only, pow2) to cover
+        a translated batch — call for EVERY batch of one dispatch before
+        densifying ANY of them, so no batch's growth invalidates another
+        already-built dense tensor."""
+        max_prots = int(prot_counts.max()) if len(prot_counts) else 0
+        if max_prots > self.max_proteins:
+            self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
+        # grow-only domain capacity: a per-batch capacity would recompile
+        # `compute_cell_params` for every distinct batch shape
+        max_doms = int(prots[:, 3].max()) if len(prots) else 1
+        self.max_doms = max(self.max_doms, pad_pow2(max_doms, minimum=1))
+
     def build_dense_tokens(
         self,
         prot_counts: np.ndarray,
@@ -464,16 +479,10 @@ class Kinetics:
     ) -> np.ndarray:
         """Flat genome-engine buffers -> the dense (b, p, d, 5) token
         tensor at the CURRENT protein/domain capacities, growing them
-        (grow-only, pow2) first if the batch needs more — the one
-        implementation of the capacity rule, shared by the normal set
-        path and the pipelined stepper's in-program spawn."""
-        max_prots = int(prot_counts.max()) if len(prot_counts) else 0
-        if max_prots > self.max_proteins:
-            self.ensure_capacity(n_proteins=pad_pow2(max_prots, minimum=1))
-        # grow-only domain capacity: a per-batch capacity would recompile
-        # `compute_cell_params` for every distinct batch shape
-        max_doms = int(prots[:, 3].max()) if len(prots) else 1
-        self.max_doms = max(self.max_doms, pad_pow2(max_doms, minimum=1))
+        first if the batch needs more — the one implementation of the
+        capacity rule, shared by the normal set path and the pipelined
+        stepper's in-program spawn and riding pushes."""
+        self.ensure_token_capacity(prot_counts, prots)
         dense, _ = flat_to_dense(
             prot_counts, prots, doms, n_prots_cap=self.max_proteins,
             n_doms_cap=self.max_doms,

@@ -202,7 +202,9 @@ def _place_global(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("det", "max_div", "n_rounds", "compact", "has_spawn", "q"),
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "has_spawn", "has_push", "q",
+    ),
 )
 def _pipeline_step(
     state: DeviceState,
@@ -217,7 +219,9 @@ def _pipeline_step(
     div_budget: jax.Array,  # i32 — host-chosen division cap this step
     spawn_dense: jax.Array | None,  # (b_spawn, p, d, 5) i16 or None
     spawn_valid: jax.Array | None,  # (b_spawn,) bool
-    tables: Any,  # TokenTables (only read when has_spawn)
+    push_dense: jax.Array | None,  # (b_push, p, d, 5) i16 or None
+    push_rows: jax.Array | None,  # (b_push,) i32; padding = OOB
+    tables: Any,  # TokenTables (only read when has_spawn/has_push)
     abs_temp: jax.Array,
     *,
     det: bool,
@@ -225,6 +229,7 @@ def _pipeline_step(
     n_rounds: int,
     compact: bool,
     has_spawn: bool,
+    has_push: bool = False,
     q: int | None = None,
 ) -> tuple[DeviceState, CellParams, StepOutputs]:
     """One fused workload step (spawn -> activity -> select -> kill ->
@@ -245,6 +250,18 @@ def _pipeline_step(
     mol_onehot = (jnp.arange(n_mols, dtype=jnp.int32) == mol_idx).astype(
         jnp.float32
     )
+
+    # ---- -1. parameter pushes riding this dispatch ---------------------
+    # the phenotype refresh for genomes changed in recent replays — rides
+    # the step program instead of paying its own dispatch round trip;
+    # rows whose proteome emptied carry all-zero token rows (their
+    # computed params are inert)
+    if has_push:
+        params = scatter_params(
+            params,
+            compute_cell_params(push_dense, tables, abs_temp),
+            push_rows,
+        )
 
     # ---- 0. spawn queued newcomers ------------------------------------
     if has_spawn:
@@ -420,6 +437,8 @@ class PipelinedStepper:
         max_divisions: Static per-step division budget (slot allocation
             is bounded so the step program compiles once).
         spawn_block: Static per-step spawn budget.
+        push_block: Static size of the parameter-refresh batch riding a
+            step dispatch; bigger change sets pay their own dispatch.
         n_rounds: Conflict-resolution rounds for on-device placement.
         p_mutation / p_indel / p_del / p_recombination: Mutation
             parameters (reference defaults).
@@ -445,6 +464,7 @@ class PipelinedStepper:
         max_lag: int = 8,
         max_divisions: int = 2048,
         spawn_block: int = 1024,
+        push_block: int = 256,
         n_rounds: int = 4,
         p_mutation: float = 1e-6,
         p_indel: float = 0.4,
@@ -472,6 +492,7 @@ class PipelinedStepper:
         self.max_lag = max_lag if lag == "auto" else max(int(lag), 1)
         self.max_divisions = max_divisions
         self.spawn_block = spawn_block
+        self.push_block = push_block
         self.n_rounds = n_rounds
         self.p_mutation = p_mutation
         self.p_indel = p_indel
@@ -513,6 +534,9 @@ class PipelinedStepper:
         # deferred pushes: (genomes, rows, change seq) held while a
         # compaction is in flight
         self._push_buffer: list[tuple[list[str], list[int], int]] = []
+        # translated-parameter refreshes ready to RIDE the next step
+        # dispatch (saves one program dispatch per step)
+        self._push_queue: list[tuple[list[str], list[int], int]] = []
         self._compact_outstanding = False
         self._growth_hist: list[int] = []  # recent per-step row growth
         self._change_seq = 0  # bumps on every genome-change batch CREATED
@@ -608,17 +632,33 @@ class PipelinedStepper:
             and projected + self.compact_headroom > self._cap
         )
 
-        # spawn batch for this dispatch
+        # spawn batch + riding parameter refreshes for this dispatch:
+        # translate BOTH first, grow token capacities for both, and only
+        # then densify — one batch's protein-capacity growth must not
+        # invalidate the other's already-built dense tensor
         spawn = self._spawn_queue[: self.spawn_block]
         self._spawn_queue = self._spawn_queue[len(spawn) :]
         has_spawn = len(spawn) > 0
+        spawn_flat = (
+            self.world.genetics.translate_genomes_flat([g for g, _ in spawn])
+            if has_spawn
+            else None
+        )
+        ride = self._take_ride_push()
+        if compact and self._push_queue:
+            # refreshes NOT riding this compacting dispatch would reach
+            # the device with pre-compaction row ids; park them in the
+            # remap buffer until the compaction's replay provides the
+            # permutation
+            self._push_buffer += self._push_queue
+            self._push_queue = []
+        for flat in (spawn_flat, ride[0] if ride else None):
+            if flat is not None:
+                self.kin.ensure_token_capacity(flat[0], flat[1])
+
         spawn_dense = spawn_valid = None
         if has_spawn:
-            genomes = [g for g, _ in spawn]
-            prot_counts, prots, doms = (
-                self.world.genetics.translate_genomes_flat(genomes)
-            )
-            dense = self.kin.build_dense_tokens(prot_counts, prots, doms)
+            dense = self.kin.build_dense_tokens(*spawn_flat)
             pad = np.zeros(
                 (self.spawn_block,) + dense.shape[1:], dtype=dense.dtype
             )
@@ -627,6 +667,9 @@ class PipelinedStepper:
             valid = np.zeros(self.spawn_block, dtype=bool)
             valid[: len(spawn)] = True
             spawn_valid = jnp.asarray(valid)
+        push_dense = push_rows = None
+        if ride is not None:
+            push_dense, push_rows = self._densify_push(*ride)
 
         # Live-row prefix for this dispatch: an EXACT upper bound on the
         # device's row count (replayed rows + each outstanding step's
@@ -653,6 +696,8 @@ class PipelinedStepper:
             jnp.asarray(div_budget, dtype=jnp.int32),
             spawn_dense,
             spawn_valid,
+            push_dense,
+            push_rows,
             self.kin.tables,
             self._abs_temp_dev,
             det=self.world.deterministic,
@@ -660,6 +705,7 @@ class PipelinedStepper:
             n_rounds=self.n_rounds,
             compact=compact,
             has_spawn=has_spawn,
+            has_push=push_dense is not None,
             q=q,
         )
         for arr in out:
@@ -899,14 +945,85 @@ class PipelinedStepper:
     def _dispatch_push(
         self, genomes: list[str], rows: list[int], seq: int
     ) -> None:
-        """Re-translate changed genomes and scatter their parameters —
-        the phenotype refresh that trails the genome history.  Rows that
+        """Queue the phenotype refresh for changed genomes; it rides the
+        next step dispatch (one fewer program round trip).  Rows that
         died since the genome change receive stale parameters; those rows
         are alive-masked everywhere and fold out at the next compaction,
         so the write is harmless."""
-        self.world._update_cell_params(genomes=genomes, idxs=rows)
+        self._push_queue.append((genomes, rows, seq))
+
+    def _apply_push_now(
+        self, genomes: list[str], rows: list[int], seq: int
+    ) -> None:
+        """Apply one refresh batch with its own standalone program (used
+        for oversized bursts and at flush, when no step dispatch
+        follows)."""
+        prot_counts, prots, doms = (
+            self.world.genetics.translate_genomes_flat(genomes)
+        )
+        self.kin.set_cell_params_flat(rows, prot_counts, prots, doms)
         self._dispatched_seq = max(self._dispatched_seq, seq)
         self.stats["pushes"] += 1
+
+    def _take_ride_push(self):
+        """Pop queued refreshes (in order) up to the fixed riding block
+        and return their translated flat buffers + rows, or None.  The
+        block size is FIXED so the fused step program compiles for at
+        most one push shape; a batch bigger than the block gets its own
+        standalone dispatch (rare burst), and queue order is never
+        reordered across dispatch boundaries — for a row changed twice,
+        the newest genome's parameters must land last."""
+        taken: list[tuple[list[str], list[int], int]] = []
+        total = 0
+        while self._push_queue:
+            g, r, seq = self._push_queue[0]
+            if len(r) > self.push_block:
+                if taken:
+                    break  # keep order; the burst goes next dispatch
+                self._push_queue.pop(0)
+                self._apply_push_now(g, r, seq)
+                continue
+            if total + len(r) > self.push_block:
+                break
+            taken.append(self._push_queue.pop(0))
+            total += len(r)
+        if not taken:
+            return None
+        # duplicate rows across taken batches: the LAST queued genome
+        # wins (dict update order) — one scatter with repeated indices
+        # would apply them in undefined order
+        merged: dict[int, str] = {}
+        top_seq = self._dispatched_seq
+        for g, r, seq in taken:
+            merged.update(zip(r, g))
+            top_seq = max(top_seq, seq)
+        rows = sorted(merged)
+        genomes = [merged[r] for r in rows]
+        flat = self.world.genetics.translate_genomes_flat(genomes)
+        self._dispatched_seq = top_seq
+        self.stats["pushes"] += 1
+        return flat, rows
+
+    def _densify_push(self, flat, rows):
+        """Flat buffers -> (dense, rows) device inputs at the FIXED push
+        block shape.  Separate from :meth:`_take_ride_push` so all of a
+        dispatch's capacity growth happens before any densify."""
+        prot_counts, prots, doms = flat
+        dense = self.kin.build_dense_tokens(prot_counts, prots, doms)
+        dense_pad = np.zeros(
+            (self.push_block,) + dense.shape[1:], dtype=dense.dtype
+        )
+        dense_pad[: len(rows)] = dense
+        rows_pad = np.full(self.push_block, self._cap, dtype=np.int32)
+        rows_pad[: len(rows)] = rows
+        return jnp.asarray(dense_pad), jnp.asarray(rows_pad)
+
+    def _flush_push_queue(self) -> None:
+        """Apply ALL queued refreshes standalone (used before a flush
+        sync, when no step dispatch follows)."""
+        for genomes, rows, seq in self._push_queue:
+            self._apply_push_now(genomes, rows, seq)
+        self._push_queue = []
 
     # -------------------------------------------------------------- #
     # flush                                                          #
@@ -916,6 +1033,9 @@ class PipelinedStepper:
         """Drain the pipeline, compact, and sync everything back into the
         attached :class:`World` (dense reference-style indices again)."""
         self._drain(block=True)
+        # refreshes queued by the final replays have no next dispatch to
+        # ride — apply them now so world params match world genomes
+        self._flush_push_queue()
         n_keep = int(self._alive.sum())
         if self._n_rows != n_keep or not self._alive[:n_keep].all():
             perm = np.argsort(~self._alive, kind="stable")

@@ -212,9 +212,11 @@ def test_pipelined_phenotypes_match_genomes_after_flush():
         lag=4,
         p_mutation=3e-3,  # aggressive: most steps mutate many genomes
         p_recombination=1e-4,
+        push_block=8,  # force riding-queue overflow across compactions
     )
     _run(st, 25)
     assert st.stats["divisions"] > 0 and st.stats["pushes"] > 0
+    assert st.stats["compactions"] > 0  # overflow straddles compactions
 
     def snapshot():
         p = world.kinetics.params

@@ -172,3 +172,49 @@ def test_random_op_sequence_keeps_state_consistent():
     for i in range(120):
         rng.choice(ops)()
         check()
+
+
+@pytest.mark.parametrize("deterministic", [False, True])
+def test_long_pipelined_run_stays_sane(deterministic, monkeypatch):
+    # the pipelined driver over a long horizon with mutations,
+    # recombination, capacity growths and compactions: the same
+    # no-NaN/no-negative invariants, host/device agreement, and
+    # phenotype/genome parity at the end — in both numeric modes
+    if deterministic:
+        monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    world = ms.World(chemistry=CHEMISTRY, map_size=32, seed=29)
+    rng = random.Random(29)
+    world.spawn_cells([random_genome(s=400, rng=rng) for _ in range(150)])
+    st = ms.PipelinedStepper(
+        world,
+        mol_name="ATP",
+        kill_below=1.0,
+        divide_above=5.0,
+        divide_cost=4.0,
+        target_cells=150,
+        genome_size=400,
+        lag=3,
+        p_mutation=5e-4,
+        p_recombination=1e-5,
+    )
+    for i in range(60):
+        st.step()
+        if i % 20 == 19:
+            st.drain()
+            st.check_consistency()
+            mm = np.asarray(st._state.mm)
+            assert np.isfinite(mm).all() and (mm >= 0).all(), i
+    st.flush()
+    st.check_consistency()
+    assert st.stats["replayed"] == 60
+    assert world.n_cells > 0
+    cm = np.asarray(world.cell_molecules)
+    assert np.isfinite(cm).all() and (cm >= 0).all()
+    # phenotypes match genomes after the asynchronous refreshes settle
+    n = world.n_cells
+    vmax_before = np.asarray(world.kinetics.params.Vmax)[:n].copy()
+    world._update_cell_params(genomes=world.cell_genomes, idxs=list(range(n)))
+    assert (
+        np.asarray(world.kinetics.params.Vmax)[:n].tobytes()
+        == vmax_before.tobytes()
+    )
