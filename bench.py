@@ -164,6 +164,10 @@ def _child_main(args: argparse.Namespace) -> None:
 
     for _ in range(args.warmup):
         step(sync=True)
+    # the warmup steps auto-schedule background compile warmers one
+    # row-ladder rung ahead; settle them so no remote compile can land
+    # inside the measured window
+    world.wait_warm()
 
     # measure the tunnel/device round-trip latency: the workload has one
     # mandatory device->host fetch per step (the selection threshold), so
@@ -211,6 +215,7 @@ def _child_main(args: argparse.Namespace) -> None:
         for _ in range(max(args.warmup, 3)):
             st.step()
         st.drain()
+        st.wait_warm()
         t0 = time.perf_counter()
         n_pipe = args.steps * 4
         for _ in range(n_pipe):

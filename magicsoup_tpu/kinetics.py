@@ -37,6 +37,7 @@ from magicsoup_tpu.ops.integrate import (
     integrate_signals,
 )
 from magicsoup_tpu.ops.params import (
+    IDX_BLOCK as _IDX_BLOCK,
     TokenTables,
     compute_and_scatter_params,
     copy_params,
@@ -507,7 +508,11 @@ class Kinetics:
         if b == 0:
             return
         dense = self.build_dense_tokens(prot_counts, prots, doms)
-        b_pad = pad_pow2(b)
+        # same minimum as pad_idxs: the token batch and the row-index batch
+        # must pad to the SAME length (they feed one scatter), and a shared
+        # 256-row floor keeps the typical mutate/update batch at one
+        # compiled variant (ops/params.py IDX_BLOCK)
+        b_pad = pad_pow2(b, minimum=_IDX_BLOCK)
         dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=dense.dtype)
         dense_pad[:b] = dense
         idxs = pad_idxs(cell_idxs, oob=self.max_cells)

@@ -66,7 +66,17 @@ def quantize_rows(n: int, cap: int, quantum: int = ROW_QUANTUM) -> int:
     return min(cap, max(quantum, -(-n // quantum) * quantum))
 
 
-def pad_idxs(idxs: np.ndarray, oob: int, minimum: int = 8) -> np.ndarray:
+# minimum padded length for index batches.  Every distinct padded length
+# is a separate compile of the program consuming it, and on this platform
+# compiles go through a remote compile service at seconds each — one
+# landing inside a measured (or merely latency-sensitive) window costs
+# more than years of the scatter work the padding adds.  256 covers the
+# typical per-step kill/divide/mutate batches at benchmark populations
+# with ONE variant; only genuine bursts (>256) step up the pow2 ladder.
+IDX_BLOCK = 256
+
+
+def pad_idxs(idxs: np.ndarray, oob: int, minimum: int = IDX_BLOCK) -> np.ndarray:
     """Pad an int index array to a power-of-two length with an out-of-bounds
     fill value (dropped by scatters with mode='drop')."""
     n = pad_pow2(len(idxs), minimum)

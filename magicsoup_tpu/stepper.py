@@ -66,6 +66,7 @@ from magicsoup_tpu.ops.params import (
     scatter_params,
 )
 from magicsoup_tpu.util import (
+    WarmScheduler,
     fetch_host as _fetch_host,
     moore_pairs,
     random_genome,
@@ -202,9 +203,7 @@ def _place_global(
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "has_spawn", "has_push", "q",
-    ),
+    static_argnames=("det", "max_div", "n_rounds", "compact", "q"),
 )
 def _pipeline_step(
     state: DeviceState,
@@ -217,19 +216,17 @@ def _pipeline_step(
     divide_above: jax.Array,
     divide_cost: jax.Array,
     div_budget: jax.Array,  # i32 — host-chosen division cap this step
-    spawn_dense: jax.Array | None,  # (b_spawn, p, d, 5) i16 or None
-    spawn_valid: jax.Array | None,  # (b_spawn,) bool
-    push_dense: jax.Array | None,  # (b_push, p, d, 5) i16 or None
-    push_rows: jax.Array | None,  # (b_push,) i32; padding = OOB
-    tables: Any,  # TokenTables (only read when has_spawn/has_push)
+    spawn_dense: jax.Array,  # (b_spawn, p, d, 5) i16; all-zero rows inert
+    spawn_valid: jax.Array,  # (b_spawn,) bool; all-False = no spawns
+    push_dense: jax.Array,  # (b_push, p, d, 5) i16; all-zero rows inert
+    push_rows: jax.Array,  # (b_push,) i32; OOB rows are dropped
+    tables: Any,  # TokenTables
     abs_temp: jax.Array,
     *,
     det: bool,
     max_div: int,
     n_rounds: int,
     compact: bool,
-    has_spawn: bool,
-    has_push: bool = False,
     q: int | None = None,
 ) -> tuple[DeviceState, CellParams, StepOutputs]:
     """One fused workload step (spawn -> activity -> select -> kill ->
@@ -239,7 +236,15 @@ def _pipeline_step(
     ``q`` (static) bounds the live-row prefix: the integrator reads only
     the first q rows of the big parameter tensors (dead-slot tax), and
     spawn/divide allocation is clamped so ``n_rows`` never exceeds q —
-    the host raises q as the population grows."""
+    the host raises q as the population grows.
+
+    Spawn and push batches are ALWAYS present at their fixed block shapes
+    (cached all-zero/all-OOB device buffers stand in on steps without
+    them) so neither forks an extra compiled variant of this program —
+    on a remote-compile platform every variant is seconds of stall the
+    first time it appears (ops/params.py IDX_BLOCK has the same
+    rationale).  The compiled-variant axes are exactly ``q`` (bounded
+    ladder, prewarmed one rung ahead) and ``compact``."""
     mm, cm, pos, occ, alive, n_rows, key = state
     cap, n_mols = cm.shape
     if q is None or q > cap:
@@ -256,34 +261,28 @@ def _pipeline_step(
     # the step program instead of paying its own dispatch round trip;
     # rows whose proteome emptied carry all-zero token rows (their
     # computed params are inert)
-    if has_push:
-        params = scatter_params(
-            params,
-            compute_cell_params(push_dense, tables, abs_temp),
-            push_rows,
-        )
+    params = scatter_params(
+        params,
+        compute_cell_params(push_dense, tables, abs_temp),
+        push_rows,
+    )
 
     # ---- 0. spawn queued newcomers ------------------------------------
-    if has_spawn:
-        b_spawn = spawn_valid.shape[0]
-        budget = q - n_rows
-        valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
-        spawn_ok, spawn_pos, occ = _place_global(k_spawn, occ, valid, n_rounds)
-        srank = jnp.cumsum(spawn_ok) - 1
-        srow = jnp.where(spawn_ok, n_rows + srank, cap).astype(jnp.int32)
-        sx, sy = spawn_pos[:, 0], spawn_pos[:, 1]
-        pickup = mm[:, sx, sy] * 0.5 * spawn_ok[None, :]  # (mols, b)
-        mm = mm.at[:, sx, sy].add(-pickup)
-        cm = cm.at[srow].set(pickup.T, mode="drop")
-        pos = pos.at[srow].set(spawn_pos, mode="drop")
-        alive = alive.at[srow].set(True, mode="drop")
-        params = scatter_params(
-            params, compute_cell_params(spawn_dense, tables, abs_temp), srow
-        )
-        n_rows = n_rows + spawn_ok.sum(dtype=jnp.int32)
-    else:
-        spawn_ok = jnp.zeros((1,), dtype=bool)
-        spawn_pos = jnp.zeros((1, 2), dtype=jnp.int32)
+    budget = q - n_rows
+    valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
+    spawn_ok, spawn_pos, occ = _place_global(k_spawn, occ, valid, n_rounds)
+    srank = jnp.cumsum(spawn_ok) - 1
+    srow = jnp.where(spawn_ok, n_rows + srank, cap).astype(jnp.int32)
+    sx, sy = spawn_pos[:, 0], spawn_pos[:, 1]
+    pickup = mm[:, sx, sy] * 0.5 * spawn_ok[None, :]  # (mols, b)
+    mm = mm.at[:, sx, sy].add(-pickup)
+    cm = cm.at[srow].set(pickup.T, mode="drop")
+    pos = pos.at[srow].set(spawn_pos, mode="drop")
+    alive = alive.at[srow].set(True, mode="drop")
+    params = scatter_params(
+        params, compute_cell_params(spawn_dense, tables, abs_temp), srow
+    )
+    n_rows = n_rows + spawn_ok.sum(dtype=jnp.int32)
 
     # ---- 1. enzymatic activity (live-row prefix only) ------------------
     xs_q, ys_q = pos[:q, 0], pos[:q, 1]
@@ -541,6 +540,10 @@ class PipelinedStepper:
         self._growth_hist: list[int] = []  # recent per-step row growth
         self._change_seq = 0  # bumps on every genome-change batch CREATED
         self._dispatched_seq = 0  # highest batch seq actually DISPATCHED
+        # compiled-variant bookkeeping (keys include the token capacities
+        # the program shapes depend on) + cached empty spawn/push buffers
+        self._warm_sched = WarmScheduler()
+        self._empty_cache: dict = {}
         self._attach(jax.random.PRNGKey(world._rng.randrange(2**31)))
         self._needs_attach = False
 
@@ -549,6 +552,10 @@ class PipelinedStepper:
         used at construction and after a capacity growth."""
         w = self.world
         self._cap = w._capacity
+        # capacity growth changes every program's shapes: compiled-variant
+        # bookkeeping and the cached empty buffers start over
+        self._warm_sched.reset()
+        self._empty_cache = {}
         self._state = DeviceState(
             mm=w._molecule_map,
             cm=w._cell_molecules,
@@ -656,7 +663,6 @@ class PipelinedStepper:
             if flat is not None:
                 self.kin.ensure_token_capacity(flat[0], flat[1])
 
-        spawn_dense = spawn_valid = None
         if has_spawn:
             dense = self.kin.build_dense_tokens(*spawn_flat)
             pad = np.zeros(
@@ -667,9 +673,15 @@ class PipelinedStepper:
             valid = np.zeros(self.spawn_block, dtype=bool)
             valid[: len(spawn)] = True
             spawn_valid = jnp.asarray(valid)
-        push_dense = push_rows = None
+        else:
+            # cached all-zero device buffers: the spawn path always runs
+            # (no extra compiled variant) but places nothing and scatters
+            # inert rows — and nothing is re-uploaded on spawnless steps
+            spawn_dense, spawn_valid = self._empty_spawn()
         if ride is not None:
             push_dense, push_rows = self._densify_push(*ride)
+        else:
+            push_dense, push_rows = self._empty_push()
 
         # Live-row prefix for this dispatch: an EXACT upper bound on the
         # device's row count (replayed rows + each outstanding step's
@@ -704,10 +716,9 @@ class PipelinedStepper:
             max_div=self.max_divisions,
             n_rounds=self.n_rounds,
             compact=compact,
-            has_spawn=has_spawn,
-            has_push=push_dense is not None,
             q=q,
         )
+        self._note_warm(q, compact)
         for arr in out:
             try:
                 arr.copy_to_host_async()
@@ -1017,6 +1028,109 @@ class PipelinedStepper:
         rows_pad = np.full(self.push_block, self._cap, dtype=np.int32)
         rows_pad[: len(rows)] = rows
         return jnp.asarray(dense_pad), jnp.asarray(rows_pad)
+
+    # -------------------------------------------------------------- #
+    # compiled-variant management                                    #
+    # -------------------------------------------------------------- #
+
+    def _empty_spawn(self) -> tuple[jax.Array, jax.Array]:
+        """Cached all-zero spawn buffers at the current token capacities —
+        device-resident so spawnless steps upload nothing."""
+        key = ("spawn", self.kin.max_proteins, self.kin.max_doms)
+        if key not in self._empty_cache:
+            self._empty_cache[key] = (
+                jnp.zeros(
+                    (self.spawn_block, self.kin.max_proteins,
+                     self.kin.max_doms, 5),
+                    dtype=jnp.int16,
+                ),
+                jnp.zeros(self.spawn_block, dtype=bool),
+            )
+        return self._empty_cache[key]
+
+    def _empty_push(self) -> tuple[jax.Array, jax.Array]:
+        """Cached all-zero/all-OOB push buffers.  The OOB row sentinel is
+        INT32_MAX — not the current capacity, which a concurrent
+        background build racing a capacity growth could capture stale,
+        leaving rows that become IN-bounds after the doubling and would
+        silently zero a live cell's params every pushless step."""
+        key = ("push", self.kin.max_proteins, self.kin.max_doms)
+        if key not in self._empty_cache:
+            self._empty_cache[key] = (
+                jnp.zeros(
+                    (self.push_block, self.kin.max_proteins,
+                     self.kin.max_doms, 5),
+                    dtype=jnp.int16,
+                ),
+                jnp.full(
+                    self.push_block, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+                ),
+            )
+        return self._empty_cache[key]
+
+    def prewarm(self, *, q: int | None = None, compact: bool = False) -> None:
+        """Compile (and persistently cache) the fused step program's
+        ``(q, compact)`` variant WITHOUT advancing the simulation: the
+        program is pure, so calling it on the current state and
+        discarding the results is a compile warmer.  The step dispatch
+        does this automatically one q-rung ahead in a background thread;
+        call it explicitly (plus :meth:`wait_warm`) before a timing
+        window so no remote compile can land inside it."""
+        if q is None:
+            q = quantize_rows(self._n_rows + 1, self._cap)
+        spawn_dense, spawn_valid = self._empty_spawn()
+        push_dense, push_rows = self._empty_push()
+        _pipeline_step(
+            self._state,
+            self.kin.params,
+            self.world._diff_kernels,
+            self.world._perm_factors,
+            self.world._degrad_factors,
+            self._mol_idx_dev,
+            self._kill_below_dev,
+            self._divide_above_dev,
+            self._divide_cost_dev,
+            jnp.asarray(0, dtype=jnp.int32),
+            spawn_dense,
+            spawn_valid,
+            push_dense,
+            push_rows,
+            self.kin.tables,
+            self._abs_temp_dev,
+            det=self.world.deterministic,
+            max_div=self.max_divisions,
+            n_rounds=self.n_rounds,
+            compact=compact,
+            q=q,
+        )
+
+    def _variant_key(self, q: int, compact: bool) -> tuple:
+        # token capacities are in the key: growing them reshapes the
+        # params/spawn/push inputs, invalidating every compiled variant —
+        # stale-capacity entries then simply never match again
+        return (q, compact, self.kin.max_proteins, self.kin.max_doms)
+
+    def _note_warm(self, q: int, compact: bool) -> None:
+        """Record a just-dispatched variant as compiled and keep the
+        q ladder warm ONE RUNG AHEAD (plus the compact variants) in a
+        background thread, so population growth or a scheduled
+        compaction never meets a cold remote compile mid-run."""
+        self._warm_sched.mark(self._variant_key(q, compact))
+        nxt = quantize_rows(q + 1, self._cap) if q < self._cap else q
+        wanted = [
+            self._variant_key(q, True),
+            self._variant_key(nxt, False),
+            self._variant_key(nxt, True),
+        ]
+        self._warm_sched.schedule(
+            wanted, lambda k: self.prewarm(q=k[0], compact=k[1])
+        )
+
+    def wait_warm(self, timeout: float | None = None) -> None:
+        """Block until any in-flight background compile warmer finishes —
+        benchmarks call this after their warmup phase so the measured
+        window starts with every nearby variant compiled."""
+        self._warm_sched.wait(timeout)
 
     def _flush_push_queue(self) -> None:
         """Apply ALL queued refreshes standalone (used before a flush

@@ -123,6 +123,78 @@ def reverse_complement(seq: str) -> str:
 _COMPLEMENT = str.maketrans("ACTG", "TGAC")
 
 
+class WarmScheduler:
+    """Compiled-variant bookkeeping shared by :class:`World` and the
+    pipelined stepper: tracks which program-variant keys are known
+    compiled and runs "compile warmer" callables (pure jitted programs
+    called for their compile side effect, results discarded) one step
+    ahead of need in a single background thread — on a remote-compile
+    platform a cold variant first used mid-run stalls for seconds.
+
+    Generation safety: :meth:`reset` (called when array shapes change,
+    e.g. capacity growth) swaps in a fresh key set; an in-flight
+    background warm finishing after a reset records into the OLD,
+    orphaned set, so a stale-shape warm can never mark the new
+    generation as compiled.  Keys should include every capacity the
+    program's shapes depend on so capacity growth also invalidates
+    through the key itself."""
+
+    def __init__(self):
+        self._warm: set = set()
+        self._thread = None
+
+    def is_warm(self, key) -> bool:
+        return key in self._warm
+
+    def mark(self, key) -> None:
+        """Record a variant the caller just compiled synchronously."""
+        self._warm.add(key)
+
+    def schedule(self, keys, warm_fn) -> None:
+        """Warm the not-yet-compiled ``keys`` via ``warm_fn(key)`` in a
+        background thread (skipped while a previous batch is in flight —
+        dropped keys are re-offered on the next call)."""
+        todo = [k for k in keys if k not in self._warm]
+        if not todo:
+            return
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        import threading
+
+        warm_set = self._warm  # capture THIS generation
+
+        def _bg():
+            for k in todo:
+                try:
+                    warm_fn(k)
+                except Exception:  # a failed warm only loses the win
+                    return
+                warm_set.add(k)
+
+        self._thread = threading.Thread(target=_bg, daemon=True)
+        self._thread.start()
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Block until any in-flight background warm batch finishes."""
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def reset(self) -> None:
+        """Start a new generation (array shapes changed)."""
+        self._warm = set()
+
+    # pickling: thread handles are not picklable and warm state is
+    # runtime-local — a restored scheduler starts cold
+    def __getstate__(self) -> dict:
+        return {}
+
+    def __setstate__(self, state: dict) -> None:
+        self._warm = set()
+        self._thread = None
+
+
 def fetch_host(arr) -> "np.ndarray":  # noqa: F821 - numpy imported lazily
     """Device array -> host numpy, including global arrays whose shards
     live on other processes (multi-host meshes): every process computes

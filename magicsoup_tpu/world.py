@@ -50,7 +50,11 @@ from magicsoup_tpu.ops.params import (
     permute_params,
     quantize_rows,
 )
-from magicsoup_tpu.util import fetch_host as _fetch_host, randstr
+from magicsoup_tpu.util import (
+    WarmScheduler,
+    fetch_host as _fetch_host,
+    randstr,
+)
 
 _MIN_CAPACITY = 64
 
@@ -482,6 +486,10 @@ class World:
         self._mm_cache: tuple | None = None
         self._cm_cache: tuple | None = None
 
+        # activity-program variant bookkeeping (see enzymatic_activity);
+        # keys include the kinetics token capacities the shapes depend on
+        self._warm_sched = WarmScheduler()
+
         self._ensure_capacity(_MIN_CAPACITY)
 
     # ------------------------------------------------------------------ #
@@ -667,6 +675,9 @@ class World:
         self._capacity = cap
         self._sync_positions()
         self.kinetics.ensure_capacity(n_cells=cap)
+        # capacity growth changes the activity program's shapes: the
+        # compiled-variant bookkeeping starts over
+        self._warm_sched.reset()
 
     def _place_map(self, arr) -> jax.Array:
         """Host array -> device: sharded over the mesh when one is set,
@@ -1181,6 +1192,7 @@ class World:
                 self.kinetics.params,
                 q=q,
             )
+            self._note_activity_warm(q, has_col=False)
             return
         fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
         self._molecule_map, self._cell_molecules, col = fn(
@@ -1193,6 +1205,56 @@ class World:
             q=q,
         )
         self._record_col_prefetch(prefetch_column, col)
+        self._note_activity_warm(q, has_col=True)
+
+    def prewarm_activity(
+        self, *, q: int | None = None, has_col: bool = False
+    ) -> None:
+        """Compile (and persistently cache) the activity program's
+        live-row-prefix variant WITHOUT touching state: the program is
+        pure, so calling it on the current state and discarding the
+        results is a compile warmer.  ``q`` defaults to the NEXT rung of
+        the row ladder above the current population.  Steps schedule
+        this automatically one rung ahead in a background thread; call
+        it (plus :meth:`wait_warm`) before a timing window so population
+        growth cannot meet a multi-second remote compile mid-window."""
+        if self._cell_sharding is not None or self.n_cells == 0:
+            return
+        if q is None:
+            q = quantize_rows(self.n_cells + 1, self._capacity)
+        args = (
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            self._n_cells_dev(),
+            self.kinetics.params,
+        )
+        if has_col:
+            fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
+            fn(*args, jnp.asarray(0, dtype=jnp.int32), q=q)
+        else:
+            self._activity_fn()(*args, q=q)
+
+    def _activity_variant_key(self, q: int, has_col: bool) -> tuple:
+        # token capacities are in the key: growing them reshapes
+        # kinetics.params, invalidating every compiled activity variant
+        return (q, has_col, self.kinetics.max_proteins, self.kinetics.max_doms)
+
+    def _note_activity_warm(self, q: int | None, has_col: bool) -> None:
+        """Record a just-used activity variant and keep the row ladder
+        warm one rung ahead in a background thread."""
+        if q is None:
+            return
+        self._warm_sched.mark(self._activity_variant_key(q, has_col))
+        nxt = quantize_rows(q + 1, self._capacity) if q < self._capacity else q
+        self._warm_sched.schedule(
+            [self._activity_variant_key(nxt, has_col)],
+            lambda k: self.prewarm_activity(q=k[0], has_col=k[1]),
+        )
+
+    def wait_warm(self, timeout: float | None = None) -> None:
+        """Block until any in-flight background compile warmer finishes."""
+        self._warm_sched.wait(timeout)
 
     def diffuse_molecules(self):
         """Let molecules diffuse over the map and permeate membranes for
@@ -1334,6 +1396,8 @@ class World:
         state.pop("_col_prefetch", None)
         state["_mm_cache"] = None
         state["_cm_cache"] = None
+        # WarmScheduler pickles itself empty (thread handles are not
+        # picklable; warm state is runtime-local)
         # meshes/shardings/devices are bound to live runtimes — a restored
         # world re-resolves its device string; pass mesh= again (or
         # device_put) to re-shard
@@ -1367,6 +1431,8 @@ class World:
             self.use_pallas = False
         self.__dict__.setdefault("_mm_cache", None)
         self.__dict__.setdefault("_cm_cache", None)
+        if "_warm_sched" not in self.__dict__:
+            self._warm_sched = WarmScheduler()
         self.__dict__.setdefault("_mesh", None)
         self.__dict__.setdefault("_map_sharding", None)
         self.__dict__.setdefault("_cell_sharding", None)

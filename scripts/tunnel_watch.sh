@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Watch for the TPU tunnel to come back, then immediately run the full
+# measurement capture (scripts/capture_tpu_numbers.sh) once and exit.
+# The tunnel has been observed down for multi-hour stretches (see
+# BENCH_NOTES.md); probing every few minutes and capturing the moment it
+# returns maximizes the use of short up-windows.
+#
+#   bash scripts/tunnel_watch.sh [outdir] [probe_interval_s]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-logs/tpu-auto-$(date +%Y%m%d-%H%M%S)}"
+INTERVAL="${2:-300}"
+
+while true; do
+    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "$(date -Is) tunnel up — starting capture into $OUT"
+        bash scripts/capture_tpu_numbers.sh "$OUT"
+        exit $?
+    fi
+    echo "$(date -Is) tunnel down; next probe in ${INTERVAL}s"
+    sleep "$INTERVAL"
+done

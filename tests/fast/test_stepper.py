@@ -295,3 +295,47 @@ def test_pipelined_rejects_mesh_world():
     world = ms.World(chemistry=_chem(), map_size=32, seed=1, mesh=mesh)
     with pytest.raises(ValueError, match="mesh"):
         PipelinedStepper(world, mol_name="stp-atp")
+
+
+def test_empty_push_buffer_is_inert_and_capacity_proof():
+    """Pushless steps ride cached empty buffers; their OOB row sentinel
+    must stay out of bounds across ANY capacity growth (regression: a
+    capacity-sized sentinel built by the background warm thread racing a
+    growth could become in-bounds and silently zero a live cell's params
+    every step)."""
+    world = _world(seed=11, n_cells=40)
+    # thresholds that never fire: no kills, no divisions, no spawns —
+    # a step may still compact (identity permutation), so params must
+    # come back bit-identical if and only if the empty push is inert
+    st = PipelinedStepper(
+        world,
+        mol_name="stp-atp",
+        kill_below=-1.0,
+        divide_above=1e9,
+        lag=1,
+        auto_grow=False,  # a growth would legitimately reshape params
+    )
+    dense, rows = st._empty_push()
+    assert (np.asarray(dense) == 0).all()
+    assert (np.asarray(rows) == np.iinfo(np.int32).max).all()
+    before = [np.asarray(t).copy() for t in st.kin.params]
+    assert st._take_ride_push() is None  # nothing queued
+    for _ in range(2):
+        st.step()
+    st.drain()
+    after = [np.asarray(t) for t in st.kin.params]
+    for b, a in zip(before, after):
+        assert (b == a).all()
+
+
+def test_stepper_variant_keys_invalidate_on_token_growth():
+    world = _world(seed=5, n_cells=30)
+    st = PipelinedStepper(world, mol_name="stp-atp", lag=1)
+    st.step()
+    st.drain()
+    key = st._variant_key(1024, False)
+    st._warm_sched.mark(key)
+    assert st._warm_sched.is_warm(st._variant_key(1024, False))
+    # growing the protein capacity reshapes params: old keys must miss
+    st.kin.ensure_capacity(n_proteins=st.kin.max_proteins * 2)
+    assert not st._warm_sched.is_warm(st._variant_key(1024, False))

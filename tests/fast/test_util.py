@@ -142,3 +142,51 @@ def test_moore_pairs_native_matches_numpy():
         finally:
             eng.neighbor_pairs = orig
         assert native.tolist() == fallback.tolist(), (m, k)
+
+
+def test_warm_scheduler_generations_and_schedule():
+    import threading
+
+    from magicsoup_tpu.util import WarmScheduler
+
+    ws = WarmScheduler()
+    ws.mark(("a", 1))
+    assert ws.is_warm(("a", 1)) and not ws.is_warm(("b", 2))
+
+    done = []
+    gate = threading.Event()
+
+    def warm(k):
+        gate.wait(5)
+        done.append(k)
+
+    ws.schedule([("a", 1), ("b", 2)], warm)  # ("a",1) filtered out
+    # a reset mid-flight orphans the old generation: the background add
+    # must not mark the NEW set
+    ws.reset()
+    gate.set()
+    ws.wait(5)
+    assert done == [("b", 2)]
+    assert not ws.is_warm(("b", 2))
+    # post-reset scheduling works again
+    ws.schedule([("c", 3)], warm)
+    ws.wait(5)
+    assert ws.is_warm(("c", 3))
+
+
+def test_warm_scheduler_swallows_warm_failures():
+    from magicsoup_tpu.util import WarmScheduler
+
+    ws = WarmScheduler()
+
+    def boom(k):
+        raise RuntimeError("compile service down")
+
+    ws.schedule([("x",)], boom)
+    ws.wait(5)
+    assert not ws.is_warm(("x",))
+    # pickling drops runtime state
+    import pickle
+
+    ws2 = pickle.loads(pickle.dumps(ws))
+    assert not ws2.is_warm(("anything",))
