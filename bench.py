@@ -195,7 +195,7 @@ def _child_main(args: argparse.Namespace) -> None:
     dt = dt_classic = (time.perf_counter() - t0) / args.steps
 
     extra = {}
-    if not args.classic and not args.pallas:
+    if not args.classic:
         # The device-resident pipelined driver (magicsoup_tpu/stepper.py):
         # same canonical workload, selection and placement on device, host
         # genome bookkeeping replayed asynchronously — no device->host

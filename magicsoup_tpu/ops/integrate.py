@@ -36,8 +36,11 @@ Two numeric modes (the ``det`` static argument, default from
   :mod:`magicsoup_tpu.ops.detmath` (integer powers by square-and-multiply,
   fixed binary reduction trees), which produce bit-identical results on
   every IEEE backend — this is what the CPU-vs-TPU bit-reproducibility
-  check (`scripts/bitrepro.py`, BITREPRO.md) runs, and what the Pallas
-  kernel must use anyway (`reduce_prod` has no Mosaic lowering).
+  check (`scripts/bitrepro.py`, BITREPRO.md) runs.  (The Pallas kernel
+  runs the FAST mode with a ``mosaic_safe`` rewrite of the allosteric
+  factor — detmath's float64 accumulation has no Mosaic lowering, which
+  is also why ``use_pallas`` and deterministic mode are mutually
+  exclusive; see :mod:`magicsoup_tpu.ops.pallas_integrate`.)
 
 Both modes implement the same math; all hand-math golden tests run in both.
 """
@@ -166,11 +169,40 @@ def _multiply_signals(
     return xx, prots
 
 
+def _a_reg_logspace(X: jax.Array, A: jax.Array, Kmr: jax.Array) -> jax.Array:
+    """Allosteric activity ``prod_s(X^A / (X^A + Kmr))`` with BOTH the
+    float-exponent power and the signal product in exp-sum-log form —
+    the ``mosaic_safe`` variant of the regulation factor (Mosaic has no
+    lowering for ``pow``/``reduce_prod``; see
+    :mod:`magicsoup_tpu.ops.pallas_integrate`).  ``X^A`` saturates at
+    MAX instead of overflowing to Inf, so a zero concentration with A<0
+    yields MAX/(MAX+Kmr) ~ 1 — the reference's "inhibitor absent ->
+    fully active" NaN-scrub (kinetics.py:790-800) — and with A>0
+    underflows to 0/(0+Kmr) = 0."""
+    is_reg = A != 0
+    t = A.astype(jnp.float32) * _safe_log(X)[:, None, :]
+    xa = jnp.exp(jnp.minimum(t, jnp.log(MAX)))
+    r = xa / (xa + Kmr)
+    r = jnp.where(jnp.isnan(r), 1.0, r)
+    r = jnp.where(~is_reg, 1.0, r)
+    # product over signals; factors are in [0, 1] so log is safe with
+    # the same zero sentinel as the main product
+    lr = jnp.where(r > 0.0, jnp.log(r), LOG0)
+    return jnp.exp(jnp.sum(lr, axis=2))
+
+
 def _velocities(
-    X: jax.Array, Vmax: jax.Array, p: CellParams, det: bool = False
+    X: jax.Array,
+    Vmax: jax.Array,
+    p: CellParams,
+    det: bool = False,
+    mosaic_safe: bool = False,
 ) -> jax.Array:
     """Reversible-MM velocity with allosteric modulation
-    (reference kinetics.py:771-806)."""
+    (reference kinetics.py:771-806).  ``mosaic_safe`` (fast mode only)
+    swaps the regulation factor's ``pow``/``prod`` for the exp-sum-log
+    :func:`_a_reg_logspace` — the one sub-expression the Pallas kernel
+    cannot share with this path verbatim."""
     kf, f_prots = _multiply_signals(X, p.Nf, det)
     kf = _div(kf, p.Kmf, det)
     kf = jnp.where(f_prots, kf, 0.0)
@@ -185,14 +217,18 @@ def _velocities(
 
     # non-competitive regulation: X^A / (X^A + Kmr); A<0 inhibits,
     # A<0 with X=0 gives Inf/Inf=NaN -> inhibitor absent -> fully active
-    is_reg = p.A != 0
-    x_reg = jnp.where(is_reg, X[:, None, :], 0.0)
-    a_reg_s = _pow(x_reg, p.A, det)
-    a_reg_s = _div(a_reg_s, a_reg_s + p.Kmr, det)
-    a_reg_s = jnp.where(jnp.isnan(a_reg_s), 1.0, a_reg_s)
-    a_reg_s = jnp.where(~is_reg, 1.0, a_reg_s)
-    a_reg = _prod2(a_reg_s, det)  # (c,p)
-    a_reg = jnp.where(jnp.isinf(a_reg), MAX, a_reg)
+    if mosaic_safe:
+        assert not det, "mosaic_safe is a fast-mode rewrite"
+        a_reg = _a_reg_logspace(X, p.A, p.Kmr)
+    else:
+        is_reg = p.A != 0
+        x_reg = jnp.where(is_reg, X[:, None, :], 0.0)
+        a_reg_s = _pow(x_reg, p.A, det)
+        a_reg_s = _div(a_reg_s, a_reg_s + p.Kmr, det)
+        a_reg_s = jnp.where(jnp.isnan(a_reg_s), 1.0, a_reg_s)
+        a_reg_s = jnp.where(~is_reg, 1.0, a_reg_s)
+        a_reg = _prod2(a_reg_s, det)  # (c,p)
+        a_reg = jnp.where(jnp.isinf(a_reg), MAX, a_reg)
 
     V = a_cat * Vmax * a_reg
     return jnp.clip(V, MIN, MAX)
@@ -292,10 +328,16 @@ def _equilibrium_adjusted_x(
 
 
 def _integrate_part(
-    X0: jax.Array, adj_vmax: jax.Array, p: CellParams, det: bool = False
+    X0: jax.Array,
+    adj_vmax: jax.Array,
+    p: CellParams,
+    det: bool = False,
+    mosaic_safe: bool = False,
 ) -> jax.Array:
-    """One trim pass (reference kinetics.py:753-769)."""
-    V = _velocities(X0, adj_vmax, p, det)  # (c,p)
+    """One trim pass (reference kinetics.py:753-769).  The Pallas kernel
+    runs THIS function (``det=False, mosaic_safe=True``) so a fix to the
+    negative guard or the equilibrium correction applies to both paths."""
+    V = _velocities(X0, adj_vmax, p, det, mosaic_safe)  # (c,p)
     W = V * _negative_factors(X0, p.N, V, det)  # (c,p)
     X1 = _weighted_dx(X0, p.N, W, det)
     X1 = jnp.where(X1 < 0.0, 0.0, X1)  # small fp errors can give -1e-7

@@ -1,43 +1,47 @@
 """
 Pallas TPU kernel for the reversible-MM signal integrator.
 
-The jitted XLA integrator (:mod:`magicsoup_tpu.ops.integrate`) re-reads the
-five (cells, proteins, signals) parameter tensors from HBM for every one of
-the ~30 signal-product reductions in a step (3 trim passes x (velocities +
-4 equilibrium-correction iterations)).  This kernel tiles the cell axis and
-keeps one tile's parameters resident in VMEM for the WHOLE step, so HBM
-traffic drops from ~30x to ~1x the parameter bytes — the classic
-memory-bound fusion case from the Pallas playbook
+The jitted XLA integrator (:mod:`magicsoup_tpu.ops.integrate`) re-reads
+the five (cells, proteins, signals) parameter tensors from HBM across
+the many signal-product reductions in a step (3 trim passes x
+(velocities + 4 equilibrium-correction iterations)).  This kernel tiles
+the cell axis and keeps one tile's parameters resident in VMEM for the
+WHOLE step, so HBM traffic drops toward 1x the parameter bytes — the
+classic memory-bound fusion case from the Pallas playbook
 (`/opt/skills/guides/pallas_guide.md`, Memory Hierarchy).
 
-Math parity is by construction: the kernel body loads the tile into values
-and calls the exact same `_integrate_part` used by the XLA path.  One
-deliberate semantic delta: the equilibrium correction's early-stop flag
-(reference kinetics.py:846-847, a GLOBAL `torch.any` over the whole batch —
-i.e. in the reference a cell's result depends on which other cells are in
-the batch) is evaluated per cell TILE here, decoupling cells in different
-tiles.  That is strictly closer to the per-cell ideal the heuristic
-approximates; the XLA path keeps the batch-global flag for exact reference
-parity.
+**Kernel body = the FAST (log-space) numeric mode**, with the two
+primitives Mosaic cannot lower rewritten in closed form:
 
-Enable with ``MAGICSOUP_TPU_PALLAS=1`` (or call
-:func:`integrate_signals_pallas` directly).  `interpret=True` runs the
-kernel on CPU for tests.
+- ``prod_s(X^N)`` is already ``exp(sum_s N*logX)`` in fast mode
+  (:func:`magicsoup_tpu.ops.integrate._prod_pow`) — plain mul/sum/exp;
+- the allosteric ``X^A`` (float-exponent ``jnp.power``) and the product
+  over its signal factors become the same exp-sum-log form
+  (:func:`magicsoup_tpu.ops.integrate._a_reg_logspace`, selected by
+  ``_integrate_part(..., mosaic_safe=True)`` — the kernel body IS the
+  shared fast-mode trim pass), with saturation at ``MAX`` reproducing the
+  reference's Inf semantics (a zero inhibitor concentration -> factor 1,
+  a zero activator -> factor 0; reference kinetics.py:790-800).
 
-**Hardware status (2026-07-29, TPU v5e via remote Mosaic compile
-service):** OFF by default, and for now prove-or-drop resolves to
-"documented, not default".  Two successive blockers were found on real
-hardware: (1) ``reduce_prod`` has no Mosaic lowering — fixed by the
-fixed-tree `_prod_last` / `ipow` now shared with the deterministic XLA
-mode; (2) the remaining kernel body crashes the Mosaic compiler itself
-(``remote_compile: HTTP 500: tpu_compile_helper subprocess exit code 1``
-with no diagnostics; a trivial Pallas kernel compiles fine on the same
-chip, and the crash reproduces with just the `_multiply_signals`
-sub-kernel).  The fall-back XLA integrator measures 13 ms/step at
-benchmark shapes (16384 cells x 32 proteins x 28 signals) vs a ~0.4 ms
-1x-HBM-read bound, so a working kernel remains worth ~12 ms/step of
-device time — relevant once steps are not dominated by host round-trip
-latency (see performance/README.md).
+History: the round-2 kernel used the DETERMINISTIC body (fixed-tree
+products) because ``reduce_prod``/``pow`` have no Mosaic lowering — but
+that body accumulates in float64 (`ops/detmath.py`), which the remote
+Mosaic compiler crashed on with no diagnostics (HTTP 500; XLA emulates
+f64 on TPU, Mosaic does not).  The fast-mode body is f32 end to end.
+`performance/pallas_bisect.py` is the rung-by-rung ladder that isolates
+each lowering hypothesis on hardware; run it after any platform update.
+
+One deliberate semantic delta vs the XLA path, unchanged from round 2:
+the equilibrium correction's early-stop flag (reference
+kinetics.py:846-847, a GLOBAL ``torch.any`` over the whole batch) is
+evaluated per cell TILE here, decoupling cells in different tiles —
+strictly closer to the per-cell ideal the heuristic approximates.  The
+XLA path keeps the batch-global flag for exact reference parity, which
+is why the kernel is opt-in (``World(use_pallas=True)`` /
+``MAGICSOUP_TPU_PALLAS=1``) and why sharded steps (no partitioning rule
+for ``pallas_call``) always use the XLA path.
+
+``interpret=True`` runs the kernel on CPU for tests.
 """
 import functools
 import math
@@ -46,7 +50,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from magicsoup_tpu.ops.integrate import TRIM_FACTORS, CellParams, _integrate_part
+from magicsoup_tpu.ops.integrate import (
+    TRIM_FACTORS,
+    CellParams,
+    _integrate_part,
+)
 
 
 def _kernel(
@@ -75,10 +83,11 @@ def _kernel(
     )
     X = x_ref[:]
     for trim in TRIM_FACTORS:
-        # det=True: reduce_prod/pow have no Mosaic lowering; the
-        # deterministic fixed-tree/square-and-multiply forms lower
+        # the SHARED fast-mode trim pass with the one Mosaic-safe
+        # sub-expression swap — fixes to the integrator apply here too
         X = _integrate_part(
-            X, jnp.clip(params.Vmax * trim, min=0.0), params, det=True
+            X, jnp.clip(params.Vmax * trim, min=0.0), params,
+            det=False, mosaic_safe=True,
         )
     out_ref[:] = X
 
@@ -95,7 +104,7 @@ def integrate_signals_pallas(
 ) -> jax.Array:
     """
     Pallas-tiled equivalent of
-    :func:`magicsoup_tpu.ops.integrate.integrate_signals`.
+    :func:`magicsoup_tpu.ops.integrate.integrate_signals` (fast mode).
 
     ``tile_c`` is the number of cells per grid step (must divide the cell
     capacity; defaults to 128 or the whole batch if smaller).  VMEM per

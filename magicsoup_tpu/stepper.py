@@ -203,7 +203,9 @@ def _place_global(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("det", "max_div", "n_rounds", "compact", "q"),
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+    ),
 )
 def _pipeline_step(
     state: DeviceState,
@@ -228,6 +230,7 @@ def _pipeline_step(
     n_rounds: int,
     compact: bool,
     q: int | None = None,
+    use_pallas: bool = False,
 ) -> tuple[DeviceState, CellParams, StepOutputs]:
     """One fused workload step (spawn -> activity -> select -> kill ->
     divide -> degrade/diffuse/permeate [-> compact]) — a single dispatch,
@@ -288,9 +291,15 @@ def _pipeline_step(
     xs_q, ys_q = pos[:q, 0], pos[:q, 1]
     ext = mm[:, xs_q, ys_q].T  # (q, mols)
     params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
-    X1 = _integrate_signals_jit(
-        jnp.concatenate([cm[:q], ext], axis=1), params_q, det
-    )
+    X0q = jnp.concatenate([cm[:q], ext], axis=1)
+    if use_pallas:
+        from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+        X1 = integrate_signals_pallas(
+            X0q, params_q, interpret=jax.default_backend() != "tpu"
+        )
+    else:
+        X1 = _integrate_signals_jit(X0q, params_q, det)
     alive_q = alive[:q, None]
     cm = jax.lax.dynamic_update_slice_in_dim(
         cm, jnp.where(alive_q, X1[:, :n_mols], cm[:q]), 0, axis=0
@@ -717,6 +726,7 @@ class PipelinedStepper:
             n_rounds=self.n_rounds,
             compact=compact,
             q=q,
+            use_pallas=self.world.use_pallas,
         )
         self._note_warm(q, compact)
         for arr in out:
@@ -1077,7 +1087,11 @@ class PipelinedStepper:
         call it explicitly (plus :meth:`wait_warm`) before a timing
         window so no remote compile can land inside it."""
         if q is None:
-            q = quantize_rows(self._n_rows + 1, self._cap)
+            # the NEXT rung above the one the current population uses —
+            # warming the current rung would be a no-op (it compiled when
+            # first dispatched)
+            cur = quantize_rows(self._n_rows, self._cap)
+            q = quantize_rows(cur + 1, self._cap) if cur < self._cap else cur
         spawn_dense, spawn_valid = self._empty_spawn()
         push_dense, push_rows = self._empty_push()
         _pipeline_step(
@@ -1102,6 +1116,7 @@ class PipelinedStepper:
             n_rounds=self.n_rounds,
             compact=compact,
             q=q,
+            use_pallas=self.world.use_pallas,
         )
 
     def _variant_key(self, q: int, compact: bool) -> tuple:

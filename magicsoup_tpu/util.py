@@ -141,6 +141,7 @@ class WarmScheduler:
 
     def __init__(self):
         self._warm: set = set()
+        self._pending: list = []  # (key, warm_fn) awaiting the bg thread
         self._thread = None
 
     def is_warm(self, key) -> bool:
@@ -151,39 +152,76 @@ class WarmScheduler:
         self._warm.add(key)
 
     def schedule(self, keys, warm_fn) -> None:
-        """Warm the not-yet-compiled ``keys`` via ``warm_fn(key)`` in a
-        background thread (skipped while a previous batch is in flight —
-        dropped keys are re-offered on the next call)."""
-        todo = [k for k in keys if k not in self._warm]
-        if not todo:
-            return
+        """Queue the not-yet-compiled ``keys`` for ``warm_fn(key)`` on
+        the background thread.  Keys arriving while a batch is already
+        in flight are APPENDED to the same queue, not dropped —
+        :meth:`wait` must be able to guarantee that everything scheduled
+        before it is compiled when it returns (bench.py relies on that
+        to keep remote compiles out of measured windows)."""
+        queued = {k for k, _ in self._pending}
+        new = [k for k in keys if k not in self._warm and k not in queued]
+        if new:
+            self._pending.extend((k, warm_fn) for k in new)
+        self._kick()
+
+    def _kick(self) -> None:
         t = self._thread
-        if t is not None and t.is_alive():
+        if not self._pending or (t is not None and t.is_alive()):
             return
         import threading
 
-        warm_set = self._warm  # capture THIS generation
+        warm_set = self._warm  # capture THIS generation...
+        pending = self._pending  # ...and THIS generation's queue
 
         def _bg():
-            for k in todo:
+            while True:
                 try:
-                    warm_fn(k)
-                except Exception:  # a failed warm only loses the win
+                    k, fn = pending.pop(0)
+                except IndexError:
                     return
+                try:
+                    fn(k)
+                except Exception:
+                    # a failed warm only loses ITS OWN win — keys queued
+                    # behind it must still run, or wait() would return
+                    # with wanted variants cold
+                    continue
                 warm_set.add(k)
 
         self._thread = threading.Thread(target=_bg, daemon=True)
         self._thread.start()
 
     def wait(self, timeout: float | None = None) -> None:
-        """Block until any in-flight background warm batch finishes."""
-        t = self._thread
-        if t is not None and t.is_alive():
-            t.join(timeout)
+        """Block until every scheduled warm has run (or failed): joins
+        the in-flight batch AND any keys queued behind it, re-kicking
+        the worker if it exited between a pop and a late schedule()."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            t = self._thread
+            alive = t is not None and t.is_alive()
+            if not alive and not self._pending:
+                return
+            if not alive:
+                self._kick()
+                t = self._thread
+                if t is None:
+                    return
+            remaining = (
+                None if deadline is None else deadline - _time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                return
+            t.join(remaining)
 
     def reset(self) -> None:
-        """Start a new generation (array shapes changed)."""
+        """Start a new generation (array shapes changed).  The old
+        generation's queue is orphaned with its set: an in-flight batch
+        keeps draining it harmlessly, and nothing it marks can leak into
+        the new generation."""
         self._warm = set()
+        self._pending = []
 
     # pickling: thread handles are not picklable and warm state is
     # runtime-local — a restored scheduler starts cold
@@ -192,6 +230,7 @@ class WarmScheduler:
 
     def __setstate__(self, state: dict) -> None:
         self._warm = set()
+        self._pending = []
         self._thread = None
 
 

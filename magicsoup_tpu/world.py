@@ -1221,7 +1221,13 @@ class World:
         if self._cell_sharding is not None or self.n_cells == 0:
             return
         if q is None:
-            q = quantize_rows(self.n_cells + 1, self._capacity)
+            # the NEXT rung above the one the current population uses
+            cur = quantize_rows(self.n_cells, self._capacity)
+            q = (
+                quantize_rows(cur + 1, self._capacity)
+                if cur < self._capacity
+                else cur
+            )
         args = (
             self._molecule_map,
             self._cell_molecules,

@@ -1,0 +1,255 @@
+"""
+Mosaic-crash bisection ladder for the Pallas integrator kernel.
+
+Round-2 finding (`magicsoup_tpu/ops/pallas_integrate.py` docstring): the
+det-mode kernel body crashes the remote Mosaic compiler with no
+diagnostics.  Hypotheses, each isolated as one rung of this ladder:
+
+  1. the det-mode body pulls in FLOAT64 (detmath accumulates in f64 —
+     TPU emulates f64 in XLA, Mosaic likely cannot);
+  2. i16 parameter loads inside the kernel (TPU vregs are 32-bit);
+  3. `jnp.power` with float exponents has no Mosaic lowering
+     (already observed for `reduce_prod`);
+  4. everything else (exp/log/sum/min/div) lowers fine, so a FAST-mode
+     (log-space) kernel body with `pow`/`prod` rewritten as
+     exp-sum-log / unrolled multiply chains should compile.
+
+Run on the TPU attachment (takes ~a minute per rung, mostly remote
+compile):
+
+    python performance/pallas_bisect.py            # all rungs
+    python performance/pallas_bisect.py --rungs 1,2,9,10
+
+Each rung compiles + runs + value-fetches; a Mosaic crash surfaces as a
+Python exception from the compile service, so failures are caught and
+the ladder continues.  Results print one line per rung.
+"""
+import argparse
+import sys
+import time
+import traceback
+from functools import partial, reduce
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=256)
+    ap.add_argument("--proteins", type=int, default=8)
+    ap.add_argument("--signals", type=int, default=12)
+    ap.add_argument("--tile-c", type=int, default=128)
+    ap.add_argument("--rungs", type=str, default=None,
+                    help="comma-separated rung numbers (default: all)")
+    ap.add_argument("--interpret", action="store_true",
+                    help="interpret mode (CPU smoke test of the ladder"
+                         " itself; lowering hypotheses need hardware)")
+    args = ap.parse_args()
+    if args.interpret:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from magicsoup_tpu.constants import MAX
+    from magicsoup_tpu.ops.integrate import (
+        CellParams,
+        INT_PARAM_DTYPE,
+        TRIM_FACTORS,
+        _safe_log,
+    )
+
+    c, p, s, tc = args.cells, args.proteins, args.signals, args.tile_c
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.uniform(0, 4, (c, s)).astype(np.float32))
+    int_np = np.dtype(INT_PARAM_DTYPE.dtype.name)
+    params = CellParams(
+        Ke=jnp.asarray(rng.uniform(0.1, 10, (c, p)).astype(np.float32)),
+        Kmf=jnp.asarray(rng.uniform(0.1, 10, (c, p)).astype(np.float32)),
+        Kmb=jnp.asarray(rng.uniform(0.1, 10, (c, p)).astype(np.float32)),
+        Kmr=jnp.asarray(rng.uniform(0.1, 10, (c, p, s)).astype(np.float32)),
+        Vmax=jnp.asarray(rng.uniform(0, 2, (c, p)).astype(np.float32)),
+        N=jnp.asarray(rng.integers(-2, 3, (c, p, s)).astype(int_np)),
+        Nf=jnp.asarray(rng.integers(0, 3, (c, p, s)).astype(int_np)),
+        Nb=jnp.asarray(rng.integers(0, 3, (c, p, s)).astype(int_np)),
+        A=jnp.asarray(rng.integers(-2, 3, (c, p, s)).astype(int_np)),
+    )
+
+    cp_ = lambda i: (i, 0)  # noqa: E731
+    cps = lambda i: (i, 0, 0)  # noqa: E731
+    bs_cs = pl.BlockSpec((tc, s), cp_)
+    bs_cp = pl.BlockSpec((tc, p), cp_)
+    bs_cps = pl.BlockSpec((tc, p, s), cps)
+
+    def call(kernel, ins, specs, out_shape=None):
+        out_shape = out_shape or jax.ShapeDtypeStruct((c, s), jnp.float32)
+        fn = pl.pallas_call(
+            kernel,
+            grid=(c // tc,),
+            in_specs=specs,
+            out_specs=pl.BlockSpec(
+                out_shape.shape[1:] and (tc,) + out_shape.shape[1:]
+                or (tc,), lambda i: (i,) + (0,) * (len(out_shape.shape) - 1)
+            ),
+            out_shape=out_shape,
+            interpret=args.interpret,
+        )
+        out = fn(*ins)
+        np.asarray(out)  # value fetch = true barrier
+        return out
+
+    # ---- kernel bodies ------------------------------------------------
+
+    def k_copy(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 1.000001
+
+    def k_i16_load(x_ref, n_ref, o_ref):
+        o_ref[:] = x_ref[:] + jnp.sum(
+            n_ref[:].astype(jnp.float32), axis=1
+        )
+
+    def k_explog(x_ref, o_ref):
+        o_ref[:] = jnp.exp(jnp.log(x_ref[:] + 1.0)) - 1.0
+
+    def k_reduce_sum(x_ref, n_ref, o_ref):
+        # sum over signals of N*logX -> (tc, p); write back broadcast
+        e = jnp.sum(
+            n_ref[:].astype(jnp.float32) * _safe_log(x_ref[:])[:, None, :],
+            axis=2,
+        )
+        o_ref[:] = e
+
+    def k_prod_pow(x_ref, n_ref, o_ref):
+        e = jnp.sum(
+            n_ref[:].astype(jnp.float32) * _safe_log(x_ref[:])[:, None, :],
+            axis=2,
+        )
+        xx = jnp.exp(e)
+        o_ref[:] = jnp.where(jnp.isinf(xx), MAX, xx)
+
+    def k_float_pow(x_ref, a_ref, o_ref):
+        # EXPECTED to crash per round-2 notes: jnp.power w/ float exponent
+        o_ref[:] = jnp.sum(
+            jnp.power(
+                x_ref[:][:, None, :] + 1.0, a_ref[:].astype(jnp.float32)
+            ),
+            axis=2,
+        )
+
+    def k_unrolled_prod(x_ref, o_ref):
+        cols = [x_ref[:][:, i] for i in range(s)]
+        o_ref[:] = reduce(lambda u, v: u * v, cols)[:, None] + 0.0 * x_ref[:]
+
+    def k_reduce_prod(x_ref, o_ref):
+        # EXPECTED to crash per round-2 notes (no Mosaic lowering)
+        o_ref[:] = jnp.prod(x_ref[:], axis=1, keepdims=True) + 0.0 * x_ref[:]
+
+    def unpack(refs):
+        (x_ref, ke, kmf, kmb, kmr, vmax, n, nf, nb, a) = refs
+        q = CellParams(
+            Ke=ke[:], Kmf=kmf[:], Kmb=kmb[:], Kmr=kmr[:], Vmax=vmax[:],
+            N=n[:], Nf=nf[:], Nb=nb[:], A=a[:],
+        )
+        return x_ref[:], q
+
+    def k_velocities(*refs):
+        # the SHARED mosaic_safe velocity body (the same code the real
+        # kernel runs), so a FAIL here indicts production code, not a
+        # drifting copy
+        from magicsoup_tpu.ops.integrate import _velocities
+
+        o_ref = refs[-1]
+        X_, q = unpack(refs[:-1])
+        V = _velocities(X_, q.Vmax, q, det=False, mosaic_safe=True)
+        o_ref[:] = X_ + jnp.sum(
+            q.N.astype(jnp.float32) * V[:, :, None], axis=1
+        )
+
+    def k_full_part(*refs):
+        o_ref = refs[-1]
+        X_, q = unpack(refs[:-1])
+        from magicsoup_tpu.ops.integrate import _integrate_part
+
+        o_ref[:] = _integrate_part(
+            X_, jnp.clip(q.Vmax * 0.7, min=0.0), q,
+            det=False, mosaic_safe=True,
+        )
+
+    def k_full_3trim(*refs):
+        o_ref = refs[-1]
+        X_, q = unpack(refs[:-1])
+        from magicsoup_tpu.ops.integrate import _integrate_part
+
+        Y = X_
+        for trim in TRIM_FACTORS:
+            Y = _integrate_part(
+                Y, jnp.clip(q.Vmax * trim, min=0.0), q,
+                det=False, mosaic_safe=True,
+            )
+        o_ref[:] = Y
+
+    full_ins = [X, params.Ke, params.Kmf, params.Kmb, params.Kmr,
+                params.Vmax, params.N, params.Nf, params.Nb, params.A]
+    full_specs = [bs_cs, bs_cp, bs_cp, bs_cp, bs_cps, bs_cp,
+                  bs_cps, bs_cps, bs_cps, bs_cps]
+
+    rungs = {
+        1: ("copy (known-good baseline)", lambda: call(
+            k_copy, [X], [bs_cs])),
+        2: ("i16 load + cast + sum", lambda: call(
+            k_i16_load, [X, params.N], [bs_cs, bs_cps])),
+        3: ("exp/log elementwise", lambda: call(
+            k_explog, [X], [bs_cs])),
+        4: ("reduce_sum over signals (log-space core)", lambda: call(
+            k_reduce_sum, [X, params.N], [bs_cs, bs_cps],
+            jax.ShapeDtypeStruct((c, p), jnp.float32))),
+        5: ("full _prod_pow (exp-sum-log)", lambda: call(
+            k_prod_pow, [X, params.N], [bs_cs, bs_cps],
+            jax.ShapeDtypeStruct((c, p), jnp.float32))),
+        6: ("jnp.power float exponent (expected crash)", lambda: call(
+            k_float_pow, [X, params.A], [bs_cs, bs_cps],
+            jax.ShapeDtypeStruct((c, p), jnp.float32))),
+        7: ("unrolled multiply-chain prod", lambda: call(
+            k_unrolled_prod, [X], [bs_cs])),
+        8: ("jnp.prod reduce (expected crash)", lambda: call(
+            k_reduce_prod, [X], [bs_cs])),
+        9: ("fast-mode velocities body", lambda: call(
+            k_velocities, full_ins, full_specs)),
+        10: ("fast-mode full trim pass", lambda: call(
+            k_full_part, full_ins, full_specs)),
+        11: ("fast-mode full 3-trim kernel", lambda: call(
+            k_full_3trim, full_ins, full_specs)),
+    }
+
+    picks = (
+        sorted(int(r) for r in args.rungs.split(","))
+        if args.rungs else sorted(rungs)
+    )
+    print(f"devices: {jax.devices()}", flush=True)
+    results = {}
+    for r in picks:
+        name, fn = rungs[r]
+        t0 = time.perf_counter()
+        try:
+            fn()
+            results[r] = "OK"
+            print(f"rung {r:2d} OK    {time.perf_counter()-t0:6.1f}s  {name}",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            results[r] = "FAIL"
+            head = str(e).splitlines()[0][:160] if str(e) else repr(e)[:160]
+            print(f"rung {r:2d} FAIL  {time.perf_counter()-t0:6.1f}s  {name}"
+                  f"\n        {head}", flush=True)
+            if r in (9, 10, 11):
+                traceback.print_exc(limit=3)
+    print("summary:", " ".join(f"{r}:{v}" for r, v in results.items()),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -178,15 +178,47 @@ def test_warm_scheduler_swallows_warm_failures():
     from magicsoup_tpu.util import WarmScheduler
 
     ws = WarmScheduler()
+    done = []
 
     def boom(k):
-        raise RuntimeError("compile service down")
+        if k == ("x",):
+            raise RuntimeError("compile service down")
+        done.append(k)
 
-    ws.schedule([("x",)], boom)
+    # a failed warm loses only its own win: keys queued behind it run
+    ws.schedule([("x",), ("y",)], boom)
     ws.wait(5)
     assert not ws.is_warm(("x",))
+    assert ws.is_warm(("y",)) and done == [("y",)]
     # pickling drops runtime state
     import pickle
 
     ws2 = pickle.loads(pickle.dumps(ws))
     assert not ws2.is_warm(("anything",))
+
+
+def test_warm_scheduler_queues_while_busy():
+    """Keys scheduled while a batch is in flight must be appended, not
+    dropped — wait() guarantees everything scheduled before it has run
+    (regression: a q-rung crossing during bench warmup used to lose its
+    prewarm and pay the compile inside the measured window)."""
+    import threading
+
+    from magicsoup_tpu.util import WarmScheduler
+
+    ws = WarmScheduler()
+    gate = threading.Event()
+    done = []
+
+    def warm(k):
+        if k == ("slow",):
+            gate.wait(5)
+        done.append(k)
+
+    ws.schedule([("slow",)], warm)
+    ws.schedule([("late-1",), ("late-2",)], warm)  # bg busy on ("slow",)
+    ws.schedule([("late-1",)], warm)  # duplicate: must not double-queue
+    gate.set()
+    ws.wait(10)
+    assert done == [("slow",), ("late-1",), ("late-2",)]
+    assert all(ws.is_warm(k) for k in done)
