@@ -216,20 +216,44 @@ def _child_main(args: argparse.Namespace) -> None:
             st.step()
         st.drain()
         st.wait_warm()
+        st.trace.clear()
         t0 = time.perf_counter()
         n_pipe = args.steps * 4
         for _ in range(n_pipe):
             st.step()
         st.drain()  # all outputs arrived + replayed
         dt_pipe = (time.perf_counter() - t0) / n_pipe
+        trace = list(st.trace)
         st.flush()
         extra = {
             "classic_steps_per_s": round(1.0 / dt, 4),
+            "pipelined_steps_per_s": round(1.0 / dt_pipe, 4),
             "pipeline_stats": {
                 k: int(v) for k, v in st.stats.items()
             },
         }
-        dt = dt_pipe
+        if trace:
+            # per-step diagnosis to stderr: where a slow window's time
+            # went (cold compiles / blocked fetches / dispatch overhead)
+            tt = sorted(t["t"] for t in trace)
+            mid = tt[len(tt) // 2]
+            p90 = tt[int(len(tt) * 0.9)]
+            sys.stderr.write(
+                f"[trace] steps={len(trace)} t_med={mid*1e3:.1f}ms"
+                f" t_p90={p90*1e3:.1f}ms t_max={tt[-1]*1e3:.1f}ms"
+                f" cold_dispatches={sum(t['cold'] for t in trace)}"
+                f" compactions={sum(t['compact'] for t in trace)}"
+                f" fetch_s={sum(t['fetch'] for t in trace):.2f}"
+                f" dispatch_s={sum(t['dispatch'] for t in trace):.2f}"
+                f" total_s={sum(t['t'] for t in trace):.2f}\n"
+            )
+            slow = [t for t in trace if t["t"] > 3 * mid]
+            for t in slow[:8]:
+                sys.stderr.write(f"[trace-slow] {t}\n")
+        # headline = the faster driver of the same workload (both are
+        # reported; the pipelined driver exists to beat the serial loop,
+        # but must never hide a regression behind it)
+        dt = min(dt_pipe, dt)
 
     steps_per_s = 1.0 / dt
     mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")

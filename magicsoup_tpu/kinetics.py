@@ -516,13 +516,28 @@ class Kinetics:
         dense_pad = np.zeros((b_pad,) + dense.shape[1:], dtype=dense.dtype)
         dense_pad[:b] = dense
         idxs = pad_idxs(cell_idxs, oob=self.max_cells)
-        self.params = compute_and_scatter_params(
-            self.params,
-            jnp.asarray(dense_pad),
-            self.tables,
-            self._abs_temp_arr,
-            jnp.asarray(idxs),
-        )
+        # Bound the per-dispatch batch: the assembly program materializes
+        # several (b, p, d, s) temps, and one giant batch (the initial
+        # 40k-cell spawn pads to 65536 rows = ~1.9 GB PER temp at
+        # benchmark capacities) OOMs the device at buffer assignment.
+        # Chunks of one fixed pow2 size compile once and stream through.
+        chunk = self._assembly_chunk()
+        for i in range(0, b_pad, chunk):
+            self.params = compute_and_scatter_params(
+                self.params,
+                jnp.asarray(dense_pad[i : i + chunk]),
+                self.tables,
+                self._abs_temp_arr,
+                jnp.asarray(idxs[i : i + chunk]),
+            )
+
+    def _assembly_chunk(self) -> int:
+        """Largest pow2 batch whose (b, p, d, s) i32 assembly temps stay
+        ~<= 256 MB each — big batches stream through in chunks of one
+        compiled shape instead of OOMing buffer assignment."""
+        per_row = max(self.max_proteins * self.max_doms * self.n_signals, 1)
+        chunk = 1 << max((2**26 // per_row).bit_length() - 1, 0)
+        return max(_IDX_BLOCK, chunk)
 
     def set_cell_params(
         self,

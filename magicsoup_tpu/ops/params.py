@@ -66,6 +66,12 @@ def quantize_rows(n: int, cap: int, quantum: int = ROW_QUANTUM) -> int:
     return min(cap, max(quantum, -(-n // quantum) * quantum))
 
 
+def next_rung(rung: int, cap: int) -> int:
+    """The row-ladder rung above ``rung`` (== ``rung`` at the cap) — the
+    one rule for 'warm one rung ahead', shared by every prewarm site."""
+    return quantize_rows(rung + 1, cap) if rung < cap else rung
+
+
 # minimum padded length for index batches.  Every distinct padded length
 # is a separate compile of the program consuming it, and on this platform
 # compiles go through a remote compile service at seconds each — one

@@ -61,6 +61,7 @@ from magicsoup_tpu.ops.params import (
     compact_rows,
     compute_cell_params,
     copy_params,
+    next_rung,
     permute_params,
     quantize_rows,
     scatter_params,
@@ -81,17 +82,43 @@ _MOORE_DY = np.asarray([-1, 0, 1, -1, 1, -1, 0, 1], dtype=np.int32)
 
 
 class StepOutputs(NamedTuple):
-    """The per-step device->host record (a few tens of KB)."""
+    """The per-step device->host record, as host numpy after unpacking.
 
-    kill: jax.Array  # (cap,) bool — rows killed this step
-    parents: jax.Array  # (max_div,) i32 rows that divided (cap = none)
-    child_pos: jax.Array  # (max_div, 2) i32 child pixels
-    n_placed: jax.Array  # i32 — number of successful divisions
-    n_candidates: jax.Array  # i32 — division candidates before clamps
-    spawn_ok: jax.Array  # (b_spawn,) bool — which queued spawns landed
-    spawn_pos: jax.Array  # (b_spawn, 2) i32 spawn pixels
-    n_rows: jax.Array  # i32 — high-water row count after the step
-    n_alive: jax.Array  # i32 — live cells after the step
+    On device the whole record is PACKED into one i32 vector
+    (:func:`_pack_bits` + concatenate) so the replay costs exactly ONE
+    device->host transfer — on a remote accelerator each separate fetch
+    is a full tunnel round trip (~60-100 ms), and the round-2 layout
+    (eight arrays) put ~8 RTTs on every replayed step."""
+
+    kill: Any  # (cap,) bool — rows killed this step
+    parents: Any  # (max_div,) i32 rows that divided (cap = none)
+    child_pos: Any  # (max_div, 2) i32 child pixels
+    n_placed: int  # number of successful divisions
+    n_candidates: int  # division candidates before the budget clamp
+    n_attempted: int  # candidates after the budget clamp (cost payers)
+    spawn_ok: Any  # (b_spawn,) bool — which queued spawns landed
+    spawn_pos: Any  # (b_spawn, 2) i32 spawn pixels
+    n_rows: int  # high-water row count after the step
+    n_alive: int  # live cells after the step
+
+
+_BITS = 16  # bits packed per i32 word (16 keeps every value positive)
+
+
+def _pack_bits(b: jax.Array) -> jax.Array:
+    """(n,) bool -> ceil(n/16) i32 words (little-endian bit order)."""
+    n = b.shape[0]
+    pad = (-n) % _BITS
+    if pad:
+        b = jnp.concatenate([b, jnp.zeros(pad, dtype=bool)])
+    w = b.reshape(-1, _BITS).astype(jnp.int32)
+    return jnp.sum(w << jnp.arange(_BITS, dtype=jnp.int32)[None, :], axis=1)
+
+
+def _unpack_bits(words: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of :func:`_pack_bits` on host numpy."""
+    bits = (words.astype(np.int64)[:, None] >> np.arange(_BITS)) & 1
+    return bits.reshape(-1)[:n].astype(bool)
 
 
 class DeviceState(NamedTuple):
@@ -325,6 +352,7 @@ def _pipeline_step(
     n_candidates = cand.sum(dtype=jnp.int32)
     budget = jnp.minimum(jnp.minimum(max_div, div_budget), q - n_rows)
     cand = cand & ((jnp.cumsum(cand) - 1) < budget)
+    n_attempted = cand.sum(dtype=jnp.int32)
     # every attempting candidate pays the division cost, whether or not a
     # free pixel is found — exactly the canonical workload's order
     # (performance/workload.py:69-75 subtracts before divide_cells)
@@ -374,16 +402,24 @@ def _pipeline_step(
         alive = rows < n_keep
         n_rows = n_keep
 
-    out = StepOutputs(
-        kill=kill,
-        parents=p_idx,
-        child_pos=child_pos_out,
-        n_placed=n_placed,
-        n_candidates=n_candidates,
-        spawn_ok=spawn_ok,
-        spawn_pos=spawn_pos,
-        n_rows=n_rows,
-        n_alive=alive.sum(dtype=jnp.int32),
+    # one packed i32 output vector = one device->host transfer per replay
+    out = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    n_placed,
+                    n_candidates,
+                    n_attempted,
+                    n_rows,
+                    alive.sum(dtype=jnp.int32),
+                ]
+            ).astype(jnp.int32),
+            _pack_bits(kill),
+            p_idx,
+            child_pos_out.reshape(-1).astype(jnp.int32),
+            _pack_bits(spawn_ok),
+            spawn_pos.reshape(-1).astype(jnp.int32),
+        ]
     )
     new_state = DeviceState(
         mm=mm, cm=cm, pos=pos, occ=occ, alive=alive, n_rows=n_rows, key=key
@@ -413,7 +449,7 @@ def _compact_program(
 class _Pending(NamedTuple):
     """One dispatched step awaiting host replay."""
 
-    out: StepOutputs
+    out: jax.Array  # packed i32 output vector (see StepOutputs)
     spawn_genomes: list  # genomes queued into this dispatch (b_spawn order)
     spawn_labels: list
     compacted: bool
@@ -516,7 +552,8 @@ class PipelinedStepper:
             "compactions": 0,
             "growths": 0,
             "divisions": 0,
-            "division_drops": 0,
+            "division_drops": 0,  # budget clamps (a pipeline delta)
+            "division_blocked": 0,  # no free Moore pixel (classic too)
             "kills": 0,
             "spawned": 0,
             "spawn_drops": 0,
@@ -537,6 +574,9 @@ class PipelinedStepper:
         self._abs_temp_dev = jnp.asarray(world.abs_temp, dtype=jnp.float32)
 
         self._rng = np.random.default_rng(world._rng.randrange(2**63))
+        self.trace: list[dict] = []  # per-step timing/diagnostic records
+        self._fetch_acc = 0.0  # seconds spent blocked on output fetches
+        self._budget_cache: dict[int, jax.Array] = {}
         self._pending: list[_Pending] = []
         self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
         # deferred pushes: (genomes, rows, change seq) held while a
@@ -611,6 +651,10 @@ class PipelinedStepper:
 
     def step(self) -> None:
         """Dispatch one workload step and replay any arrived outputs."""
+        import time as _time
+
+        t_start = _time.perf_counter()
+        fetch0 = self._fetch_acc
         if self._needs_attach:
             # after a flush the World may have been advanced/mutated with
             # the classic API; re-pulling its state here (cheap: the
@@ -698,12 +742,20 @@ class PipelinedStepper:
         # skips the dead tail.  The division budget is adaptive (recent
         # demand x2) so the bound stays tight; genuine demand spikes clamp
         # for one step, are counted as drops, and raise the next estimate.
-        div_budget = int(min(self.max_divisions, 2 * g_est + 64))
+        # quantized to 64 so the per-step scalar upload hits a small cache
+        # of device constants instead of paying its own transfer each step
+        div_budget = int(min(self.max_divisions, -(-(2 * g_est + 64) // 64) * 64))
+        dev_budget = self._budget_cache.get(div_budget)
+        if dev_budget is None:
+            dev_budget = jnp.asarray(div_budget, dtype=jnp.int32)
+            self._budget_cache[div_budget] = dev_budget
         upper = self._n_rows + div_budget + len(spawn)
         for p in self._pending:
             upper += p.div_budget + len(p.spawn_genomes)
         q = quantize_rows(upper, self._cap)
 
+        cold = not self._warm_sched.is_warm(self._variant_key(q, compact))
+        t_dispatch0 = _time.perf_counter()
         self._state, self.kin.params, out = _pipeline_step(
             self._state,
             self.kin.params,
@@ -714,7 +766,7 @@ class PipelinedStepper:
             self._kill_below_dev,
             self._divide_above_dev,
             self._divide_cost_dev,
-            jnp.asarray(div_budget, dtype=jnp.int32),
+            dev_budget,
             spawn_dense,
             spawn_valid,
             push_dense,
@@ -728,12 +780,12 @@ class PipelinedStepper:
             q=q,
             use_pallas=self.world.use_pallas,
         )
+        t_dispatched = _time.perf_counter()
         self._note_warm(q, compact)
-        for arr in out:
-            try:
-                arr.copy_to_host_async()
-            except AttributeError:
-                pass
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
         self._pending.append(
             _Pending(
                 out=out,
@@ -750,6 +802,25 @@ class PipelinedStepper:
             self._compact_outstanding = True
         self.stats["steps"] += 1
         self._drain(block=False)
+        # per-step trace: ~100 B of host bookkeeping that makes a slow
+        # hardware window self-diagnosing (bench.py summarises to stderr);
+        # bounded so an unbounded simulation loop cannot leak host memory
+        t_end = _time.perf_counter()
+        if len(self.trace) >= 4096:
+            del self.trace[:2048]
+        self.trace.append(
+            {
+                "t": t_end - t_start,
+                "dispatch": t_dispatched - t_dispatch0,
+                "fetch": self._fetch_acc - fetch0,
+                "q": q,
+                "cold": cold,
+                "compact": compact,
+                "push": 0 if ride is None else len(ride[1]),
+                "spawn": len(spawn),
+                "pend": len(self._pending),
+            }
+        )
 
     # -------------------------------------------------------------- #
     # replay side                                                    #
@@ -769,9 +840,38 @@ class PipelinedStepper:
 
     def _ready(self, pend: _Pending) -> bool:
         try:
-            return all(a.is_ready() for a in pend.out)
+            return pend.out.is_ready()
         except AttributeError:
             return False
+
+    def _unpack_outputs(self, arr: np.ndarray) -> StepOutputs:
+        """Host-side inverse of the step program's output packing."""
+        md = self.max_divisions
+        sb = self.spawn_block
+        nw_k = -(-self._cap // _BITS)
+        nw_s = -(-sb // _BITS)
+        off = 5
+        kill = _unpack_bits(arr[off : off + nw_k], self._cap)
+        off += nw_k
+        parents = arr[off : off + md]
+        off += md
+        child_pos = arr[off : off + 2 * md].reshape(md, 2)
+        off += 2 * md
+        spawn_ok = _unpack_bits(arr[off : off + nw_s], sb)
+        off += nw_s
+        spawn_pos = arr[off : off + 2 * sb].reshape(sb, 2)
+        return StepOutputs(
+            kill=kill,
+            parents=parents,
+            child_pos=child_pos,
+            n_placed=int(arr[0]),
+            n_candidates=int(arr[1]),
+            n_attempted=int(arr[2]),
+            spawn_ok=spawn_ok,
+            spawn_pos=spawn_pos,
+            n_rows=int(arr[3]),
+            n_alive=int(arr[4]),
+        )
 
     def _drain(self, block: bool) -> None:
         while self._pending:
@@ -787,13 +887,17 @@ class PipelinedStepper:
             self._replay(self._pending.pop(0))
 
     def _replay(self, pend: _Pending) -> None:
-        out = pend.out
-        kill = np.asarray(out.kill)
-        parents = np.asarray(out.parents)
-        n_placed = int(out.n_placed)
-        child_pos = np.asarray(out.child_pos)
-        spawn_ok = np.asarray(out.spawn_ok)
-        spawn_pos = np.asarray(out.spawn_pos)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = self._unpack_outputs(np.asarray(pend.out))  # the ONE fetch
+        self._fetch_acc += _time.perf_counter() - t0
+        kill = out.kill
+        parents = out.parents
+        n_placed = out.n_placed
+        child_pos = out.child_pos
+        spawn_ok = out.spawn_ok
+        spawn_pos = out.spawn_pos
 
         # 0. spawns (allocation order matches the device: queue order)
         n_spawned = 0
@@ -842,7 +946,8 @@ class PipelinedStepper:
                 self._last_change[row] = self._last_change[p]
         self._n_rows += n_placed
         self.stats["divisions"] += n_placed
-        self.stats["division_drops"] += int(out.n_candidates) - n_placed
+        self.stats["division_drops"] += out.n_candidates - out.n_attempted
+        self.stats["division_blocked"] += out.n_attempted - n_placed
 
         # 3. lifetimes
         self._lifetimes[: self._n_rows][
@@ -870,7 +975,7 @@ class PipelinedStepper:
         self.stats["replayed"] += 1
         # growth history feeds the division-budget/row-bound estimates;
         # drops count as demand so a clamp raises the next budget
-        dropped = max(0, int(out.n_candidates) - n_placed)
+        dropped = max(0, out.n_candidates - out.n_attempted)
         self._growth_hist.append(n_spawned + n_placed + dropped)
         if len(self._growth_hist) > 64:
             del self._growth_hist[:32]
@@ -1087,11 +1192,14 @@ class PipelinedStepper:
         call it explicitly (plus :meth:`wait_warm`) before a timing
         window so no remote compile can land inside it."""
         if q is None:
-            # the NEXT rung above the one the current population uses —
-            # warming the current rung would be a no-op (it compiled when
-            # first dispatched)
+            # warm the rung the current population uses AND the one above
+            # it: before the first dispatch nothing is compiled yet, so
+            # 'current' is only a no-op when a step already ran
             cur = quantize_rows(self._n_rows, self._cap)
-            q = quantize_rows(cur + 1, self._cap) if cur < self._cap else cur
+            self.prewarm(q=cur, compact=compact)
+            if (nxt := next_rung(cur, self._cap)) != cur:
+                self.prewarm(q=nxt, compact=compact)
+            return
         spawn_dense, spawn_valid = self._empty_spawn()
         push_dense, push_rows = self._empty_push()
         _pipeline_step(
@@ -1131,7 +1239,7 @@ class PipelinedStepper:
         background thread, so population growth or a scheduled
         compaction never meets a cold remote compile mid-run."""
         self._warm_sched.mark(self._variant_key(q, compact))
-        nxt = quantize_rows(q + 1, self._cap) if q < self._cap else q
+        nxt = next_rung(q, self._cap)
         wanted = [
             self._variant_key(q, True),
             self._variant_key(nxt, False),

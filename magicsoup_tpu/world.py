@@ -45,6 +45,7 @@ from magicsoup_tpu.ops.integrate import (
 from magicsoup_tpu.ops.params import (
     compact_rows,
     copy_params,
+    next_rung,
     pad_idxs,
     pad_pow2,
     permute_params,
@@ -1221,13 +1222,14 @@ class World:
         if self._cell_sharding is not None or self.n_cells == 0:
             return
         if q is None:
-            # the NEXT rung above the one the current population uses
+            # warm the rung the current population uses AND the one above
+            # it: before the first step nothing is compiled yet, so
+            # 'current' is only a no-op when a step already ran
             cur = quantize_rows(self.n_cells, self._capacity)
-            q = (
-                quantize_rows(cur + 1, self._capacity)
-                if cur < self._capacity
-                else cur
-            )
+            self.prewarm_activity(q=cur, has_col=has_col)
+            if (nxt := next_rung(cur, self._capacity)) != cur:
+                self.prewarm_activity(q=nxt, has_col=has_col)
+            return
         args = (
             self._molecule_map,
             self._cell_molecules,
@@ -1252,7 +1254,7 @@ class World:
         if q is None:
             return
         self._warm_sched.mark(self._activity_variant_key(q, has_col))
-        nxt = quantize_rows(q + 1, self._capacity) if q < self._capacity else q
+        nxt = next_rung(q, self._capacity)
         self._warm_sched.schedule(
             [self._activity_variant_key(nxt, has_col)],
             lambda k: self.prewarm_activity(q=k[0], has_col=k[1]),

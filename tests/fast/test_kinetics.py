@@ -1194,3 +1194,29 @@ def test_fast_and_deterministic_modes_agree():
     V_fast = np.asarray(integ._velocities(X, p.Vmax, p, det=False))
     V_det = np.asarray(integ._velocities(X, p.Vmax, p, det=True))
     np.testing.assert_allclose(V_fast, V_det, rtol=1e-4, atol=1e-6)
+
+
+def test_set_cell_params_flat_chunked_matches_unchunked():
+    """Large batches stream through fixed-size assembly chunks (the
+    65536-row pad of a 40k spawn OOMs buffer assignment otherwise); a
+    forced-tiny chunk must write bit-identical parameters."""
+    import random as _random
+
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY as _WL
+    from magicsoup_tpu.util import random_genome as _rg
+    from magicsoup_tpu.world import World as _World
+
+    rng = _random.Random(7)
+    world = _World(chemistry=_WL, map_size=32, seed=7)
+    genomes = [_rg(s=300, rng=rng) for _ in range(60)]
+    world.spawn_cells(genomes)
+    kin = world.kinetics
+    ref = [np.asarray(t).copy() for t in kin.params]
+
+    assert kin._assembly_chunk() >= 256  # default stays batch-friendly
+    kin._assembly_chunk = lambda: 8  # force many chunks through one pad
+    world._update_cell_params(genomes=genomes, idxs=list(range(60)))
+    for before, after in zip(ref, kin.params):
+        a = np.nan_to_num(before)
+        b = np.nan_to_num(np.asarray(after))
+        assert np.array_equal(a, b)

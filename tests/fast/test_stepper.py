@@ -339,3 +339,41 @@ def test_stepper_variant_keys_invalidate_on_token_growth():
     # growing the protein capacity reshapes params: old keys must miss
     st.kin.ensure_capacity(n_proteins=st.kin.max_proteins * 2)
     assert not st._warm_sched.is_warm(st._variant_key(1024, False))
+
+
+def test_packed_output_bits_roundtrip():
+    """The step program packs its whole output record into one i32
+    vector (one device->host transfer per replay); the bit-pack halves
+    must invert each other for every length, aligned or not."""
+    import jax.numpy as jnp
+
+    from magicsoup_tpu.stepper import _pack_bits, _unpack_bits
+
+    rng = np.random.default_rng(0)
+    for n in (1, 15, 16, 17, 64, 1000, 1024):
+        bits = rng.random(n) < 0.3
+        words = np.asarray(_pack_bits(jnp.asarray(bits)))
+        assert words.dtype == np.int32 and (words >= 0).all()
+        assert (_unpack_bits(words, n) == bits).all()
+
+
+def test_packed_output_unpack_layout():
+    """One real step's packed record must unpack into self-consistent
+    fields (scalars match mask popcounts; layout offsets line up)."""
+    world = _world(seed=21, n_cells=40)
+    st = PipelinedStepper(
+        world, mol_name="stp-atp", kill_below=0.05, divide_above=0.2,
+        divide_cost=0.1, lag=2,  # depth 2 so the first output stays pending
+    )
+    st.step()
+    arr = np.asarray(st._pending[0].out)
+    out = st._unpack_outputs(arr)
+    assert out.kill.shape == (st._cap,)
+    assert out.spawn_ok.shape == (st.spawn_block,)
+    assert out.child_pos.shape == (st.max_divisions, 2)
+    assert 0 <= out.n_placed <= out.n_attempted <= out.n_candidates
+    assert out.n_alive <= out.n_rows <= st._cap
+    # parents beyond n_placed carry the cap sentinel
+    assert (out.parents[out.n_placed:] == st._cap).all()
+    st.drain()
+    st.flush()
