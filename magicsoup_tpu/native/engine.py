@@ -333,6 +333,15 @@ def neighbor_pairs(positions: np.ndarray, map_size: int) -> np.ndarray | None:
     if lib is None:
         return None
     pos = np.ascontiguousarray(positions, dtype=np.int32)
+    if len(pos) and (pos.min() < 0 or pos.max() >= map_size):
+        # the C scan indexes an occupancy grid with these coordinates;
+        # an out-of-range position would silently overflow the heap
+        # (observed as 'corrupted size vs. prev_size' at exit), so fail
+        # loudly at the boundary instead
+        raise ValueError(
+            f"positions out of range for map_size={map_size}: "
+            f"min={pos.min()}, max={pos.max()}"
+        )
     out_pairs = _i32p()
     out_n = ctypes.c_int64()
     lib.ms_neighbor_pairs(

@@ -72,6 +72,7 @@ from magicsoup_tpu.util import (
     moore_pairs,
     random_genome,
     randstr,
+    register_exit_join as _register_exit_join,
 )
 
 # numpy on purpose: a module-level jnp array would initialise the XLA
@@ -446,10 +447,59 @@ def _compact_program(
     )
 
 
+class _Fetcher:
+    """One DAEMON thread pulling packed step outputs to host in dispatch
+    order.  Daemon on purpose: a fetch hung on a dead tunnel must never
+    block interpreter exit (a ThreadPoolExecutor's workers are joined at
+    exit and would).  :meth:`close` (hooked to the stepper via
+    ``weakref.finalize``) ends the thread when the stepper is collected,
+    and the :func:`magicsoup_tpu.util.register_exit_join` atexit hook
+    stops + joins it (bounded) before runtime teardown — a daemon thread
+    still inside a device fetch during teardown corrupts the heap."""
+
+    def __init__(self):
+        import queue
+        import threading
+
+        self._q: Any = queue.SimpleQueue()
+        self._t = threading.Thread(
+            target=self._run, daemon=True, name="ms-stepper-fetch"
+        )
+        self._t.start()
+        _register_exit_join(self)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            arr, fut = item
+            try:
+                fut.set_result(np.asarray(arr))
+            except BaseException as exc:  # noqa: BLE001 - delivered to result()
+                fut.set_exception(exc)
+
+    def submit(self, arr):
+        # a bare stdlib Future (no executor, so nothing joins it at exit)
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        self._q.put((arr, fut))
+        return fut
+
+    def close(self) -> None:
+        self._q.put(None)
+
+    def exit_join(self, timeout: float | None = None) -> None:
+        self.close()
+        if self._t.is_alive():
+            self._t.join(timeout)
+
+
 class _Pending(NamedTuple):
     """One dispatched step awaiting host replay."""
 
-    out: jax.Array  # packed i32 output vector (see StepOutputs)
+    out: Any  # Future[np.ndarray] — packed i32 output (see StepOutputs)
     spawn_genomes: list  # genomes queued into this dispatch (b_spawn order)
     spawn_labels: list
     compacted: bool
@@ -488,6 +538,11 @@ class PipelinedStepper:
             parameters (reference defaults).
         compact_headroom: Compact when fewer than this many free rows
             are estimated to remain (default 256).
+        compact_dead_slack: Also compact once this many dead rows have
+            accumulated (default 768) — dead rows inflate the live-row
+            prefix the integrator reads, and compaction rides the step
+            program, so reclaiming early keeps slot occupancy >= ~85%
+            at steady-state churn for free.
         auto_grow: Double the world's slot capacity (a rare full
             pipeline drain) when the live population crowds it; with
             ``False`` the allocation clamps instead and drops are
@@ -515,6 +570,7 @@ class PipelinedStepper:
         p_del: float = 0.66,
         p_recombination: float = 1e-7,
         compact_headroom: int | None = None,
+        compact_dead_slack: int = 768,
         auto_grow: bool = True,
     ):
         if world._mesh is not None:
@@ -545,6 +601,7 @@ class PipelinedStepper:
         self.compact_headroom = (
             compact_headroom if compact_headroom is not None else 256
         )
+        self.compact_dead_slack = compact_dead_slack
         self.auto_grow = auto_grow
         self.stats = {
             "steps": 0,
@@ -577,6 +634,14 @@ class PipelinedStepper:
         self.trace: list[dict] = []  # per-step timing/diagnostic records
         self._fetch_acc = 0.0  # seconds spent blocked on output fetches
         self._budget_cache: dict[int, jax.Array] = {}
+        # one background worker pulls each step's packed output record to
+        # host as soon as it is dispatched, so the replay path never puts
+        # a device->host round trip (~70-100 ms through a tunnel) on the
+        # step loop; a single worker keeps fetches in dispatch order
+        import weakref
+
+        self._fetcher = _Fetcher()
+        weakref.finalize(self, self._fetcher.close)
         self._pending: list[_Pending] = []
         self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
         # deferred pushes: (genomes, rows, change seq) held while a
@@ -687,9 +752,15 @@ class PipelinedStepper:
             + (len(self._pending) + 1) * 2 * g_est
             + len(self._spawn_queue)
         )
-        compact = (
-            not self._compact_outstanding
-            and projected + self.compact_headroom > self._cap
+        # two triggers: (a) running out of rows, and (b) enough dead rows
+        # accumulated that the live-row prefix q carries a whole ladder
+        # rung of dead-slot tax (VERDICT round-2 #9: keep the integrator's
+        # occupancy >= 85% at steady state).  Compaction rides the step
+        # program — no extra dispatch, and the variant is prewarmed.
+        dead_est = self._n_rows - int(self._alive.sum())
+        compact = not self._compact_outstanding and (
+            projected + self.compact_headroom > self._cap
+            or dead_est > self.compact_dead_slack
         )
 
         # spawn batch + riding parameter refreshes for this dispatch:
@@ -782,13 +853,9 @@ class PipelinedStepper:
         )
         t_dispatched = _time.perf_counter()
         self._note_warm(q, compact)
-        try:
-            out.copy_to_host_async()
-        except AttributeError:
-            pass
         self._pending.append(
             _Pending(
-                out=out,
+                out=self._fetcher.submit(out),
                 spawn_genomes=[g for g, _ in spawn],
                 spawn_labels=[l for _, l in spawn],
                 compacted=compact,
@@ -814,6 +881,8 @@ class PipelinedStepper:
                 "dispatch": t_dispatched - t_dispatch0,
                 "fetch": self._fetch_acc - fetch0,
                 "q": q,
+                "rows": self._n_rows,
+                "alive": int(self._alive.sum()),
                 "cold": cold,
                 "compact": compact,
                 "push": 0 if ride is None else len(ride[1]),
@@ -839,10 +908,7 @@ class PipelinedStepper:
         self._drain(block=True)
 
     def _ready(self, pend: _Pending) -> bool:
-        try:
-            return pend.out.is_ready()
-        except AttributeError:
-            return False
+        return pend.out.done()
 
     def _unpack_outputs(self, arr: np.ndarray) -> StepOutputs:
         """Host-side inverse of the step program's output packing."""
@@ -890,7 +956,8 @@ class PipelinedStepper:
         import time as _time
 
         t0 = _time.perf_counter()
-        out = self._unpack_outputs(np.asarray(pend.out))  # the ONE fetch
+        # the ONE fetch — usually already pulled by the background worker
+        out = self._unpack_outputs(pend.out.result())
         self._fetch_acc += _time.perf_counter() - t0
         kill = out.kill
         parents = out.parents

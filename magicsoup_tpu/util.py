@@ -123,6 +123,49 @@ def reverse_complement(seq: str) -> str:
 _COMPLEMENT = str.maketrans("ACTG", "TGAC")
 
 
+# -------------------------------------------------------------------- #
+# background-worker exit discipline                                     #
+# -------------------------------------------------------------------- #
+# Background threads here run jax work (compiles, device fetches).  They
+# are DAEMON threads so a worker hung on a dead accelerator tunnel can
+# never block process exit — but a daemon thread still inside XLA while
+# the interpreter tears the runtime down corrupts the heap (observed as
+# `corrupted size vs. prev_size` / `terminate called` at exit).  So every
+# worker registers here, and one atexit hook — registered AFTER jax's own,
+# hence running BEFORE jax teardown — asks workers to stop and joins them
+# with a bounded timeout: clean shutdown in the normal case, bounded wait
+# (not a hang) in the pathological one.
+
+_EXIT_JOIN_TIMEOUT_S = 60.0
+
+
+def _exit_join_registry():
+    global _EXIT_REGISTRY
+    try:
+        return _EXIT_REGISTRY
+    except NameError:
+        import atexit
+        import weakref
+
+        _EXIT_REGISTRY = weakref.WeakSet()
+
+        def _join_all() -> None:
+            for worker in list(_EXIT_REGISTRY):
+                try:
+                    worker.exit_join(_EXIT_JOIN_TIMEOUT_S)
+                except Exception:  # noqa: BLE001 - exit path, best effort
+                    pass
+
+        atexit.register(_join_all)
+        return _EXIT_REGISTRY
+
+
+def register_exit_join(worker) -> None:
+    """Register ``worker`` (anything with ``exit_join(timeout)``) for the
+    stop-and-join-at-exit discipline described above."""
+    _exit_join_registry().add(worker)
+
+
 class WarmScheduler:
     """Compiled-variant bookkeeping shared by :class:`World` and the
     pipelined stepper: tracks which program-variant keys are known
@@ -143,6 +186,8 @@ class WarmScheduler:
         self._warm: set = set()
         self._pending: list = []  # (key, warm_fn) awaiting the bg thread
         self._thread = None
+        self._stopping = [False]  # shared with bg closures across resets
+        register_exit_join(self)
 
     def is_warm(self, key) -> bool:
         return key in self._warm
@@ -172,9 +217,10 @@ class WarmScheduler:
 
         warm_set = self._warm  # capture THIS generation...
         pending = self._pending  # ...and THIS generation's queue
+        stopping = self._stopping
 
         def _bg():
-            while True:
+            while not stopping[0]:
                 try:
                     k, fn = pending.pop(0)
                 except IndexError:
@@ -223,6 +269,15 @@ class WarmScheduler:
         self._warm = set()
         self._pending = []
 
+    def exit_join(self, timeout: float | None = None) -> None:
+        """Stop after the in-flight warm and join (bounded) — called by
+        the atexit hook so no warm compile straddles runtime teardown."""
+        self._stopping[0] = True
+        self._pending.clear()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
     # pickling: thread handles are not picklable and warm state is
     # runtime-local — a restored scheduler starts cold
     def __getstate__(self) -> dict:
@@ -232,6 +287,8 @@ class WarmScheduler:
         self._warm = set()
         self._pending = []
         self._thread = None
+        self._stopping = [False]
+        register_exit_join(self)
 
 
 def fetch_host(arr) -> "np.ndarray":  # noqa: F821 - numpy imported lazily

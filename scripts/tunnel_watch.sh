@@ -1,22 +1,30 @@
 #!/usr/bin/env bash
 # Watch for the TPU tunnel to come back, then immediately run the full
-# measurement capture (scripts/capture_tpu_numbers.sh) once and exit.
-# The tunnel has been observed down for multi-hour stretches (see
-# BENCH_NOTES.md); probing every few minutes and capturing the moment it
-# returns maximizes the use of short up-windows.
+# measurement capture (scripts/capture_tpu_numbers.sh).  The tunnel has
+# been observed down for multi-hour stretches with up-windows as short
+# as minutes (see BENCH_NOTES.md), so this loops until ONE capture runs
+# to completion — a capture aborted by a mid-window drop re-arms the
+# watch with a fresh outdir instead of giving up.
 #
-#   bash scripts/tunnel_watch.sh [outdir] [probe_interval_s]
+#   bash scripts/tunnel_watch.sh [outdir_prefix] [probe_interval_s]
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-logs/tpu-auto-$(date +%Y%m%d-%H%M%S)}"
-INTERVAL="${2:-300}"
+PREFIX="${1:-logs/tpu-auto}"
+INTERVAL="${2:-180}"
 
+n=0
 while true; do
     if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-        echo "$(date -Is) tunnel up — starting capture into $OUT"
-        bash scripts/capture_tpu_numbers.sh "$OUT"
-        exit $?
+        n=$((n + 1))
+        OUT="$PREFIX-$(date +%Y%m%d-%H%M%S)"
+        echo "$(date -Is) tunnel up — capture #$n into $OUT"
+        if bash scripts/capture_tpu_numbers.sh "$OUT"; then
+            echo "$(date -Is) capture complete: $OUT"
+            exit 0
+        fi
+        echo "$(date -Is) capture aborted (tunnel drop?); re-arming"
+    else
+        echo "$(date -Is) tunnel down; next probe in ${INTERVAL}s"
     fi
-    echo "$(date -Is) tunnel down; next probe in ${INTERVAL}s"
     sleep "$INTERVAL"
 done

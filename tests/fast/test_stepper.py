@@ -366,7 +366,7 @@ def test_packed_output_unpack_layout():
         divide_cost=0.1, lag=2,  # depth 2 so the first output stays pending
     )
     st.step()
-    arr = np.asarray(st._pending[0].out)
+    arr = st._pending[0].out.result()  # Future from the fetch worker
     out = st._unpack_outputs(arr)
     assert out.kill.shape == (st._cap,)
     assert out.spawn_ok.shape == (st.spawn_block,)
