@@ -68,6 +68,7 @@ from magicsoup_tpu.ops.params import (
 )
 from magicsoup_tpu.util import (
     WarmScheduler,
+    async_workers_enabled,
     fetch_host as _fetch_host,
     moore_pairs,
     random_genome,
@@ -496,6 +497,26 @@ class _Fetcher:
             self._t.join(timeout)
 
 
+class _LazyFetch:
+    """Inline stand-in for a fetch Future on backends without a worker
+    thread (CPU): resolves on the replay thread, exactly the pre-worker
+    semantics."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def done(self) -> bool:
+        try:
+            return self._arr.is_ready()
+        except AttributeError:
+            return True
+
+    def result(self, timeout=None):
+        return np.asarray(self._arr)
+
+
 class _Pending(NamedTuple):
     """One dispatched step awaiting host replay."""
 
@@ -637,11 +658,20 @@ class PipelinedStepper:
         # one background worker pulls each step's packed output record to
         # host as soon as it is dispatched, so the replay path never puts
         # a device->host round trip (~70-100 ms through a tunnel) on the
-        # step loop; a single worker keeps fetches in dispatch order
-        import weakref
+        # step loop; a single worker keeps fetches in dispatch order.
+        # CPU backend: no worker (no RTT to hide, and a background fetch
+        # racing a compile segfaults jaxlib's CPU client — see
+        # util.async_workers_enabled)
+        self._async = async_workers_enabled(
+            world._device.platform if world._device is not None else None
+        )
+        if self._async:
+            import weakref
 
-        self._fetcher = _Fetcher()
-        weakref.finalize(self, self._fetcher.close)
+            self._fetcher = _Fetcher()
+            weakref.finalize(self, self._fetcher.close)
+        else:
+            self._fetcher = None
         self._pending: list[_Pending] = []
         self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
         # deferred pushes: (genomes, rows, change seq) held while a
@@ -855,7 +885,11 @@ class PipelinedStepper:
         self._note_warm(q, compact)
         self._pending.append(
             _Pending(
-                out=self._fetcher.submit(out),
+                out=(
+                    self._fetcher.submit(out)
+                    if self._fetcher is not None
+                    else _LazyFetch(out)
+                ),
                 spawn_genomes=[g for g, _ in spawn],
                 spawn_labels=[l for _, l in spawn],
                 compacted=compact,
@@ -1306,6 +1340,10 @@ class PipelinedStepper:
         background thread, so population growth or a scheduled
         compaction never meets a cold remote compile mid-run."""
         self._warm_sched.mark(self._variant_key(q, compact))
+        if not self._async:
+            # local compiles: first use compiles synchronously, which is
+            # both cheap and the only thread-safe option on this backend
+            return
         nxt = next_rung(q, self._cap)
         wanted = [
             self._variant_key(q, True),

@@ -166,6 +166,31 @@ def register_exit_join(worker) -> None:
     _exit_join_registry().add(worker)
 
 
+def async_workers_enabled(platform: str | None = None) -> bool:
+    """Whether background jax workers (compile warmers, output fetch
+    threads) should run at all.  They exist to hide REMOTE round trips —
+    a tunneled accelerator pays ~seconds per compile and ~70-100 ms per
+    fetch.  The CPU backend pays neither, and jaxlib's CPU client has
+    been observed to SEGFAULT when a background fetch races a compile on
+    the main thread — so on CPU the framework does everything inline.
+
+    ``platform`` is the platform of the device the caller's arrays
+    actually live on (e.g. ``world._device.platform`` under an explicit
+    ``device=`` placement); the hazard is a property of that client, not
+    of the process-wide default backend.  ``MAGICSOUP_TPU_ASYNC=0/1``
+    overrides (testing)."""
+    import os
+
+    env = os.environ.get("MAGICSOUP_TPU_ASYNC")
+    if env is not None:
+        return env == "1"
+    if platform is not None:
+        return platform != "cpu"
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 class WarmScheduler:
     """Compiled-variant bookkeeping shared by :class:`World` and the
     pipelined stepper: tracks which program-variant keys are known
@@ -203,6 +228,8 @@ class WarmScheduler:
         :meth:`wait` must be able to guarantee that everything scheduled
         before it is compiled when it returns (bench.py relies on that
         to keep remote compiles out of measured windows)."""
+        if self._stopping[0]:
+            return
         queued = {k for k, _ in self._pending}
         new = [k for k in keys if k not in self._warm and k not in queued]
         if new:
@@ -211,7 +238,7 @@ class WarmScheduler:
 
     def _kick(self) -> None:
         t = self._thread
-        if not self._pending or (t is not None and t.is_alive()):
+        if self._stopping[0] or not self._pending or (t is not None and t.is_alive()):
             return
         import threading
 
@@ -245,6 +272,8 @@ class WarmScheduler:
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
+            if self._stopping[0]:
+                return
             t = self._thread
             alive = t is not None and t.is_alive()
             if not alive and not self._pending:

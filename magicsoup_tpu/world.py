@@ -53,6 +53,7 @@ from magicsoup_tpu.ops.params import (
 )
 from magicsoup_tpu.util import (
     WarmScheduler,
+    async_workers_enabled as _async_workers_enabled,
     fetch_host as _fetch_host,
     randstr,
 )
@@ -384,6 +385,11 @@ class World:
             )
         self.device = device
         self._device = _resolve_device(device)
+        # resolved ONCE against the platform this world's arrays live on
+        # (the background-worker hazard is per-client, not per-process)
+        self._async_workers = _async_workers_enabled(
+            self._device.platform if self._device is not None else None
+        )
         self.batch_size = batch_size
         self.map_size = map_size
         self.abs_temp = abs_temp
@@ -1250,10 +1256,15 @@ class World:
 
     def _note_activity_warm(self, q: int | None, has_col: bool) -> None:
         """Record a just-used activity variant and keep the row ladder
-        warm one rung ahead in a background thread."""
+        warm one rung ahead in a background thread (remote-compile
+        backends only; on CPU first use compiles synchronously, which is
+        cheap and the only thread-safe option — see
+        util.async_workers_enabled)."""
         if q is None:
             return
         self._warm_sched.mark(self._activity_variant_key(q, has_col))
+        if not self._async_workers:
+            return
         nxt = next_rung(q, self._capacity)
         self._warm_sched.schedule(
             [self._activity_variant_key(nxt, has_col)],
@@ -1458,6 +1469,9 @@ class World:
             )
             self.device = None
             self._device = None
+        self._async_workers = _async_workers_enabled(
+            self._device.platform if self._device is not None else None
+        )
         self._cell_molecules = self._place_cells(state["_cell_molecules"])
         self._molecule_map = self._place_map(state["_molecule_map"])
         self._diff_kernels = jnp.asarray(state["_diff_kernels"])

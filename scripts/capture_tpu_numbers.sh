@@ -60,6 +60,7 @@ run bench_40k       1200 python bench.py --config 40k --warmup 4 --steps 8
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run bench_diffusion 1200 python bench.py --config diffusion --warmup 4 --steps 8
 run bench_det       1200 python bench.py --det --warmup 4 --steps 8
+run bitrepro         900 python scripts/bitrepro.py
 run check           1200 python performance/check.py
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"

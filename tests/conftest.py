@@ -20,6 +20,26 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_compilation_cache", True)
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables after each test module.
+
+    One pytest process compiles many hundreds of program variants
+    (capacity ladders x numeric modes x 8-device meshes); with all of
+    them held live, a late large compile segfaults inside jaxlib's CPU
+    compiler (reproducible at tests/slow/test_invariants.py when run
+    after the whole fast tier; every tier green in isolation).  Dropping
+    the jit caches at module boundaries keeps the per-process compiled
+    footprint bounded while preserving within-module reuse."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
 
 class Retry:
     """

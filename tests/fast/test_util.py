@@ -222,3 +222,54 @@ def test_warm_scheduler_queues_while_busy():
     ws.wait(10)
     assert done == [("slow",), ("late-1",), ("late-2",)]
     assert all(ws.is_warm(k) for k in done)
+
+
+def test_warm_scheduler_exit_join_stops_promptly():
+    """The atexit discipline: exit_join must stop the worker after the
+    in-flight item (dropping the queued tail) and join it — a warm
+    compile must never straddle interpreter teardown."""
+    import threading
+    import time
+
+    from magicsoup_tpu.util import WarmScheduler
+
+    ws = WarmScheduler()
+    started = threading.Event()
+    ran = []
+
+    def slow(k):
+        started.set()
+        ran.append(k)
+        time.sleep(0.05)
+
+    ws.schedule([("a",), ("b",), ("c",)], slow)
+    assert started.wait(5)
+    ws.exit_join(10)
+    t = ws._thread
+    assert t is not None and not t.is_alive()
+    # the queued tail was dropped, not run to completion
+    assert len(ran) < 3
+    # once stopped, schedule() is a no-op and wait() returns immediately
+    # instead of spinning to its deadline re-kicking dead workers
+    ws.schedule([("d",)], slow)
+    t0 = time.monotonic()
+    ws.wait(5)
+    assert time.monotonic() - t0 < 1.0
+    assert not ws._pending
+
+
+def test_stepper_fetcher_exit_join_and_gc_close():
+    """The stepper's fetch worker must be a daemon (a dead tunnel cannot
+    block exit), must drain queued fetches on exit_join, and must stop
+    on close()."""
+    import numpy as _np
+
+    from magicsoup_tpu.stepper import _Fetcher
+
+    f = _Fetcher()
+    assert f._t.daemon
+    futs = [f.submit(_np.arange(3)) for _ in range(4)]
+    f.exit_join(10)
+    assert not f._t.is_alive()
+    for fut in futs:
+        assert (fut.result(timeout=1) == _np.arange(3)).all()
