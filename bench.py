@@ -238,6 +238,8 @@ def _child_main(args: argparse.Namespace) -> None:
             tt = sorted(t["t"] for t in trace)
             mid = tt[len(tt) // 2]
             p90 = tt[int(len(tt) * 0.9)]
+            occ = [t["alive"] / t["q"] for t in trace if "alive" in t]
+            occ_mean = sum(occ) / len(occ) if occ else float("nan")
             sys.stderr.write(
                 f"[trace] steps={len(trace)} t_med={mid*1e3:.1f}ms"
                 f" t_p90={p90*1e3:.1f}ms t_max={tt[-1]*1e3:.1f}ms"
@@ -245,7 +247,8 @@ def _child_main(args: argparse.Namespace) -> None:
                 f" compactions={sum(t['compact'] for t in trace)}"
                 f" fetch_s={sum(t['fetch'] for t in trace):.2f}"
                 f" dispatch_s={sum(t['dispatch'] for t in trace):.2f}"
-                f" total_s={sum(t['t'] for t in trace):.2f}\n"
+                f" total_s={sum(t['t'] for t in trace):.2f}"
+                f" occupancy={occ_mean:.2f}\n"
             )
             slow = [t for t in trace if t["t"] > 3 * mid]
             for t in slow[:8]:

@@ -68,7 +68,6 @@ from magicsoup_tpu.ops.params import (
 )
 from magicsoup_tpu.util import (
     WarmScheduler,
-    async_workers_enabled,
     fetch_host as _fetch_host,
     moore_pairs,
     random_genome,
@@ -662,9 +661,8 @@ class PipelinedStepper:
         # CPU backend: no worker (no RTT to hide, and a background fetch
         # racing a compile segfaults jaxlib's CPU client — see
         # util.async_workers_enabled)
-        self._async = async_workers_enabled(
-            world._device.platform if world._device is not None else None
-        )
+        # one source of truth: the world resolved the per-client policy
+        self._async = world._async_workers
         if self._async:
             import weakref
 
@@ -769,9 +767,13 @@ class PipelinedStepper:
 
         # compaction cannot free more than the dead rows; when the LIVE
         # population itself crowds the capacity (>7/8 full), grow (drain
-        # + double + reattach, like the classic loop's pow2 growth)
+        # + double + reattach, like the classic loop's pow2 growth).  The
+        # demand term is clamped: a transient division wave (everyone
+        # above threshold after a fresh spawn) must raise the division
+        # BUDGET, not permanently double capacity — growth is a response
+        # to live crowding, clamps merely defer divisions a step
         if self.auto_grow:
-            grow_at = max(2 * g_est, self._cap // 8)
+            grow_at = max(2 * min(g_est, 256), self._cap // 8)
             if self._cap - int(self._alive.sum()) < grow_at:
                 self.drain()
                 if self._cap - int(self._alive.sum()) < grow_at:

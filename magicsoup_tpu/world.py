@@ -1517,6 +1517,10 @@ class World:
         if device is not None:
             obj.device = device
             obj._device = _resolve_device(device)
+            # the async-worker policy is per-client: follow the override
+            obj._async_workers = _async_workers_enabled(
+                obj._device.platform if obj._device is not None else None
+            )
             obj._molecule_map = obj._place_map(obj._molecule_map)
             obj._cell_molecules = obj._place_cells(obj._cell_molecules)
             obj._sync_positions()
