@@ -1,0 +1,89 @@
+"""
+Sanity figures for parameter assembly thermodynamics (reference figure
+counterparts: docs/plots/equilibrium_constants.py / free_energy.py —
+same checks, own construction): equilibrium constants of assembled
+proteomes must follow Ke = exp(-dG0/RT) over the reaction energies, and
+the Kmf/Kmb split must put the sampled Km on the smaller side.
+
+    python docs/plots/plot_equilibrium.py  # writes docs/img/equilibrium.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+from magicsoup_tpu.constants import GAS_CONSTANT
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.util import random_genome
+from magicsoup_tpu.world import World
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+
+def main() -> None:
+    rng = random.Random(5)
+    world = World(chemistry=CHEMISTRY, map_size=64, seed=5)
+    world.spawn_cells([random_genome(s=1000, rng=rng) for _ in range(300)])
+    kin = world.kinetics
+    n = world.n_cells
+
+    Ke = np.asarray(kin.params.Ke)[:n]
+    Kmf = np.asarray(kin.params.Kmf)[:n]
+    Kmb = np.asarray(kin.params.Kmb)[:n]
+    N = np.asarray(kin.params.N)[:n].astype(np.float64)
+    Vmax = np.asarray(kin.params.Vmax)[:n]
+    live = Vmax > 0.0  # protein slots actually encoding domains
+
+    # energies duplicated over int/ext signals, like the assembly
+    energies = np.asarray(
+        [m.energy for m in CHEMISTRY.molecules] * 2, dtype=np.float64
+    )
+    dg0 = (N * energies).sum(axis=2)
+
+    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+
+    ax = axes[0]
+    x = dg0[live]
+    y = np.log(Ke[live])
+    ax.scatter(x / 1000.0, y, s=4, alpha=0.3)
+    xs = np.linspace(x.min(), x.max(), 50)
+    ax.plot(
+        xs / 1000.0,
+        -xs / (GAS_CONSTANT * world.abs_temp),
+        color="crimson",
+        lw=1.0,
+        label="ln Ke = -dG0 / RT",
+    )
+    ax.set_xlabel("dG0 [kJ/mol]")
+    ax.set_ylabel("ln Ke (assembled, clamped)")
+    ax.set_title(f"{int(live.sum())} proteins from 300 random genomes")
+    ax.legend()
+
+    ax = axes[1]
+    ax.scatter(np.log10(Kmf[live]), np.log10(Kmb[live]), s=4, alpha=0.3)
+    ax.set_xlabel("log10 Kmf")
+    ax.set_ylabel("log10 Kmb")
+    ax.set_title("Km split: Kmb/Kmf = Ke,\nsampled Km on the smaller side")
+
+    ax = axes[2]
+    ax.hist(np.log10(Vmax[live]), bins=40)
+    ax.set_xlabel("log10 Vmax")
+    ax.set_ylabel("proteins")
+    ax.set_title("Vmax lognormal sample range")
+
+    fig.tight_layout()
+    OUT.mkdir(exist_ok=True)
+    fig.savefig(OUT / "equilibrium.png", dpi=110)
+    print(f"wrote {OUT / 'equilibrium.png'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""
+Sanity figures for the mutation engine (reference figure counterpart:
+docs/plots/mutations.py — same checks, own construction): the per-genome
+point-mutation count must follow Poisson(p*len), indels must drift
+genome length only slowly, and recombination must conserve total length
+while reshuffling it between partners.
+
+    python docs/plots/plot_mutations.py   # writes docs/img/mutations.png
+"""
+import math
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+from magicsoup_tpu.mutations import point_mutations, recombinations
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+
+def _poisson_pmf(k: np.ndarray, lam: float) -> np.ndarray:
+    return np.exp(k * math.log(lam) - lam - [math.lgamma(x + 1) for x in k])
+
+
+def mutation_counts(ax):
+    rng = random.Random(0)
+    n, size, p = 4000, 1000, 1e-3
+    genomes = [random_genome(s=size, rng=rng) for _ in range(n)]
+    # count mutated genomes over many independent low-p rounds to build
+    # the per-genome count distribution at lam = p * len
+    counts = np.zeros(n, dtype=np.int64)
+    muts = point_mutations(genomes, p=p, seed=17)
+    per_genome = np.zeros(n, dtype=np.int64)
+    for _, i in muts:
+        per_genome[i] += 1  # >= 1 mutation happened for that genome
+    lam = p * size
+    # distribution of per-genome mutation counts across genomes in ONE
+    # call is what the engine draws; estimate it by edit distance proxy:
+    # count differing positions of equal-length results (substitutions)
+    sub_counts = []
+    for g, i in muts:
+        if len(g) == len(genomes[i]):
+            d = sum(a != b for a, b in zip(g, genomes[i]))
+            sub_counts.append(d)
+    ks = np.arange(1, 8)
+    # the sample keeps only genomes whose k mutations were ALL
+    # substitutions (equal length), which happens with prob (1-p_indel)^k
+    # = 0.6^k — so the expected count distribution is
+    # P(k) ∝ Poisson(k; p·len) · 0.6^k, renormalised over k >= 1
+    pmf = _poisson_pmf(ks, lam) * 0.6**ks
+    pmf = pmf / pmf.sum()
+    hist = np.bincount(sub_counts, minlength=9)[1:8].astype(float)
+    hist = hist / max(hist.sum(), 1)
+    ax.bar(ks - 0.15, hist, width=0.3, label="engine (subst.-only genomes)")
+    ax.bar(ks + 0.15, pmf, width=0.3,
+           label="Poisson(p·len)·(1-p_indel)^k")
+    ax.set_xlabel("mutations per mutated genome")
+    ax.set_ylabel("fraction")
+    ax.set_title(f"point mutations, p={p}, len={size}")
+    ax.legend()
+
+
+def length_drift(ax):
+    rng = random.Random(1)
+    size = 1000
+    genomes = [random_genome(s=size, rng=rng) for _ in range(500)]
+    steps = 60
+    means = [size]
+    for step in range(steps):
+        muts = point_mutations(genomes, p=1e-3, seed=step)
+        for g, i in muts:
+            genomes[i] = g
+        means.append(float(np.mean([len(g) for g in genomes])))
+    ax.plot(means)
+    ax.axhline(size, color="grey", lw=0.8, ls="--")
+    ax.set_xlabel("mutation rounds")
+    ax.set_ylabel("mean genome length")
+    ax.set_title("indel length drift (p_del=0.66 shrinks slowly)")
+
+
+def recombination_conservation(ax):
+    rng = random.Random(2)
+    pairs = [
+        (random_genome(s=800, rng=rng), random_genome(s=1200, rng=rng))
+        for _ in range(3000)
+    ]
+    recs = recombinations(pairs, p=1e-3, seed=3)
+    deltas = []
+    splits = []
+    for g0, g1, i in recs:
+        a, b = pairs[i]
+        deltas.append(len(g0) + len(g1) - len(a) - len(b))
+        splits.append(len(g0))
+    assert all(d == 0 for d in deltas), "length not conserved!"
+    ax.hist(splits, bins=40)
+    ax.axvline(800, color="grey", lw=0.8, ls="--", label="input split")
+    ax.set_xlabel("first-partner length after recombination")
+    ax.set_ylabel("pairs")
+    ax.set_title(f"strand reshuffling, {len(recs)} recombined pairs\n"
+                 "total length conserved in every pair")
+    ax.legend()
+
+
+def main() -> None:
+    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+    mutation_counts(axes[0])
+    length_drift(axes[1])
+    recombination_conservation(axes[2])
+    fig.tight_layout()
+    OUT.mkdir(exist_ok=True)
+    fig.savefig(OUT / "mutations.png", dpi=110)
+    print(f"wrote {OUT / 'mutations.png'}")
+
+
+if __name__ == "__main__":
+    main()

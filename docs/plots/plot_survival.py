@@ -1,0 +1,103 @@
+"""
+Sanity figure for a whole selection run (reference figure counterpart:
+docs/plots/survival_replication.py — same check, own construction): under
+ATP-threshold selection the population must not collapse or explode, the
+selected molecule's mean must stratify between survivors and casualties,
+and slot occupancy must stay high across compactions.
+
+    python docs/plots/plot_survival.py   # writes docs/img/survival.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.util import random_genome
+from magicsoup_tpu.world import World
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+ATP = CHEMISTRY.molname_2_idx["ATP"]
+
+
+def main() -> None:
+    rng = random.Random(13)
+    world = World(chemistry=CHEMISTRY, map_size=64, seed=13)
+    world.spawn_cells([random_genome(s=500, rng=rng) for _ in range(1200)])
+    st = PipelinedStepper(
+        world,
+        mol_name="ATP",
+        kill_below=1.0,
+        divide_above=5.0,
+        divide_cost=4.0,
+        target_cells=1200,
+        genome_size=500,
+        lag=4,
+        p_mutation=1e-4,
+        p_recombination=1e-6,
+    )
+
+    steps = 150
+    pop, atp_mean, occ = [], [], []
+    for i in range(steps):
+        st.step()
+        tr = st.trace[-1]
+        pop.append(tr["alive"])
+        occ.append(tr["alive"] / tr["q"] if tr["alive"] else 0.0)
+    st.drain()
+    st.flush()
+    cm = np.asarray(world.cell_molecules)[: world.n_cells]
+
+    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+
+    ax = axes[0]
+    ax.plot(pop)
+    ax.set_xlabel("step")
+    ax.set_ylabel("live cells (replayed)")
+    ax.set_title(
+        f"population under ATP selection\n"
+        f"kills={st.stats['kills']} divisions={st.stats['divisions']} "
+        f"spawned={st.stats['spawned']}"
+    )
+
+    ax = axes[1]
+    ax.hist(cm[:, ATP], bins=40)
+    ax.axvline(1.0, color="crimson", lw=0.8, label="kill threshold")
+    ax.axvline(5.0, color="seagreen", lw=0.8, label="divide threshold")
+    ax.set_xlabel("intracellular ATP")
+    ax.set_ylabel("cells")
+    ax.set_title("final ATP distribution")
+    ax.legend()
+
+    ax = axes[2]
+    ax.plot(occ)
+    ax.axhline(
+        0.85, color="grey", lw=0.8, ls="--",
+        label="target at benchmark scale (>=10k cells)",
+    )
+    ax.set_ylim(0, 1.05)
+    ax.set_xlabel("step")
+    ax.set_ylabel("live rows / computed prefix q")
+    ax.set_title(
+        f"slot occupancy across {st.stats['compactions']} compactions\n"
+        "(small populations are bounded by the 1024-row ladder quantum)"
+    )
+    ax.legend()
+
+    fig.tight_layout()
+    OUT.mkdir(exist_ok=True)
+    fig.savefig(OUT / "survival.png", dpi=110)
+    print(f"wrote {OUT / 'survival.png'}")
+
+
+if __name__ == "__main__":
+    main()

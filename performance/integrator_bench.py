@@ -117,8 +117,10 @@ def main() -> None:
         print(f"Pallas failed: {type(e).__name__}: {str(e)[:300]}")
 
     # memory-bound model: one step must read the 5 (c,p,s) tensors + 4 (c,p)
-    # + X at least once; XLA re-reads per reduction, Pallas ~once
-    cps_bytes = 5 * c * p * s * 4
+    # + X at least once; XLA re-reads per reduction, Pallas ~once.
+    # N/Nf/Nb/A are stored i16 (2 B), Kmr f32
+    int_bytes = np.dtype(int_np).itemsize
+    cps_bytes = 4 * c * p * s * int_bytes + c * p * s * 4
     cp_bytes = 4 * c * p * 4
     x_bytes = c * s * 4
     min_bytes = cps_bytes + cp_bytes + 2 * x_bytes
