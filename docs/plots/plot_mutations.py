@@ -31,13 +31,7 @@ def mutation_counts(ax):
     rng = random.Random(0)
     n, size, p = 4000, 1000, 1e-3
     genomes = [random_genome(s=size, rng=rng) for _ in range(n)]
-    # count mutated genomes over many independent low-p rounds to build
-    # the per-genome count distribution at lam = p * len
-    counts = np.zeros(n, dtype=np.int64)
     muts = point_mutations(genomes, p=p, seed=17)
-    per_genome = np.zeros(n, dtype=np.int64)
-    for _, i in muts:
-        per_genome[i] += 1  # >= 1 mutation happened for that genome
     lam = p * size
     # distribution of per-genome mutation counts across genomes in ONE
     # call is what the engine draws; estimate it by edit distance proxy:

@@ -2,8 +2,8 @@
 Sanity figure for a whole selection run (reference figure counterpart:
 docs/plots/survival_replication.py — same check, own construction): under
 ATP-threshold selection the population must not collapse or explode, the
-selected molecule's mean must stratify between survivors and casualties,
-and slot occupancy must stay high across compactions.
+survivors' ATP distribution must pile up between the thresholds, and
+slot occupancy must stay high across compactions.
 
     python docs/plots/plot_survival.py   # writes docs/img/survival.png
 """
@@ -47,15 +47,14 @@ def main() -> None:
     )
 
     steps = 150
-    pop, atp_mean, occ = [], [], []
+    pop, occ = [], []
     for i in range(steps):
         st.step()
         tr = st.trace[-1]
         pop.append(tr["alive"])
         occ.append(tr["alive"] / tr["q"] if tr["alive"] else 0.0)
-    st.drain()
-    st.flush()
-    cm = np.asarray(world.cell_molecules)[: world.n_cells]
+    st.flush()  # drains, compacts, and syncs back into the world
+    cm = np.asarray(world.cell_molecules)
 
     fig, axes = plt.subplots(1, 3, figsize=(14, 4))
 

@@ -21,8 +21,10 @@ mkdir -p "$OUT"
 # drop (or a half-dead hang inside one bench child) should fail fast
 # here and hand control back to the watcher, not poll for 30 minutes
 # per harness.  600 s per attempt leaves room for a cold-cache compile
-# warmup; the run() wrapper's `timeout 1800` stays the hard cap.
-export MAGICSOUP_BENCH_RETRY_BUDGET="${MAGICSOUP_BENCH_RETRY_BUDGET:-240}"
+# warmup, and the retry budget EXCEEDS it so a first attempt killed at
+# the timeout (its compiles persist in the cache) still gets one fast
+# retry — a budget below the attempt timeout can never retry at all.
+export MAGICSOUP_BENCH_RETRY_BUDGET="${MAGICSOUP_BENCH_RETRY_BUDGET:-900}"
 export MAGICSOUP_BENCH_ATTEMPT_TIMEOUT="${MAGICSOUP_BENCH_ATTEMPT_TIMEOUT:-600}"
 
 probe() {
@@ -53,13 +55,13 @@ run() {
     fi
 }
 
-run bench           1200 python bench.py
+run bench           1800 python bench.py
 run integrator       600 python performance/integrator_bench.py
 run pallas_bisect   1500 python performance/pallas_bisect.py
-run bench_40k       1200 python bench.py --config 40k --warmup 4 --steps 8
+run bench_40k       1800 python bench.py --config 40k --warmup 4 --steps 8
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
-run bench_diffusion 1200 python bench.py --config diffusion --warmup 4 --steps 8
-run bench_det       1200 python bench.py --det --warmup 4 --steps 8
+run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8
+run bench_det       1800 python bench.py --det --warmup 4 --steps 8
 run bitrepro         900 python scripts/bitrepro.py
 run check           1200 python performance/check.py
 
