@@ -19,15 +19,21 @@ not pin a platform here.
 Robustness: the accelerator is reached over a tunnel that can drop.  The
 parent process never imports jax; it probes the backend and runs the real
 measurement in child processes with bounded retry/backoff
-(MAGICSOUP_BENCH_RETRY_BUDGET seconds total, default 900).  If every
-attempt fails, it still prints one parseable JSON line with an "error"
-field instead of dying with a traceback.
+(MAGICSOUP_BENCH_RETRY_BUDGET seconds total, default 1200 — deliberately
+well under the driver's ~30 min kill window).  Result lines are forwarded
+to stdout the moment the child prints them (the classic-loop number is
+printed before the pipelined bench starts), so a later hang or kill cannot
+erase an already-measured number.  If every attempt fails, it still prints
+one parseable JSON line with an "error" field instead of dying with a
+traceback — including when the driver SIGTERMs it.
 """
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -53,6 +59,10 @@ CONFIGS = {
     "40k": {"n_cells": 40_000, "map_size": 256},
     "diffusion": {"n_cells": 10_000, "map_size": 512},
 }
+
+# optional platform pin for CPU smoke tests of this harness (the real
+# bench runs on whatever the driver provides and leaves this unset)
+_PLATFORM = os.environ.get("MAGICSOUP_BENCH_PLATFORM", "")
 
 # stderr markers that indicate a transient backend/tunnel failure worth retrying
 _TRANSIENT_MARKERS = (
@@ -129,6 +139,10 @@ def _child_main(args: argparse.Namespace) -> None:
 
     import jax
 
+    if _PLATFORM:
+        # test/CI hook: the axon TPU plugin ignores JAX_PLATFORMS, so CPU
+        # smoke runs of this harness need the config-level pin
+        jax.config.update("jax_platforms", _PLATFORM)
     _setup_compile_cache(jax)
 
     import magicsoup_tpu as ms
@@ -194,6 +208,41 @@ def _child_main(args: argparse.Namespace) -> None:
     float(world._cell_molecules[0, 0])
     dt = dt_classic = (time.perf_counter() - t0) / args.steps
 
+    mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
+    metric_name = (
+        f"sim steps/sec ({args.n_cells} cells, "
+        f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
+        f"run_simulation workload){mode}"
+    )
+
+    def emit(steps_per_s: float, **fields) -> None:
+        print(
+            json.dumps(
+                {
+                    "metric": metric_name,
+                    "value": round(steps_per_s, 4),
+                    "unit": "steps/s",
+                    "vs_baseline": round(
+                        steps_per_s * baseline_s_per_step(args.n_cells), 4
+                    ),
+                    "device_rtt_ms": round(rtt_ms, 1),
+                    # the serial loop's throughput with its one per-step
+                    # fetch subtracted — the co-located-hardware proxy the
+                    # pipelined driver is judged against
+                    "rtt_free_steps_per_s": round(
+                        1.0 / max(dt_classic - rtt_ms / 1e3, 1e-9), 4
+                    ),
+                    **fields,
+                }
+            ),
+            flush=True,
+        )
+
+    # print the classic number the moment it exists: a hang or kill later
+    # in the pipelined bench must not erase an already-measured result
+    # (the parent forwards this line to the driver immediately)
+    emit(1.0 / dt_classic, driver="classic")
+
     extra = {}
     if not args.classic:
         # The device-resident pipelined driver (magicsoup_tpu/stepper.py):
@@ -235,6 +284,13 @@ def _child_main(args: argparse.Namespace) -> None:
         if trace:
             # per-step diagnosis to stderr: where a slow window's time
             # went (cold compiles / blocked fetches / dispatch overhead)
+            if len(trace) < n_pipe:
+                # the stepper bounds its trace ring; sums below would
+                # silently underreport a window longer than the ring
+                sys.stderr.write(
+                    f"[trace] WARNING: trace holds {len(trace)} of "
+                    f"{n_pipe} measured steps; sums are partial\n"
+                )
             tt = sorted(t["t"] for t in trace)
             mid = tt[len(tt) // 2]
             p90 = tt[int(len(tt) * 0.9)]
@@ -253,37 +309,15 @@ def _child_main(args: argparse.Namespace) -> None:
             slow = [t for t in trace if t["t"] > 3 * mid]
             for t in slow[:8]:
                 sys.stderr.write(f"[trace-slow] {t}\n")
-        # headline = the faster driver of the same workload (both are
-        # reported; the pipelined driver exists to beat the serial loop,
-        # but must never hide a regression behind it)
+        # headline = the faster driver of the same workload (both rates
+        # are reported and "driver" records which one won, so cross-run
+        # comparisons stay interpretable; the pipelined driver exists to
+        # beat the serial loop but must never hide a regression behind it)
         dt = min(dt_pipe, dt)
+        extra["driver"] = "pipelined" if dt_pipe <= dt_classic else "classic"
 
-    steps_per_s = 1.0 / dt
-    mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"sim steps/sec ({args.n_cells} cells, "
-                    f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
-                    f"run_simulation workload){mode}"
-                ),
-                "value": round(steps_per_s, 4),
-                "unit": "steps/s",
-                "vs_baseline": round(
-                    steps_per_s * baseline_s_per_step(args.n_cells), 4
-                ),
-                "device_rtt_ms": round(rtt_ms, 1),
-                # the serial loop's throughput with its one per-step fetch
-                # subtracted — the co-located-hardware proxy the pipelined
-                # driver is judged against ("raw within 20% of rtt-free")
-                "rtt_free_steps_per_s": round(
-                    1.0 / max(dt_classic - rtt_ms / 1e3, 1e-9), 4
-                ),
-                **extra,
-            }
-        )
-    )
+    if extra:
+        emit(1.0 / dt, **extra)
 
 
 def _looks_transient(stderr: str) -> bool:
@@ -294,9 +328,16 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     """Cheaply check the accelerator responds before paying for a full
     bench attempt.  A half-down tunnel hangs forever on first jax use, so
     the probe gets its own (short) timeout."""
+    code = "import jax; jax.devices()"
+    if _PLATFORM:
+        code = (
+            "import jax; "
+            f"jax.config.update('jax_platforms', {_PLATFORM!r}); "
+            "jax.devices()"
+        )
     try:
         res = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", code],
             capture_output=True,
             text=True,
             timeout=timeout_s,
@@ -306,6 +347,64 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     if res.returncode != 0:
         return False, res.stderr[-2000:]
     return True, ""
+
+
+def _is_result_line(line: str) -> bool:
+    line = line.strip()
+    if not line.startswith("{"):
+        return False
+    try:
+        d = json.loads(line)
+    except ValueError:
+        return False
+    return isinstance(d, dict) and "value" in d and "metric" in d
+
+
+def _run_attempt(
+    child_cmd: list[str], timeout_s: float, state: dict
+) -> tuple[int, str]:
+    """Run one measurement child, forwarding every JSON result line to our
+    stdout THE MOMENT it appears (sets state['printed']) so a later hang,
+    crash or driver kill cannot erase an already-measured number.  Returns
+    (returncode, stderr_tail); returncode -1 means the attempt timed out.
+    """
+    proc = subprocess.Popen(
+        child_cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    # visible to the SIGTERM handler: an orphaned child would keep the
+    # one-job-at-a-time accelerator busy after the parent dies
+    state["proc"] = proc
+    stderr_chunks: list[str] = []
+
+    def _read_out() -> None:
+        for line in proc.stdout:
+            if _is_result_line(line):
+                print(line.rstrip("\n"), flush=True)
+                state["printed"] = True
+
+    def _read_err() -> None:
+        # drain continuously: a full stderr pipe would deadlock the child
+        for line in proc.stderr:
+            stderr_chunks.append(line)
+
+    t_out = threading.Thread(target=_read_out, daemon=True)
+    t_err = threading.Thread(target=_read_err, daemon=True)
+    t_out.start()
+    t_err.start()
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rc = -1
+    finally:
+        state["proc"] = None
+    t_out.join(timeout=10)
+    t_err.join(timeout=10)
+    return rc, "".join(stderr_chunks)[-4000:]
 
 
 def _apply_config(args: argparse.Namespace) -> None:
@@ -327,69 +426,114 @@ def main() -> None:
         _child_main(args)
         return
 
-    # 30 min default: the tunnel has been observed down for multi-hour
-    # stretches, and a successful first probe costs nothing
-    budget_s = float(os.environ.get("MAGICSOUP_BENCH_RETRY_BUDGET", "1800"))
+    # 20 min default: deliberately WELL UNDER the driver's observed
+    # ~30 min kill window (BENCH_r02/r03 died at rc=124 with the old
+    # 30 min budget before the structured-failure line could print)
+    budget_s = float(os.environ.get("MAGICSOUP_BENCH_RETRY_BUDGET", "1200"))
     attempt_timeout_s = float(
-        os.environ.get("MAGICSOUP_BENCH_ATTEMPT_TIMEOUT", "1800")
+        os.environ.get("MAGICSOUP_BENCH_ATTEMPT_TIMEOUT", "900")
     )
     child_cmd = [sys.executable, str(Path(__file__).resolve()), "--_child"] + [
         a for a in sys.argv[1:]
     ]
 
     deadline = time.monotonic() + budget_s
+    state = {"printed": False, "last_err": "", "proc": None}
+    mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
+
+    def _fail_json() -> str:
+        return json.dumps(
+            {
+                "metric": (
+                    f"sim steps/sec ({args.n_cells} cells, "
+                    f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
+                    f"run_simulation workload){mode}"
+                ),
+                "value": 0.0,
+                "unit": "steps/s",
+                "vs_baseline": 0.0,
+                "error": state["last_err"][-1500:],
+                "attempts": state.get("attempt", 0),
+            }
+        )
+
+    def _on_term(signum, frame):
+        # the driver is killing us: leave a parseable line behind unless a
+        # real result already went out, and never orphan a measurement
+        # child (it would keep the one-job-at-a-time accelerator busy)
+        proc = state.get("proc")
+        if proc is not None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if not state["printed"]:
+            state["last_err"] = (
+                f"killed by signal {signum}; last: {state['last_err']}"
+            )
+            print(_fail_json(), flush=True)
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     backoff_s = 20.0
-    last_err = ""
     attempt = 0
     while True:
         attempt += 1
-        ok, probe_err = _probe_backend(timeout_s=120.0)
+        state["attempt"] = attempt
+        remaining = deadline - time.monotonic()
+        if remaining < 10:
+            break
+        ok, probe_err = _probe_backend(timeout_s=min(60.0, remaining))
         if ok:
-            try:
-                res = subprocess.run(
-                    child_cmd,
-                    capture_output=True,
-                    text=True,
-                    timeout=attempt_timeout_s,
+            remaining = deadline - time.monotonic()
+            if remaining < 30:
+                state["last_err"] = "backend up but retry budget exhausted"
+                break
+            # an attempt may never outlive the overall budget: a hang is
+            # killed in time for the structured failure line to print
+            rc, err_tail = _run_attempt(
+                child_cmd, min(attempt_timeout_s, remaining), state
+            )
+            if state["printed"]:
+                # at least one measured number reached stdout — success,
+                # even if a later phase of the child crashed or hung
+                sys.stderr.write(err_tail)
+                if rc != 0:
+                    sys.stderr.write(
+                        f"\n[bench] note: child rc={rc} after a result line"
+                        " was already emitted\n"
+                    )
+                return
+            state["last_err"] = (
+                f"bench attempt hung (> {min(attempt_timeout_s, remaining):.0f}s)"
+                if rc == -1
+                else err_tail or f"rc={rc}, no output"
+            )
+            if rc == 0:
+                # exited cleanly yet printed no result line: deterministic
+                # bug, retrying cannot help
+                state["last_err"] = (
+                    "child exited 0 without a result line; stderr: "
+                    + state["last_err"]
                 )
-            except subprocess.TimeoutExpired:
-                last_err = f"bench attempt hung (> {attempt_timeout_s:.0f}s)"
-            else:
-                if res.returncode == 0 and res.stdout.strip():
-                    sys.stderr.write(res.stderr)
-                    print(res.stdout.strip().splitlines()[-1])
-                    return
-                last_err = res.stderr[-2000:] or f"rc={res.returncode}, no output"
-                if not _looks_transient(last_err):
-                    break  # a real bug; retrying won't help
+                break
+            if rc != -1 and not _looks_transient(state["last_err"]):
+                break  # a real bug; retrying won't help
         else:
-            last_err = probe_err
+            state["last_err"] = probe_err
 
         if time.monotonic() + backoff_s > deadline:
             break
         sys.stderr.write(
             f"[bench] attempt {attempt} failed (transient), retrying in "
-            f"{backoff_s:.0f}s: {last_err.splitlines()[-1] if last_err else '?'}\n"
+            f"{backoff_s:.0f}s: "
+            f"{state['last_err'].splitlines()[-1] if state['last_err'] else '?'}\n"
         )
         time.sleep(backoff_s)
-        backoff_s = min(backoff_s * 2, 180.0)
+        backoff_s = min(backoff_s * 2, 120.0)
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"sim steps/sec ({args.n_cells} cells, "
-                    f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
-                    "run_simulation workload)"
-                ),
-                "value": 0.0,
-                "unit": "steps/s",
-                "vs_baseline": 0.0,
-                "error": last_err[-1500:],
-                "attempts": attempt,
-            }
-        )
-    )
+    print(_fail_json(), flush=True)
     sys.exit(1)
 
 

@@ -1,0 +1,108 @@
+"""
+Kinetic-constant sanity figures (the reference's figure family 11,
+`docs/plots/kinetic_constants.py` / `docs/figures.md` §11): the analytic
+Michaelis-Menten and allosteric-modulation curves the integrator is
+built on, plus Vmax/Km/Ka distributions of randomly generated proteins —
+the distribution shapes catch token-map regressions that exact-value
+tests don't cover.
+
+    python docs/plots/plot_constants.py  # writes docs/img/constants.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+N_CELLS = 1000
+
+
+def _mm_curves(ax) -> None:
+    x = np.linspace(0, 10, 200)
+    for n in (1, 2, 3):
+        ax.plot(x, x**n / (x**n + 1.0), label=f"n={n}")
+    ax.set_title("MM velocity  y = x^n/(x^n+Km), Km=1", fontsize=9)
+    ax.set_xlabel("[S] (mM)")
+    ax.set_ylabel("v / Vmax")
+    ax.legend(fontsize=7)
+
+
+def _allosteric_curves(ax) -> None:
+    x = np.linspace(0.01, 10, 200)
+    for ka in (0.5, 2.0):
+        for h in (1, 3, 5):
+            ax.plot(
+                x, x**h / (x**h + ka**h),
+                label=f"Ka={ka} h={h}", lw=1.0,
+            )
+    ax.set_title("allosteric occupancy  x^h/(x^h+Ka^h)", fontsize=9)
+    ax.set_xlabel("[ligand] (mM)")
+    ax.set_ylabel("occupancy")
+    ax.legend(fontsize=6, ncol=2)
+
+
+def _random_constant_distributions(axs_lin, axs_log) -> None:
+    """Vmax/Km/Ka of the proteomes of N_CELLS random genomes, pulled from
+    the interpreted :class:`Protein` views (the same path users see)."""
+    rng = random.Random(1)
+    world = ms.World(chemistry=CHEMISTRY, map_size=64, seed=1)
+    world.spawn_cells([random_genome(s=1000, rng=rng) for _ in range(N_CELLS)])
+    vmaxs: list[float] = []
+    kms: list[float] = []
+    kas: list[float] = []
+    for idx in range(world.n_cells):
+        cell = world.get_cell(by_idx=idx)
+        for prot in cell.proteome:
+            for dom in prot.domains:
+                if getattr(dom, "vmax", None) is not None:
+                    vmaxs.append(dom.vmax)
+                km = getattr(dom, "km", None)
+                if km is not None:
+                    if type(dom).__name__ == "RegulatoryDomain":
+                        kas.append(km)
+                    else:
+                        kms.append(km)
+    for axl, axg, vals, name in (
+        (axs_lin[0], axs_log[0], vmaxs, "Vmax"),
+        (axs_lin[1], axs_log[1], kms, "Km"),
+        (axs_lin[2], axs_log[2], kas, "Ka"),
+    ):
+        arr = np.asarray(vals, dtype=np.float64)
+        med = float(np.median(arr))
+        axl.hist(arr, bins=60, color="C0")
+        axl.axvline(med, ls="--", c="k", lw=0.8)
+        axl.set_title(f"{name} (n={len(arr)}, median {med:.2g})", fontsize=8)
+        axg.hist(np.log10(arr), bins=60, color="C1")
+        axg.axvline(np.log10(med), ls="--", c="k", lw=0.8)
+        axg.set_title(f"log10 {name}", fontsize=8)
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig = plt.figure(figsize=(13, 9))
+    gs = fig.add_gridspec(3, 3)
+    _mm_curves(fig.add_subplot(gs[0, 0]))
+    _allosteric_curves(fig.add_subplot(gs[0, 1]))
+    fig.add_subplot(gs[0, 2]).axis("off")
+    axs_lin = [fig.add_subplot(gs[1, i]) for i in range(3)]
+    axs_log = [fig.add_subplot(gs[2, i]) for i in range(3)]
+    _random_constant_distributions(axs_lin, axs_log)
+    fig.tight_layout()
+    fig.savefig(OUT / "constants.png", dpi=120)
+    print(f"wrote {OUT / 'constants.png'}")
+
+
+if __name__ == "__main__":
+    main()

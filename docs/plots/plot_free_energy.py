@@ -1,0 +1,88 @@
+"""
+Free-energy sanity figure (the reference's figure family 8,
+`docs/plots/free_energy.py` / `docs/figures.md` §8): energy and entropy
+density over time for simulations with only diffusion, only enzymatic
+activity, and both.  Catalysis must dissipate energy (monotone-ish decay
+toward equilibrium), diffusion must raise entropy — a thermodynamic
+sanity check on the whole integrator no unit test expresses.
+
+    python docs/plots/plot_free_energy.py  # writes docs/img/free_energy.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+MAP_SIZE = 32
+N_STEPS = 120
+EPS = 1e-7
+
+
+def _energies() -> np.ndarray:
+    return np.array([m.energy for m in CHEMISTRY.molecules], dtype=np.float64)
+
+
+def _measure(world: ms.World, energies: np.ndarray) -> tuple[float, float]:
+    mm = np.asarray(world.molecule_map, dtype=np.float64)  # (m, s, s)
+    x = np.clip(mm, EPS, None)
+    entropy = float(-(x * np.log(x)).sum() / (MAP_SIZE * MAP_SIZE))
+    energy = float((mm * energies[:, None, None]).sum() / (MAP_SIZE * MAP_SIZE))
+    return energy, entropy
+
+
+def _run(do_diffuse: bool, do_enzymes: bool, seed: int = 5):
+    rng = random.Random(seed)
+    world = ms.World(chemistry=CHEMISTRY, map_size=MAP_SIZE, seed=seed)
+    # ~50% confluency of random-genome cells
+    world.spawn_cells(
+        [random_genome(s=1000, rng=rng) for _ in range(MAP_SIZE * MAP_SIZE // 2)]
+    )
+    energies = _energies()
+    es, ss = [], []
+    for _ in range(N_STEPS):
+        if do_enzymes:
+            world.enzymatic_activity()
+        if do_diffuse:
+            world.diffuse_molecules()
+        e, s = _measure(world, energies)
+        es.append(e)
+        ss.append(s)
+    return es, ss
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    runs = {
+        "diffusion only": _run(do_diffuse=True, do_enzymes=False),
+        "enzymes only": _run(do_diffuse=False, do_enzymes=True),
+        "diffusion + enzymes": _run(do_diffuse=True, do_enzymes=True),
+    }
+    fig, (ax_s, ax_e) = plt.subplots(2, 1, figsize=(8, 6), sharex=True)
+    for label, (es, ss) in runs.items():
+        ax_s.plot(ss, label=label)
+        ax_e.plot(es, label=label)
+    ax_s.set_ylabel("entropy / pixel  (-sum x ln x)")
+    ax_s.set_title("extracellular entropy and energy density over time")
+    ax_s.legend()
+    ax_e.set_ylabel("energy / pixel (J)")
+    ax_e.set_xlabel("step")
+    fig.tight_layout()
+    fig.savefig(OUT / "free_energy.png", dpi=120)
+    print(f"wrote {OUT / 'free_energy.png'}")
+
+
+if __name__ == "__main__":
+    main()

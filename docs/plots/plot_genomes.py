@@ -1,0 +1,119 @@
+"""
+Genome-composition sanity figures (the reference's figure family 1,
+`docs/plots/genomes.py` / `docs/figures.md` §1): distributions of
+proteins per genome, domains per protein and coding fraction for random
+genomes at different sizes and domain-type frequencies.  These catch
+regressions in the codon/token sampling of :class:`Genetics` that no
+golden-value test sees.
+
+    python docs/plots/plot_genomes.py   # writes docs/img/genomes.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+from magicsoup_tpu.genetics import Genetics
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+N_GENOMES = 500
+
+
+def _stats(gen: Genetics, size: int, n: int, rng) -> dict[str, np.ndarray]:
+    genomes = [random_genome(s=size, rng=rng) for _ in range(n)]
+    prot_counts, prots, doms = gen.translate_genomes_flat(genomes)
+    n_prots = prot_counts.astype(np.int64)
+    doms_per_prot = prots[:, 3].astype(np.int64)
+
+    # coding fraction: base pairs covered by >= 1 domain, per genome
+    coding = np.zeros(n, dtype=np.float64)
+    pi = 0
+    di = 0
+    for g, count in enumerate(prot_counts.tolist()):
+        mask = np.zeros(size, dtype=bool)
+        for p in range(count):
+            cds_start, cds_end, is_fwd, n_doms = prots[pi].tolist()
+            for dom in doms[di : di + n_doms].tolist():
+                start, end = dom[5], dom[6]
+                if is_fwd:
+                    lo, hi = cds_start + start, cds_start + end
+                else:
+                    # reverse-complement CDS: map parse coords to 5'-3'
+                    lo, hi = size - (cds_start + end), size - (cds_start + start)
+                mask[max(lo, 0) : min(hi, size)] = True
+            pi += 1
+            di += n_doms
+        coding[g] = mask.mean()
+    return {"prots": n_prots, "doms": doms_per_prot, "coding": coding}
+
+
+def _violin(ax, data: list[np.ndarray], labels: list[str], title: str) -> None:
+    data = [np.asarray(d, dtype=np.float64) for d in data]
+    ax.violinplot(data, showextrema=False)
+    for i, d in enumerate(data):
+        med = float(np.median(d))
+        ax.hlines(med, i + 0.8, i + 1.2, color="k", ls="--", lw=0.8)
+        ax.text(i + 1.25, med, f"{med:.2f}", fontsize=7, va="center")
+    ax.set_xticks(range(1, len(labels) + 1), labels)
+    ax.set_title(title, fontsize=9)
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    rng = random.Random(0)
+    fig, axs = plt.subplots(2, 3, figsize=(13, 7))
+
+    # row 1: genome sizes at the default 1% domain frequency
+    sizes = [200, 500, 1000, 2000]
+    gen = Genetics(seed=0)
+    by_size = {s: _stats(gen, s, N_GENOMES, rng) for s in sizes}
+    labels = [str(s) for s in sizes]
+    _violin(
+        axs[0, 0], [by_size[s]["prots"] for s in sizes], labels,
+        "proteins / genome vs genome size",
+    )
+    _violin(
+        axs[0, 1], [by_size[s]["doms"] for s in sizes], labels,
+        "domains / protein vs genome size",
+    )
+    _violin(
+        axs[0, 2], [by_size[s]["coding"] for s in sizes], labels,
+        "coding bp fraction vs genome size",
+    )
+
+    # row 2: domain-type frequencies at size 1000 (p split over 3 types)
+    freqs = [0.001, 0.01, 0.1]
+    by_freq = {}
+    for p in freqs:
+        g = Genetics(
+            p_catal_dom=p, p_transp_dom=p, p_reg_dom=p, seed=0
+        )
+        by_freq[p] = _stats(g, 1000, N_GENOMES, rng)
+    labels = [f"{p:.1%}" for p in freqs]
+    _violin(
+        axs[1, 0], [by_freq[p]["prots"] for p in freqs], labels,
+        "proteins / genome vs domain freq (size 1000)",
+    )
+    _violin(
+        axs[1, 1], [by_freq[p]["doms"] for p in freqs], labels,
+        "domains / protein vs domain freq",
+    )
+    _violin(
+        axs[1, 2], [by_freq[p]["coding"] for p in freqs], labels,
+        "coding bp fraction vs domain freq",
+    )
+
+    for ax in axs.flat:
+        ax.set_xlabel("")
+    fig.tight_layout()
+    fig.savefig(OUT / "genomes.png", dpi=120)
+    print(f"wrote {OUT / 'genomes.png'}")
+
+
+if __name__ == "__main__":
+    main()

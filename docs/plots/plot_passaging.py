@@ -1,0 +1,115 @@
+"""
+Passaging-selection sanity figures (the reference's figure family 10,
+`docs/plots/survival_replication.py` passaging part / `docs/figures.md`
+§10): growth of 4 cell lines with different division rates under random
+vs biased passaging.  A pure probabilistic model (no World needed) of
+the standard experiment described in docs/tutorials.md — shows how the
+passaging regime decides whether the fastest grower takes over.
+
+    python docs/plots/plot_passaging.py  # writes docs/img/passaging.png
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+# the canonical selection probabilities (reference `docs/figures.md` §9/10)
+X_BY_LINE = np.array([3.0, 4.0, 5.0, 6.0])
+SPLIT_AT = 7_000
+N_STEPS = 1_000
+START_PER_LINE = 250
+
+
+def p_divide(x: np.ndarray) -> np.ndarray:
+    return x**5 / (x**5 + 15.0**5)
+
+
+def p_die(x: np.ndarray) -> np.ndarray:
+    return 1.0**7 / (x**7 + 1.0**7)
+
+
+def _grow_one_step(counts: np.ndarray, rng) -> np.ndarray:
+    divs = rng.binomial(counts, p_divide(X_BY_LINE))
+    dies = rng.binomial(counts, p_die(X_BY_LINE))
+    return np.maximum(counts + divs - dies, 0)
+
+
+def _passage_random(counts: np.ndarray, ratio: float, rng) -> np.ndarray:
+    """Keep each cell with probability ``ratio``, blind to its line."""
+    return rng.binomial(counts, ratio)
+
+
+def _passage_biased(counts: np.ndarray, ratio: float, bias: float, rng):
+    """Sample so that a ``bias`` fraction of the kept cells is spread
+    evenly across (non-empty) lines, the rest proportionally."""
+    total = counts.sum()
+    keep = int(total * ratio)
+    alive = counts > 0
+    even = np.where(alive, keep * bias / max(alive.sum(), 1), 0.0)
+    prop = counts / max(total, 1) * keep * (1.0 - bias)
+    target = np.minimum(np.maximum(even + prop, 0.0), counts)
+    return rng.binomial(counts, np.clip(target / np.maximum(counts, 1), 0, 1))
+
+
+def _simulate(passage_fn, rng) -> tuple[np.ndarray, list[tuple[int, np.ndarray]]]:
+    counts = np.full(4, START_PER_LINE, dtype=np.int64)
+    history = np.zeros((N_STEPS, 4), dtype=np.int64)
+    passages: list[tuple[int, np.ndarray]] = []
+    for step in range(N_STEPS):
+        counts = _grow_one_step(counts, rng)
+        if counts.sum() >= SPLIT_AT:
+            passages.append((step, counts / max(counts.sum(), 1)))
+            counts = passage_fn(counts)
+        history[step] = counts
+    return history, passages
+
+
+def _draw(ax, history: np.ndarray, passages, title: str) -> None:
+    ax.fill_between(
+        range(N_STEPS), history.sum(axis=1), color="0.85", label="total cells"
+    )
+    for step, fracs in passages:
+        bottom = 0.0
+        for line in range(4):
+            ax.bar(
+                step, fracs[line] * SPLIT_AT, width=12, bottom=bottom,
+                color=f"C{line}",
+            )
+            bottom += fracs[line] * SPLIT_AT
+    ax.set_title(title, fontsize=9)
+    ax.set_xlabel("step")
+    ax.set_ylabel("cells")
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig, axs = plt.subplots(2, 3, figsize=(14, 7))
+    for ax, ratio in zip(axs[0], (0.1, 0.2, 0.3)):
+        rng = np.random.default_rng(7)
+        hist, passages = _simulate(
+            lambda c: _passage_random(c, ratio, rng), rng
+        )
+        _draw(ax, hist, passages, f"random passaging, ratio {ratio}")
+    for ax, bias in zip(axs[1], (0.1, 0.5, 0.9)):
+        rng = np.random.default_rng(7)
+        hist, passages = _simulate(
+            lambda c: _passage_biased(c, 0.2, bias, rng), rng
+        )
+        _draw(ax, hist, passages, f"biased passaging 0.2, bias {bias}")
+    handles = [
+        plt.Line2D([], [], color=f"C{i}", lw=4, label=f"line x={X_BY_LINE[i]}")
+        for i in range(4)
+    ]
+    fig.legend(handles=handles, loc="lower center", ncol=4, fontsize=8)
+    fig.tight_layout(rect=(0, 0.05, 1, 1))
+    fig.savefig(OUT / "passaging.png", dpi=120)
+    print(f"wrote {OUT / 'passaging.png'}")
+
+
+if __name__ == "__main__":
+    main()

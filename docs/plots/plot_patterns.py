@@ -1,0 +1,257 @@
+"""
+Biochemical-pattern sanity figures (the reference's figure family 7,
+`docs/plots/biochemical_patterns.py` / `docs/figures.md` §7): designed
+proteomes whose emergent dynamics — a relay switch, a bistable switch,
+signal propagation between cells, a cyclic pathway — exercise the whole
+genome->proteome->kinetics stack in ways no unit test can.  Each panel
+builds a genome with :class:`magicsoup_tpu.factories.GenomeFact`, spawns
+cells and drives ``enzymatic_activity`` step by step.
+
+    python docs/plots/plot_patterns.py   # writes docs/img/patterns.png
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.factories import (
+    CatalyticDomainFact,
+    GenomeFact,
+    RegulatoryDomainFact,
+)
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+
+
+def _spawn_designed(world: ms.World, proteome, n: int = 1) -> list[int]:
+    """Spawn ``n`` cells whose genomes encode exactly ``proteome``."""
+    fact = GenomeFact(world=world, proteome=proteome)
+    idxs: list[int] = []
+    while len(idxs) < n:
+        idxs += world.spawn_cells([fact.generate()])
+    return idxs
+
+
+def switch_relay(ax) -> None:
+    """A<->B interconversion flipped by a third molecule C: protein 1
+    (A+E->B) is inhibited by C, protein 2 (B+E->A) is activated by C, so
+    adding/removing C toggles which direction wins."""
+    a = ms.Molecule("patA", 10e3)
+    b = ms.Molecule("patB", 10e3)
+    c = ms.Molecule("patC", 10e3)
+    e = ms.Molecule("patE", 100e3)
+    chem = ms.Chemistry(
+        molecules=[a, b, c, e], reactions=[([a, e], [b]), ([b, e], [a])]
+    )
+    world = ms.World(chemistry=chem, map_size=8, seed=11)
+    proteome = [
+        [
+            CatalyticDomainFact(reaction=([a, e], [b]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=c, is_transmembrane=False, is_inhibiting=True,
+                km=1.0, hill=1,
+            ),
+        ],
+        [
+            CatalyticDomainFact(reaction=([b, e], [a]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=c, is_transmembrane=False, is_inhibiting=False,
+                km=1.0, hill=1,
+            ),
+        ],
+    ]
+    (ci,) = _spawn_designed(world, proteome)
+    ia, ib, ic, ie = (chem.mol_2_idx[m] for m in (a, b, c, e))
+
+    traj = {ia: [], ib: []}
+    flips = []
+    c_on = False
+    for step in range(240):
+        cm = world.cell_molecules.copy()
+        cm[ci, ie] = 10.0  # E is supplied each step
+        if step % 60 == 0:
+            c_on = not c_on
+            cm[ci, ic] = 4.0 if c_on else 0.0
+            flips.append(step)
+        world.cell_molecules = cm
+        world.enzymatic_activity()
+        cm = world.cell_molecules.copy()
+        traj[ia].append(cm[ci, ia])
+        traj[ib].append(cm[ci, ib])
+    ax.plot(traj[ia], label="A")
+    ax.plot(traj[ib], label="B")
+    for s in flips:
+        ax.axvline(s, ls="--", c="gray", lw=0.7)
+    ax.set_title("switch relay (C toggles A<->B)")
+    ax.set_xlabel("step")
+    ax.set_ylabel("mM (intracellular)")
+    ax.legend()
+
+
+def bistable_switch(ax_l, ax_r) -> None:
+    """Two mutually-converting molecules whose enzymes are inhibited by
+    their own substrate: whichever species starts higher locks in."""
+    a = ms.Molecule("patA2", 10e3)
+    b = ms.Molecule("patB2", 10e3)
+    e = ms.Molecule("patE2", 100e3)
+    chem = ms.Chemistry(
+        molecules=[a, b, e], reactions=[([a, e], [b]), ([b, e], [a])]
+    )
+    proteome = [
+        [
+            CatalyticDomainFact(reaction=([a, e], [b]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=a, is_transmembrane=False, is_inhibiting=True,
+                km=1.0, hill=1,
+            ),
+        ],
+        [
+            CatalyticDomainFact(reaction=([b, e], [a]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=b, is_transmembrane=False, is_inhibiting=True,
+                km=1.0, hill=1,
+            ),
+        ],
+    ]
+    for ax, (a0, b0), title in (
+        (ax_l, (2.2, 2.0), "A starts higher"),
+        (ax_r, (2.0, 2.2), "B starts higher"),
+    ):
+        world = ms.World(chemistry=chem, map_size=8, seed=13)
+        (ci,) = _spawn_designed(world, proteome)
+        ia, ib, ie = (chem.mol_2_idx[m] for m in (a, b, e))
+        cm = world.cell_molecules.copy()
+        cm[ci, ia] = a0
+        cm[ci, ib] = b0
+        world.cell_molecules = cm
+        ta, tb = [], []
+        for _ in range(150):
+            cm = world.cell_molecules.copy()
+            cm[ci, ie] = 10.0
+            world.cell_molecules = cm
+            world.enzymatic_activity()
+            cm = world.cell_molecules.copy()
+            ta.append(cm[ci, ia])
+            tb.append(cm[ci, ib])
+        ax.plot(ta, label="A")
+        ax.plot(tb, label="B")
+        ax.set_title(f"bistable switch ({title})")
+        ax.set_xlabel("step")
+        ax.legend()
+
+
+def switch_cascade(ax) -> None:
+    """Bistable-switch cells with membrane-permeable A/B: the state of
+    the loudest cell propagates to its neighbours through the map."""
+    a = ms.Molecule("patA3", 10e3, permeability=0.1)
+    b = ms.Molecule("patB3", 10e3, permeability=0.1)
+    e = ms.Molecule("patE3", 100e3)
+    chem = ms.Chemistry(
+        molecules=[a, b, e], reactions=[([a, e], [b]), ([b, e], [a])]
+    )
+    proteome = [
+        [
+            CatalyticDomainFact(reaction=([a, e], [b]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=a, is_transmembrane=False, is_inhibiting=True,
+                km=1.0, hill=1,
+            ),
+        ],
+        [
+            CatalyticDomainFact(reaction=([b, e], [a]), km=1.0, vmax=1.0),
+            RegulatoryDomainFact(
+                effector=b, is_transmembrane=False, is_inhibiting=True,
+                km=1.0, hill=1,
+            ),
+        ],
+    ]
+    world = ms.World(chemistry=chem, map_size=4, seed=17)
+    idxs = _spawn_designed(world, proteome, n=4)
+    ia, ib, ie = (chem.mol_2_idx[m] for m in (a, b, e))
+    # nudge ONE cell towards the A state; the rest start balanced
+    cm = world.cell_molecules.copy()
+    cm[idxs, ia] = 2.0
+    cm[idxs, ib] = 2.0
+    cm[idxs[0], ia] = 2.4
+    world.cell_molecules = cm
+    traj = {i: ([], []) for i in idxs}
+    for _ in range(200):
+        cm = world.cell_molecules.copy()
+        cm[idxs, ie] = 10.0
+        world.cell_molecules = cm
+        world.enzymatic_activity()
+        world.diffuse_molecules()  # permeation + map diffusion
+        cm = world.cell_molecules.copy()
+        for i in idxs:
+            traj[i][0].append(cm[i, ia])
+            traj[i][1].append(cm[i, ib])
+    for n, i in enumerate(idxs):
+        ax.plot(traj[i][0], c=f"C{n}", label=f"cell {n} A")
+        ax.plot(traj[i][1], c=f"C{n}", ls=":", label=f"cell {n} B")
+    ax.set_title("bistable cascade (perm. A/B, 4 cells)")
+    ax.set_xlabel("step")
+    ax.set_ylabel("mM (intracellular)")
+    ax.legend(fontsize=6, ncol=2)
+
+
+def cyclic_pathway(ax) -> None:
+    """A->B->C->D->A driven by E: concentrations cycle through the four
+    intermediates from an all-A start."""
+    mols = [ms.Molecule(f"pat{x}4", 10e3) for x in "ABCD"]
+    e = ms.Molecule("patE4", 100e3)
+    a, b, c, d = mols
+    chem = ms.Chemistry(
+        molecules=[*mols, e],
+        reactions=[([a, e], [b]), ([b, e], [c]), ([c, e], [d]), ([d, e], [a])],
+    )
+    world = ms.World(chemistry=chem, map_size=8, seed=19)
+    proteome = [
+        [CatalyticDomainFact(reaction=([s, e], [p]), km=1.0, vmax=1.0)]
+        for s, p in ((a, b), (b, c), (c, d), (d, a))
+    ]
+    (ci,) = _spawn_designed(world, proteome)
+    ie = chem.mol_2_idx[e]
+    cm = world.cell_molecules.copy()
+    cm[ci, :] = 0.0
+    cm[ci, chem.mol_2_idx[a]] = 4.0
+    world.cell_molecules = cm
+    traj = {m: [] for m in mols}
+    for _ in range(200):
+        cm = world.cell_molecules.copy()
+        cm[ci, ie] = 10.0
+        world.cell_molecules = cm
+        world.enzymatic_activity()
+        cm = world.cell_molecules.copy()
+        for m in mols:
+            traj[m].append(cm[ci, chem.mol_2_idx[m]])
+    for m in mols:
+        ax.plot(traj[m], label=m.name[-2])
+    ax.set_title("cyclic pathway A->B->C->D->A")
+    ax.set_xlabel("step")
+    ax.set_ylabel("mM (intracellular)")
+    ax.legend()
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig, axs = plt.subplots(2, 3, figsize=(15, 8))
+    switch_relay(axs[0, 0])
+    bistable_switch(axs[0, 1], axs[0, 2])
+    switch_cascade(axs[1, 0])
+    cyclic_pathway(axs[1, 1])
+    axs[1, 2].axis("off")
+    fig.tight_layout()
+    fig.savefig(OUT / "patterns.png", dpi=120)
+    print(f"wrote {OUT / 'patterns.png'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""
+Transcriptome layout figures (the reference's figure family 2,
+`docs/plots/transcriptomes.py` / `docs/figures.md` §2): for random
+genomes of length 1000, every CDS drawn against the genome — forward
+transcripts above, reverse-complement transcripts below, with colored
+domain spans.  A quick visual check that CDS coordinates, strands and
+domain positions stay mutually consistent.
+
+    python docs/plots/plot_transcriptomes.py  # writes docs/img/transcriptomes.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import matplotlib.pyplot as plt
+from matplotlib.patches import Patch
+
+from magicsoup_tpu.genetics import Genetics
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+SIZE = 1000
+DOM_COLORS = {1: "tab:orange", 2: "tab:blue", 3: "tab:green"}
+DOM_NAMES = {1: "catalytic", 2: "transporter", 3: "regulatory"}
+
+
+def _draw(ax, gen: Genetics, genome: str, title: str) -> None:
+    (proteome,) = gen.translate_genomes([genome])
+    n = len(genome)
+    ax.barh(0, n, left=0, height=0.5, color="0.25")  # the genome, 5'-3'
+
+    fwd_lane = 1
+    rev_lane = -1
+    for doms, cds_start, cds_end, is_fwd in proteome:
+        if is_fwd:
+            lo, hi = cds_start, cds_end
+            lane = fwd_lane
+            fwd_lane += 1
+        else:
+            # parse coords are on the reverse-complement; map to 5'-3'
+            lo, hi = n - cds_end, n - cds_start
+            lane = rev_lane
+            rev_lane -= 1
+        ax.barh(lane, hi - lo, left=lo, height=0.5, color="0.8")
+        for (dom_type, *_), d_start, d_end in doms:
+            if is_fwd:
+                d_lo, d_hi = cds_start + d_start, cds_start + d_end
+            else:
+                d_lo, d_hi = n - (cds_start + d_end), n - (cds_start + d_start)
+            ax.barh(
+                lane, d_hi - d_lo, left=d_lo, height=0.5,
+                color=DOM_COLORS.get(dom_type, "tab:red"),
+            )
+    ax.set_ylim(rev_lane - 0.5, fwd_lane + 0.5)
+    ax.set_yticks([])
+    ax.set_xlabel("genome position (5'-3')")
+    ax.set_title(title, fontsize=9)
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    rng = random.Random(3)
+    gen = Genetics(seed=0)
+    fig, axs = plt.subplots(3, 1, figsize=(10, 8))
+    for i, ax in enumerate(axs):
+        genome = random_genome(s=SIZE, rng=rng)
+        _draw(ax, gen, genome, f"random genome {i} (length {SIZE})")
+    fig.legend(
+        handles=[
+            Patch(color="0.25", label="genome"),
+            Patch(color="0.8", label="transcript"),
+            *(
+                Patch(color=c, label=DOM_NAMES[t])
+                for t, c in DOM_COLORS.items()
+            ),
+        ],
+        loc="lower center", ncol=5, fontsize=8,
+    )
+    fig.tight_layout(rect=(0, 0.05, 1, 1))
+    fig.savefig(OUT / "transcriptomes.png", dpi=120)
+    print(f"wrote {OUT / 'transcriptomes.png'}")
+
+
+if __name__ == "__main__":
+    main()

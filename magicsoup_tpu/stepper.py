@@ -462,6 +462,7 @@ class _Fetcher:
         import threading
 
         self._q: Any = queue.SimpleQueue()
+        self._closed = False
         self._t = threading.Thread(
             target=self._run, daemon=True, name="ms-stepper-fetch"
         )
@@ -484,10 +485,20 @@ class _Fetcher:
         from concurrent.futures import Future
 
         fut: Future = Future()
+        if self._closed or not self._t.is_alive():
+            # a submit after close() (or with a dead worker) would queue
+            # behind the shutdown sentinel and hang its consumer forever;
+            # resolve inline instead — slower, never silent
+            try:
+                fut.set_result(np.asarray(arr))
+            except BaseException as exc:  # noqa: BLE001
+                fut.set_exception(exc)
+            return fut
         self._q.put((arr, fut))
         return fut
 
     def close(self) -> None:
+        self._closed = True
         self._q.put(None)
 
     def exit_join(self, timeout: float | None = None) -> None:
@@ -635,6 +646,12 @@ class PipelinedStepper:
             "spawned": 0,
             "spawn_drops": 0,
             "pushes": 0,
+            # whole-run aggregates mirroring the (bounded) trace ring, so
+            # totals stay exact for windows longer than the ring
+            "cold_dispatches": 0,
+            "fetch_ms": 0,
+            "dispatch_ms": 0,
+            "step_ms": 0,
         }
 
         # constant device scalars, built once — jnp.asarray per dispatch
@@ -909,6 +926,12 @@ class PipelinedStepper:
         # hardware window self-diagnosing (bench.py summarises to stderr);
         # bounded so an unbounded simulation loop cannot leak host memory
         t_end = _time.perf_counter()
+        self.stats["cold_dispatches"] += cold
+        # float ms accumulators (bench.py int-casts on report): per-step
+        # int truncation would zero out sub-ms fetches
+        self.stats["fetch_ms"] += (self._fetch_acc - fetch0) * 1e3
+        self.stats["dispatch_ms"] += (t_dispatched - t_dispatch0) * 1e3
+        self.stats["step_ms"] += (t_end - t_start) * 1e3
         if len(self.trace) >= 4096:
             del self.trace[:2048]
         self.trace.append(
@@ -992,8 +1015,10 @@ class PipelinedStepper:
         import time as _time
 
         t0 = _time.perf_counter()
-        # the ONE fetch — usually already pulled by the background worker
-        out = self._unpack_outputs(pend.out.result())
+        # the ONE fetch — usually already pulled by the background worker;
+        # the (generous) timeout makes a dead worker or wedged tunnel
+        # surface as an exception here instead of a silent hang
+        out = self._unpack_outputs(pend.out.result(timeout=300.0))
         self._fetch_acc += _time.perf_counter() - t0
         kill = out.kill
         parents = out.parents

@@ -183,7 +183,15 @@ def async_workers_enabled(platform: str | None = None) -> bool:
 
     env = os.environ.get("MAGICSOUP_TPU_ASYNC")
     if env is not None:
-        return env == "1"
+        low = env.strip().lower()
+        if low in ("1", "true", "yes", "on"):
+            return True
+        if low in ("0", "false", "no", "off", ""):
+            return False
+        raise ValueError(
+            f"MAGICSOUP_TPU_ASYNC={env!r} not understood; use 1/0, "
+            "true/false, yes/no or on/off"
+        )
     if platform is not None:
         return platform != "cpu"
     import jax

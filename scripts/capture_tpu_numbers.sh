@@ -55,14 +55,18 @@ run() {
     fi
 }
 
+# VERDICT r03 priority order: headline (pipelined vs classic), integrator
+# latency, bit-repro re-pin at HEAD (cheap, must share the bench's
+# window+commit), then the 40k/det/diffusion preset validations, then the
+# Mosaic ladder and wider sweeps.
 run bench           1800 python bench.py
 run integrator       600 python performance/integrator_bench.py
-run pallas_bisect   1500 python performance/pallas_bisect.py
+run bitrepro         900 python scripts/bitrepro.py
 run bench_40k       1800 python bench.py --config 40k --warmup 4 --steps 8
+run bench_det       1800 python bench.py --det --warmup 4 --steps 8
+run pallas_bisect   1500 python performance/pallas_bisect.py
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8
-run bench_det       1800 python bench.py --det --warmup 4 --steps 8
-run bitrepro         900 python scripts/bitrepro.py
 run check           1200 python performance/check.py
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"
