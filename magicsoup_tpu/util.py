@@ -21,7 +21,10 @@ from magicsoup_tpu.constants import ALL_NTS, CODON_SIZE
 
 _DEFAULT_RNG = random.Random()
 
-_LABEL_CHARS = string.ascii_uppercase + string.ascii_lowercase + string.digits
+# 64 URL-safe chars: a power-of-two alphabet makes the byte-mask draw in
+# randstr unbiased (256 % 64 == 0), the same C-speed path random_genome uses
+_LABEL_CHARS = string.ascii_uppercase + string.ascii_lowercase + string.digits + "-_"
+_LABEL_TABLE = bytes(ord(_LABEL_CHARS[b & 63]) for b in range(256))
 
 # template wildcard -> allowed nucleotides; expansion order of each pool is
 # what fixes the (token-map-relevant) enumeration order of codons()
@@ -42,11 +45,20 @@ def randstr(n: int = 12, rng: random.Random | None = None) -> str:
     """
     Generate random string of length `n`.
 
-    With `n=12` and 62 different characters there is a 50% chance of one
-    collision after 5e10 draws (birthday paradox).
+    With `n=12` and 64 different characters there is a 50% chance of one
+    collision after ~8e10 draws (birthday paradox).
     """
     rng = rng or _DEFAULT_RNG
-    return "".join(rng.choice(_LABEL_CHARS) for _ in range(n))
+    return rng.randbytes(n).translate(_LABEL_TABLE).decode("ascii")
+
+
+# byte -> nucleotide translation table (b & 3 indexes ALL_NTS; 256 % 4 == 0
+# keeps the map unbiased): lets random_genome draw a whole sequence as one
+# C-speed randbytes + translate instead of a per-character Python loop —
+# the pipelined stepper generates spawn genomes on its replay path, where
+# ~0.5 ms per 500-nt genome of pure-Python drawing was a measured host
+# bottleneck at benchmark scale
+_NT_TABLE = bytes(ord(ALL_NTS[b & 3]) for b in range(256))
 
 
 def random_genome(
@@ -67,7 +79,7 @@ def random_genome(
     rng = rng or _DEFAULT_RNG
 
     def draw(k: int) -> str:
-        return "".join(rng.choices(ALL_NTS, k=k))
+        return rng.randbytes(k).translate(_NT_TABLE).decode("ascii")
 
     if not excl:
         return draw(s)

@@ -26,6 +26,9 @@ mkdir -p "$OUT"
 # retry — a budget below the attempt timeout can never retry at all.
 export MAGICSOUP_BENCH_RETRY_BUDGET="${MAGICSOUP_BENCH_RETRY_BUDGET:-900}"
 export MAGICSOUP_BENCH_ATTEMPT_TIMEOUT="${MAGICSOUP_BENCH_ATTEMPT_TIMEOUT:-600}"
+# line-buffered stdout: the per-harness logs are pipes/files, and a
+# timeout-kill must not erase numbers a harness already printed
+export PYTHONUNBUFFERED=1
 
 probe() {
     timeout 120 python -c "import jax; print(jax.devices())" \

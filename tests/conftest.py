@@ -26,7 +26,7 @@ jax.config.update("jax_enable_compilation_cache", True)
 # jax/jaxlib version internally, so upgrades invalidate cleanly.  Opt out
 # with MAGICSOUP_TEST_COMPILE_CACHE=off (or point it somewhere else).
 _cache_dir = os.environ.get("MAGICSOUP_TEST_COMPILE_CACHE", "")
-if _cache_dir.lower() not in ("off", "0", "no"):
+if _cache_dir.lower() not in ("off", "0", "no", "false", "disabled"):
     if not _cache_dir:
         _cache_dir = str(
             Path.home() / ".cache" / "magicsoup-tpu-tests-jax"
