@@ -1,0 +1,86 @@
+"""
+Cell-growth-pattern montage (the reference's cell_growth.gif, figure
+9.5, rendered as snapshot rows): the binary cell map over time under
+four kill/replication-rate regimes.  The spatial patterns — extinction,
+overgrowth, wavefronts, sustainable colonies — are the failure modes the
+rate-estimation tutorial teaches (docs/tutorials.md §Estimating useful
+rates); this figure is what they look like.
+
+    python docs/plots/plot_growth_patterns.py  # writes docs/img/growth_patterns.png
+"""
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.containers import Chemistry, Molecule
+from magicsoup_tpu.util import random_genome
+
+OUT = Path(__file__).resolve().parents[1] / "img"
+MAP = 64
+SNAPSHOTS = (30, 120, 300, 600)
+
+REGIMES = {
+    "high kill, low repl": (0.02, 0.01),
+    "low kill, high repl": (0.002, 0.05),
+    "high kill, high repl": (0.03, 0.06),
+    "moderate kill + repl": (0.008, 0.02),
+}
+
+
+def _run(p_kill: float, p_divide: float, seed: int) -> list[np.ndarray]:
+    mol = Molecule("figGP", 10e3)
+    chem = Chemistry(molecules=[mol], reactions=[])
+    world = ms.World(chemistry=chem, map_size=MAP, mol_map_init="zeros", seed=seed)
+    rng = random.Random(seed)
+    nprng = np.random.default_rng(seed)
+    world.spawn_cells([random_genome(s=50, rng=rng) for _ in range(40)])
+    frames = []
+    for step in range(1, max(SNAPSHOTS) + 1):
+        n = world.n_cells
+        if n:
+            kill = np.nonzero(nprng.random(n) < p_kill)[0].tolist()
+            world.kill_cells(cell_idxs=kill)
+        n = world.n_cells
+        if n:
+            div = np.nonzero(nprng.random(n) < p_divide)[0].tolist()
+            world.divide_cells(cell_idxs=div)
+        if world.n_cells == 0 and not frames:
+            pass  # keep snapshotting the empty map
+        if step in SNAPSHOTS:
+            frames.append(world.cell_map.copy())
+    return frames
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig, axs = plt.subplots(
+        len(REGIMES), len(SNAPSHOTS), figsize=(3 * len(SNAPSHOTS), 3 * len(REGIMES))
+    )
+    for r, (name, (pk, pd)) in enumerate(REGIMES.items()):
+        frames = _run(pk, pd, seed=40 + r)
+        for c, (step, frame) in enumerate(zip(SNAPSHOTS, frames)):
+            ax = axs[r, c]
+            ax.imshow(frame, cmap="gray", vmin=0, vmax=1)
+            ax.set_xticks([])
+            ax.set_yticks([])
+            if r == 0:
+                ax.set_title(f"step {step}", fontsize=10)
+            if c == 0:
+                ax.set_ylabel(f"{name}\n(k={pk}, r={pd})", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(OUT / "growth_patterns.png", dpi=110)
+    print(f"wrote {OUT / 'growth_patterns.png'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,9 +24,51 @@ from magicsoup_tpu.util import random_genome
 OUT = Path(__file__).resolve().parents[1] / "img"
 
 
+def gradients(axes) -> None:
+    """Sustained 1D and 2D gradients (reference figure 4.2,
+    `docs/plots/molecule_maps.py`): molecules added at source pixels and
+    removed at sinks every step reach a steady spatial profile under
+    diffusion + degradation."""
+    mol = Molecule("figG", 10e3, diffusivity=1.0, half_life=100)
+    chem = Chemistry(molecules=[mol], reactions=[])
+
+    # 1D: source column in the middle, sinks at the map's edge columns
+    world = ms.World(chemistry=chem, map_size=64, mol_map_init="zeros", seed=3)
+    for _ in range(400):
+        mm = np.asarray(world.molecule_map).copy()
+        mm[0, :, 31:33] += 2.0
+        mm[0, :, :2] = 0.0
+        mm[0, :, -2:] = 0.0
+        world.molecule_map = mm
+        world.diffuse_molecules()
+        world.degrade_molecules()
+    axes[0].imshow(np.asarray(world.molecule_map)[0])
+    axes[0].set_title("1D gradient (source center, sinks at edges)")
+
+    # 2D: a 4x4 grid of point sources, sinks on the grid between them
+    world = ms.World(chemistry=chem, map_size=64, mol_map_init="zeros", seed=4)
+    src = np.linspace(8, 56, 4, dtype=int)
+    sink = np.array([0, 16, 32, 48, 63])  # the grid BETWEEN the sources
+    for _ in range(400):
+        mm = np.asarray(world.molecule_map).copy()
+        for i in src:
+            mm[0, i, src] += 4.0
+        mm[0, sink, :] = 0.0
+        mm[0, :, sink] = 0.0
+        world.molecule_map = mm
+        world.diffuse_molecules()
+        world.degrade_molecules()
+    axes[1].imshow(np.asarray(world.molecule_map)[0])
+    axes[1].set_title("2D gradients (4x4 sources, grid sinks)")
+
+
 def main() -> None:
     OUT.mkdir(parents=True, exist_ok=True)
-    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+    fig = plt.figure(figsize=(14, 8))
+    top = [fig.add_subplot(2, 3, i) for i in (1, 2, 3)]
+    bottom = [fig.add_subplot(2, 3, i) for i in (4, 5)]
+    gradients(bottom)
+    axes = top
 
     # diffusion of a point source
     mol = Molecule("figD", 10e3, diffusivity=1.0, half_life=100)
