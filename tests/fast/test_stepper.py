@@ -130,6 +130,12 @@ def test_pipelined_mass_conservation():
     # with a 1:1 reaction the SUM over both species is conserved exactly
     assert after == pytest.approx(before, rel=2e-4)
     assert st.stats["steps"] == 15 and st.stats["replayed"] == 15
+    # whole-run aggregates (exact totals even past the bounded trace
+    # ring): every step contributes wall time and dispatch time
+    assert st.stats["step_ms"] > 0
+    assert st.stats["dispatch_ms"] > 0
+    assert st.stats["fetch_ms"] >= 0
+    assert st.stats["cold_dispatches"] >= 1  # at least the first program
 
 
 def test_pipelined_fixed_lag_is_seed_reproducible():
