@@ -1,0 +1,51 @@
+"""
+Unit tests for the benchmark harness's pure helpers: result-line
+detection (what the parent forwards to the driver) and the CUDA-baseline
+interpolation the `vs_baseline` field is computed from.
+"""
+import importlib.util
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", Path(__file__).resolve().parents[2] / "bench.py"
+)
+bench = importlib.util.module_from_spec(_spec)
+sys.modules["bench"] = bench
+_spec.loader.exec_module(bench)
+
+
+def test_result_line_detection():
+    ok = '{"metric": "x", "value": 1.5, "unit": "steps/s"}'
+    assert bench._is_result_line(ok)
+    assert bench._is_result_line("  " + ok + "\n")
+    # failure lines ARE result lines (value 0.0 + error still parses)
+    assert bench._is_result_line(
+        '{"metric": "x", "value": 0.0, "error": "boom"}'
+    )
+    assert not bench._is_result_line("")
+    assert not bench._is_result_line("plain log text")
+    assert not bench._is_result_line('{"value": 1.0}')  # no metric
+    assert not bench._is_result_line('{"metric": "x"}')  # no value
+    assert not bench._is_result_line('{"metric": broken json')
+    assert not bench._is_result_line('[1, 2, 3]')
+
+
+def test_baseline_interpolation_matches_reference_measurements():
+    # the reference's two direct measurements must be reproduced exactly
+    assert bench.baseline_s_per_step(1_000) == 0.03
+    assert abs(bench.baseline_s_per_step(40_000) - 0.30) < 1e-12
+    # the headline 10k point sits on the line between them
+    mid = bench.baseline_s_per_step(10_000)
+    assert 0.092 < mid < 0.093
+    assert bench.BASELINE_S_PER_STEP == mid
+
+
+def test_transient_markers_cover_tunnel_failure_modes():
+    for msg in (
+        "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE",
+        "DEADLINE_EXCEEDED: deadline exceeded",
+        "Connection reset by peer",
+    ):
+        assert bench._looks_transient(msg)
+    assert not bench._looks_transient("TypeError: bad argument")
