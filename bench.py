@@ -324,10 +324,12 @@ def _looks_transient(stderr: str) -> bool:
     return any(m in stderr for m in _TRANSIENT_MARKERS)
 
 
-def _probe_backend(timeout_s: float) -> tuple[bool, str]:
+def _probe_backend(timeout_s: float, state: dict | None = None) -> tuple[bool, str]:
     """Cheaply check the accelerator responds before paying for a full
     bench attempt.  A half-down tunnel hangs forever on first jax use, so
-    the probe gets its own (short) timeout."""
+    the probe gets its own (short) timeout.  The probe process is tracked
+    in ``state['proc']`` so the SIGTERM handler can kill it too — an
+    orphaned probe hung on a dead tunnel would linger forever."""
     code = "import jax; jax.devices()"
     if _PLATFORM:
         code = (
@@ -335,17 +337,25 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
             f"jax.config.update('jax_platforms', {_PLATFORM!r}); "
             "jax.devices()"
         )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    if state is not None:
+        state["proc"] = proc
     try:
-        res = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
+        _, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
         return False, f"backend probe hung (> {timeout_s:.0f}s)"
-    if res.returncode != 0:
-        return False, res.stderr[-2000:]
+    finally:
+        if state is not None:
+            state["proc"] = None
+    if proc.returncode != 0:
+        return False, (stderr or "")[-2000:]
     return True, ""
 
 
@@ -484,7 +494,7 @@ def main() -> None:
         remaining = deadline - time.monotonic()
         if remaining < 10:
             break
-        ok, probe_err = _probe_backend(timeout_s=min(60.0, remaining))
+        ok, probe_err = _probe_backend(min(60.0, remaining), state)
         if ok:
             remaining = deadline - time.monotonic()
             if remaining < 30:
