@@ -447,25 +447,23 @@ def _compact_program(
     )
 
 
-class _Fetcher:
-    """One DAEMON thread pulling packed step outputs to host in dispatch
-    order.  Daemon on purpose: a fetch hung on a dead tunnel must never
-    block interpreter exit (a ThreadPoolExecutor's workers are joined at
-    exit and would).  :meth:`close` (hooked to the stepper via
-    ``weakref.finalize``) ends the thread when the stepper is collected,
-    and the :func:`magicsoup_tpu.util.register_exit_join` atexit hook
-    stops + joins it (bounded) before runtime teardown — a daemon thread
-    still inside a device fetch during teardown corrupts the heap."""
+class _Worker:
+    """One DAEMON thread running queued callables in FIFO order.  Daemon
+    on purpose: work hung on a dead tunnel must never block interpreter
+    exit (a ThreadPoolExecutor's workers are joined at exit and would).
+    :meth:`close` (hooked to the owner via ``weakref.finalize``) ends the
+    thread when the owner is collected, and the
+    :func:`magicsoup_tpu.util.register_exit_join` atexit hook stops +
+    joins it (bounded) before runtime teardown — a daemon thread still
+    inside a device fetch during teardown corrupts the heap."""
 
-    def __init__(self):
+    def __init__(self, name: str):
         import queue
         import threading
 
         self._q: Any = queue.SimpleQueue()
         self._closed = False
-        self._t = threading.Thread(
-            target=self._run, daemon=True, name="ms-stepper-fetch"
-        )
+        self._t = threading.Thread(target=self._run, daemon=True, name=name)
         self._t.start()
         _register_exit_join(self)
 
@@ -474,13 +472,13 @@ class _Fetcher:
             item = self._q.get()
             if item is None:
                 return
-            arr, fut = item
+            fn, fut = item
             try:
-                fut.set_result(np.asarray(arr))
+                fut.set_result(fn())
             except BaseException as exc:  # noqa: BLE001 - delivered to result()
                 fut.set_exception(exc)
 
-    def submit(self, arr):
+    def submit(self, fn):
         # a bare stdlib Future (no executor, so nothing joins it at exit)
         from concurrent.futures import Future
 
@@ -490,11 +488,11 @@ class _Fetcher:
             # behind the shutdown sentinel and hang its consumer forever;
             # resolve inline instead — slower, never silent
             try:
-                fut.set_result(np.asarray(arr))
+                fut.set_result(fn())
             except BaseException as exc:  # noqa: BLE001
                 fut.set_exception(exc)
             return fut
-        self._q.put((arr, fut))
+        self._q.put((fn, fut))
         return fut
 
     def close(self) -> None:
@@ -505,6 +503,19 @@ class _Fetcher:
         self.close()
         if self._t.is_alive():
             self._t.join(timeout)
+
+
+class _Fetcher(_Worker):
+    """:class:`_Worker` pulling packed step outputs to host in dispatch
+    order (one fetch per replayed step)."""
+
+    def __init__(self):
+        super().__init__(name="ms-stepper-fetch")
+
+    def submit(self, arr):
+        from functools import partial
+
+        return super().submit(partial(np.asarray, arr))
 
 
 class _LazyFetch:
@@ -578,6 +589,22 @@ class PipelinedStepper:
             pipeline drain) when the live population crowds it; with
             ``False`` the allocation clamps instead and drops are
             counted in :attr:`stats`.
+        overlap_evolution: Run the evolution phase (recombination +
+            point mutations, the largest host-replay item — the C++
+            engine releases the GIL) on a worker thread, overlapping the
+            next step's dispatch and fetch wait.  Deterministic by
+            construction: the worker only COMPUTES the changed-genome
+            set (drawing from the stepper's own rng, which nothing else
+            uses); every replay starts by joining the previous
+            evolution and applying it on the main thread, so at fixed
+            lag the resulting phenotype pushes always ride the
+            second-next dispatch — a transfer-speed-independent
+            schedule, like the rest of the fixed-lag contract.  Note
+            the two modes are each seed-reproducible but differ from
+            EACH OTHER (pushes ride the second-next vs the next
+            dispatch), so toggling this flag — like upgrading past the
+            release that introduced it — changes the trajectory a given
+            seed produces.
     """
 
     def __init__(
@@ -603,6 +630,7 @@ class PipelinedStepper:
         compact_headroom: int | None = None,
         compact_dead_slack: int = 768,
         auto_grow: bool = True,
+        overlap_evolution: bool = True,
     ):
         if world._mesh is not None:
             raise ValueError(
@@ -687,6 +715,18 @@ class PipelinedStepper:
             weakref.finalize(self, self._fetcher.close)
         else:
             self._fetcher = None
+        # evolution overlap runs on ALL backends (it calls only the C++
+        # engine + numpy — none of the jax-client hazards that gate the
+        # fetcher off CPU apply), so the CPU test tier exercises the
+        # exact threading the TPU path uses
+        if overlap_evolution:
+            import weakref
+
+            self._evo_worker = _Worker("ms-stepper-evo")
+            weakref.finalize(self, self._evo_worker.close)
+        else:
+            self._evo_worker = None
+        self._evo_future = None
         self._pending: list[_Pending] = []
         self._spawn_queue: list[tuple[str, str]] = []  # (genome, label)
         # deferred pushes: (genomes, rows, change seq) held while a
@@ -1010,6 +1050,10 @@ class PipelinedStepper:
                 # requires a transfer-speed-independent schedule
                 break
             self._replay(self._pending.pop(0))
+        if block:
+            # "host state is caught up" includes the final replay's
+            # evolution phase
+            self._join_evolution()
 
     def _replay(self, pend: _Pending) -> None:
         import time as _time
@@ -1020,6 +1064,9 @@ class PipelinedStepper:
         # surface as an exception here instead of a silent hang
         out = self._unpack_outputs(pend.out.result(timeout=300.0))
         self._fetch_acc += _time.perf_counter() - t0
+        # the previous replay's evolution must land before anything here
+        # touches genomes, positions or the push queues
+        self._join_evolution()
         kill = out.kill
         parents = out.parents
         n_placed = out.n_placed
@@ -1108,8 +1155,9 @@ class PipelinedStepper:
         if len(self._growth_hist) > 64:
             del self._growth_hist[:32]
 
-        # 5. evolution on the replayed state (+ stale-child refreshes)
-        self._recombinate_and_mutate(repush)
+        # 5. evolution on the replayed state (+ stale-child refreshes) —
+        # computes on the worker, applied at the next replay's join
+        self._submit_evolution(repush)
 
         # 6. population top-up (reacts with pipeline lag, documented)
         if self.target_cells is not None:
@@ -1148,15 +1196,23 @@ class PipelinedStepper:
         self._last_change[n_keep:] = -1
         self._n_rows = n_keep
 
-    def _recombinate_and_mutate(self, repush: dict[int, str] | None = None) -> None:
-        rows = np.nonzero(self._alive)[0]
-        changed: dict[int, str] = dict(repush or {})
+    def _evolution_compute(
+        self, rows: np.ndarray, pos_rows: np.ndarray, repush: dict[int, str]
+    ) -> dict[int, str]:
+        """The evolution phase's COMPUTE half: recombination + point
+        mutations over the live rows, returning the changed-genome dict.
+        Reads shared state but never writes it, so it can run on the
+        evolution worker while the main thread dispatches the next step
+        (the join discipline in :meth:`_replay` guarantees nothing
+        mutates genomes/positions while it runs); ``rows``/``pos_rows``
+        are main-thread snapshots.  All rng draws come from
+        ``self._rng``, which only this phase uses — a single FIFO worker
+        keeps their order deterministic."""
+        changed: dict[int, str] = dict(repush)
 
         # recombination among Moore neighbors (workload order: first)
         if len(rows) > 1 and self.p_recombination > 0:
-            pairs_k = moore_pairs(
-                self._positions[rows], self.world.map_size
-            )
+            pairs_k = moore_pairs(pos_rows, self.world.map_size)
             if len(pairs_k):
                 pair_rows = rows[pairs_k]
                 seed = int(self._rng.integers(2**63))
@@ -1167,21 +1223,55 @@ class PipelinedStepper:
                     r0, r1 = pair_rows[k]
                     changed[int(r0)] = g0
                     changed[int(r1)] = g1
-                for r, g in changed.items():
-                    self._genomes[r] = g
 
-        # point mutations (on the post-recombination genomes)
+        # point mutations (on the post-recombination genomes: overlay
+        # this round's recombinants without touching the shared list)
         if len(rows) and self.p_mutation > 0:
-            seqs = [self._genomes[int(r)] for r in rows]
+            seqs = [
+                changed.get(int(r), self._genomes[int(r)]) for r in rows
+            ]
             seed = int(self._rng.integers(2**63))
             for g, i in _engine.point_mutations(
                 seqs, p=self.p_mutation, p_indel=self.p_indel,
                 p_del=self.p_del, seed=seed,
             ):
-                r = int(rows[i])
-                self._genomes[r] = g
-                changed[r] = g
+                changed[int(rows[i])] = g
+        return changed
 
+    def _submit_evolution(self, repush: dict[int, str]) -> None:
+        """Kick off the evolution phase for the just-replayed state —
+        on the worker when overlap is on, inline otherwise."""
+        from functools import partial
+
+        rows = np.nonzero(self._alive)[0]
+        pos_rows = self._positions[rows]  # fancy indexing: already a copy
+        if self._evo_worker is not None:
+            self._evo_future = self._evo_worker.submit(
+                partial(self._evolution_compute, rows, pos_rows, repush)
+            )
+        else:
+            self._apply_evolution(
+                self._evolution_compute(rows, pos_rows, repush)
+            )
+
+    def _join_evolution(self) -> None:
+        """Wait for (and apply) the in-flight evolution phase, if any.
+        Called at the start of every replay — before anything touches
+        genomes or positions — and at drain(block=True)."""
+        fut = self._evo_future
+        if fut is None:
+            return
+        self._evo_future = None
+        self._apply_evolution(fut.result(timeout=300.0))
+
+    def _apply_evolution(self, changed: dict[int, str]) -> None:
+        """The evolution phase's APPLY half (main thread only): write the
+        changed genomes and queue their phenotype refresh.  Runs under
+        the same compaction routing as any other push — if a compaction
+        is in flight, the batch waits in the push buffer for its row
+        permutation."""
+        for r, g in changed.items():
+            self._genomes[r] = g
         if changed:
             rows_c = sorted(changed)
             genomes_c = [changed[r] for r in rows_c]

@@ -25,6 +25,13 @@ jax.config.update("jax_enable_compilation_cache", True)
 # on a warm cache), and the cache works on the CPU backend.  Keyed by
 # jax/jaxlib version internally, so upgrades invalidate cleanly.  Opt out
 # with MAGICSOUP_TEST_COMPILE_CACHE=off (or point it somewhere else).
+#
+# Gotcha (observed): a cache-LOADED XLA:CPU AOT executable can differ
+# numerically from a freshly-compiled one (machine-feature preferences
+# like prefer-no-scatter change codegen), so fast-mode trajectories are
+# only reproducible across processes once the cache is warm.  Tests that
+# compare trajectories therefore run both sides within one process (same
+# executables) — keep it that way.
 _cache_dir = os.environ.get("MAGICSOUP_TEST_COMPILE_CACHE", "")
 if _cache_dir.lower() not in ("off", "0", "no", "false", "disabled"):
     if not _cache_dir:

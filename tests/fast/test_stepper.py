@@ -138,7 +138,8 @@ def test_pipelined_mass_conservation():
     assert st.stats["cold_dispatches"] >= 1  # at least the first program
 
 
-def test_pipelined_fixed_lag_is_seed_reproducible():
+@pytest.mark.parametrize("overlap", [True, False])
+def test_pipelined_fixed_lag_is_seed_reproducible(overlap):
     def run():
         world = _world(seed=11)
         st = PipelinedStepper(
@@ -152,6 +153,7 @@ def test_pipelined_fixed_lag_is_seed_reproducible():
             lag=3,
             p_mutation=5e-4,
             p_recombination=1e-5,
+            overlap_evolution=overlap,
         )
         _run(st, 20)
         return (
