@@ -64,7 +64,11 @@ run() {
 # Mosaic ladder and wider sweeps.
 run bench           1800 python bench.py
 run integrator       600 python performance/integrator_bench.py
-run bitrepro         900 python scripts/bitrepro.py
+# 1800 s: a DIVERGING bitrepro re-runs both children to quantify ULP
+# magnitudes (scripts/bitrepro.py _divergence_magnitudes), roughly
+# doubling its runtime — and a conclusive divergence verdict is worth
+# more than the harnesses behind it in the queue
+run bitrepro        1800 python scripts/bitrepro.py
 run bench_40k       1800 python bench.py --config 40k --warmup 4 --steps 8
 run bench_det       1800 python bench.py --det --warmup 4 --steps 8
 run pallas_bisect   1500 python performance/pallas_bisect.py
