@@ -17,15 +17,25 @@ Run on whatever accelerator JAX finds (the driver provides a TPU chip); do
 not pin a platform here.
 
 Robustness: the accelerator is reached over a tunnel that can drop.  The
-parent process never imports jax; it probes the backend and runs the real
-measurement in child processes with bounded retry/backoff
-(MAGICSOUP_BENCH_RETRY_BUDGET seconds total, default 1200 — deliberately
-well under the driver's ~30 min kill window).  Result lines are forwarded
-to stdout the moment the child prints them (the classic-loop number is
-printed before the pipelined bench starts), so a later hang or kill cannot
-erase an already-measured number.  If every attempt fails, it still prints
-one parseable JSON line with an "error" field instead of dying with a
-traceback — including when the driver SIGTERMs it.
+parent process never imports jax; it runs the real measurement in child
+processes with bounded retry/backoff (MAGICSOUP_BENCH_RETRY_BUDGET seconds
+total, default 1200 — deliberately well under the driver's ~30 min kill
+window).  There is NO separate backend probe on the critical path: attempt
+#1 IS the measurement, guarded by a backend-ready watchdog — the child
+prints a ready marker the moment `jax.devices()` answers, and a child that
+shows no sign of life within MAGICSOUP_BENCH_READY_TIMEOUT seconds
+(default 90; a half-dead tunnel hangs forever there) is killed and
+retried.  In a short tunnel window this makes the first number land
+within ~90 s of a healthy backend appearing instead of after a probe
+round-trip.  Result lines are forwarded to stdout the moment the child
+prints them (the classic-loop number — metric suffixed " [classic]" so it
+can never be mistaken for the headline — is printed before the pipelined
+bench starts), so a later hang or kill cannot erase an already-measured
+number.  If the child dies after the classic line but before the headline
+line, the parent retries once (compiles are cached, so the retry is
+cheap).  If every attempt fails, it still prints one parseable JSON line
+with an "error" field instead of dying with a traceback — including when
+the driver SIGTERMs it.
 """
 import argparse
 import json
@@ -145,6 +155,15 @@ def _child_main(args: argparse.Namespace) -> None:
         jax.config.update("jax_platforms", _PLATFORM)
     _setup_compile_cache(jax)
 
+    # ready marker: the parent's watchdog kills a child that never gets
+    # here (a half-dead tunnel hangs forever inside jax.devices()); once
+    # this line is out, only the full attempt timeout applies
+    devs = jax.devices()
+    sys.stderr.write(
+        f"[bench-child] backend ready: {len(devs)} {devs[0].platform} device(s)\n"
+    )
+    sys.stderr.flush()
+
     import magicsoup_tpu as ms
     from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
     from magicsoup_tpu.util import random_genome
@@ -215,11 +234,11 @@ def _child_main(args: argparse.Namespace) -> None:
         f"run_simulation workload){mode}"
     )
 
-    def emit(steps_per_s: float, **fields) -> None:
+    def emit(steps_per_s: float, metric: str = metric_name, **fields) -> None:
         print(
             json.dumps(
                 {
-                    "metric": metric_name,
+                    "metric": metric,
                     "value": round(steps_per_s, 4),
                     "unit": "steps/s",
                     "vs_baseline": round(
@@ -240,8 +259,15 @@ def _child_main(args: argparse.Namespace) -> None:
 
     # print the classic number the moment it exists: a hang or kill later
     # in the pipelined bench must not erase an already-measured result
-    # (the parent forwards this line to the driver immediately)
-    emit(1.0 / dt_classic, driver="classic")
+    # (the parent forwards this line to the driver immediately).  When the
+    # pipelined headline follows, this early line gets a " [classic]"
+    # metric suffix so a first-match parser can never record it AS the
+    # headline; under --classic it IS the headline and keeps the name.
+    emit(
+        1.0 / dt_classic,
+        metric=metric_name if args.classic else metric_name + " [classic]",
+        driver="classic",
+    )
 
     extra = {}
     if not args.classic:
@@ -324,41 +350,6 @@ def _looks_transient(stderr: str) -> bool:
     return any(m in stderr for m in _TRANSIENT_MARKERS)
 
 
-def _probe_backend(timeout_s: float, state: dict | None = None) -> tuple[bool, str]:
-    """Cheaply check the accelerator responds before paying for a full
-    bench attempt.  A half-down tunnel hangs forever on first jax use, so
-    the probe gets its own (short) timeout.  The probe process is tracked
-    in ``state['proc']`` so the SIGTERM handler can kill it too — an
-    orphaned probe hung on a dead tunnel would linger forever."""
-    code = "import jax; jax.devices()"
-    if _PLATFORM:
-        code = (
-            "import jax; "
-            f"jax.config.update('jax_platforms', {_PLATFORM!r}); "
-            "jax.devices()"
-        )
-    proc = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.PIPE,
-        text=True,
-    )
-    if state is not None:
-        state["proc"] = proc
-    try:
-        _, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait()
-        return False, f"backend probe hung (> {timeout_s:.0f}s)"
-    finally:
-        if state is not None:
-            state["proc"] = None
-    if proc.returncode != 0:
-        return False, (stderr or "")[-2000:]
-    return True, ""
-
-
 def _is_result_line(line: str) -> bool:
     line = line.strip()
     if not line.startswith("{"):
@@ -371,13 +362,23 @@ def _is_result_line(line: str) -> bool:
 
 
 def _run_attempt(
-    child_cmd: list[str], timeout_s: float, state: dict
+    child_cmd: list[str],
+    timeout_s: float,
+    state: dict,
+    ready_timeout_s: float = 90.0,
 ) -> tuple[int, str]:
     """Run one measurement child, forwarding every JSON result line to our
-    stdout THE MOMENT it appears (sets state['printed']) so a later hang,
-    crash or driver kill cannot erase an already-measured number.  Returns
-    (returncode, stderr_tail); returncode -1 means the attempt timed out.
-    """
+    stdout THE MOMENT it appears (sets state['printed'];
+    state['headline'] when the line carries the pipelined rate) so a later
+    hang, crash or driver kill cannot erase an already-measured number.
+
+    The attempt doubles as the backend probe: a half-dead tunnel hangs the
+    child inside its first jax call with zero output, so a child that has
+    neither printed the "[bench-child] backend ready" marker nor a result
+    line within ``ready_timeout_s`` is killed (returncode -2, retryable).
+    Once the marker appears only the full ``timeout_s`` applies — remote
+    compiles may legitimately take minutes.  Returncode -1 means the full
+    attempt timed out."""
     proc = subprocess.Popen(
         child_cmd,
         stdout=subprocess.PIPE,
@@ -388,30 +389,44 @@ def _run_attempt(
     # one-job-at-a-time accelerator busy after the parent dies
     state["proc"] = proc
     stderr_chunks: list[str] = []
+    ready = threading.Event()
 
     def _read_out() -> None:
         for line in proc.stdout:
             if _is_result_line(line):
                 print(line.rstrip("\n"), flush=True)
                 state["printed"] = True
+                ready.set()
+                if "pipelined_steps_per_s" in line:
+                    state["headline"] = True
 
     def _read_err() -> None:
         # drain continuously: a full stderr pipe would deadlock the child
         for line in proc.stderr:
+            if "[bench-child] backend ready" in line:
+                ready.set()
             stderr_chunks.append(line)
 
     t_out = threading.Thread(target=_read_out, daemon=True)
     t_err = threading.Thread(target=_read_err, daemon=True)
     t_out.start()
     t_err.start()
-    try:
-        rc = proc.wait(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait()
-        rc = -1
-    finally:
-        state["proc"] = None
+    t_start = time.monotonic()
+    rc: int | None = None
+    while rc is None:
+        try:
+            rc = proc.wait(timeout=1.0)
+        except subprocess.TimeoutExpired:
+            elapsed = time.monotonic() - t_start
+            if not ready.is_set() and elapsed > ready_timeout_s:
+                proc.kill()
+                proc.wait()
+                rc = -2
+            elif elapsed > timeout_s:
+                proc.kill()
+                proc.wait()
+                rc = -1
+    state["proc"] = None
     t_out.join(timeout=10)
     t_err.join(timeout=10)
     return rc, "".join(stderr_chunks)[-4000:]
@@ -447,8 +462,12 @@ def main() -> None:
         a for a in sys.argv[1:]
     ]
 
+    ready_timeout_s = float(
+        os.environ.get("MAGICSOUP_BENCH_READY_TIMEOUT", "90")
+    )
+
     deadline = time.monotonic() + budget_s
-    state = {"printed": False, "last_err": "", "proc": None}
+    state = {"printed": False, "headline": False, "last_err": "", "proc": None}
     mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
 
     def _fail_json() -> str:
@@ -486,28 +505,29 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, _on_term)
 
-    backoff_s = 20.0
+    backoff_s = 15.0
     attempt = 0
+    headline_retries_left = 1
     while True:
         attempt += 1
         state["attempt"] = attempt
         remaining = deadline - time.monotonic()
         if remaining < 10:
             break
-        ok, probe_err = _probe_backend(min(60.0, remaining), state)
-        if ok:
-            remaining = deadline - time.monotonic()
-            if remaining < 30:
-                state["last_err"] = "backend up but retry budget exhausted"
-                break
-            # an attempt may never outlive the overall budget: a hang is
-            # killed in time for the structured failure line to print
-            rc, err_tail = _run_attempt(
-                child_cmd, min(attempt_timeout_s, remaining), state
-            )
-            if state["printed"]:
-                # at least one measured number reached stdout — success,
-                # even if a later phase of the child crashed or hung
+        # attempt #1 IS the measurement (no separate probe): in a short
+        # tunnel window every second counts, and the ready watchdog inside
+        # _run_attempt fails a dead backend as fast as a probe would.  An
+        # attempt may never outlive the overall budget — a hang is killed
+        # in time for the structured failure line to print.
+        rc, err_tail = _run_attempt(
+            child_cmd,
+            min(attempt_timeout_s, remaining),
+            state,
+            ready_timeout_s=min(ready_timeout_s, remaining),
+        )
+        if state["printed"]:
+            # at least one measured number reached stdout
+            if state["headline"] or args.classic or rc == 0:
                 sys.stderr.write(err_tail)
                 if rc != 0:
                     sys.stderr.write(
@@ -515,23 +535,45 @@ def main() -> None:
                         " was already emitted\n"
                     )
                 return
-            state["last_err"] = (
-                f"bench attempt hung (> {min(attempt_timeout_s, remaining):.0f}s)"
-                if rc == -1
-                else err_tail or f"rc={rc}, no output"
-            )
-            if rc == 0:
-                # exited cleanly yet printed no result line: deterministic
-                # bug, retrying cannot help
-                state["last_err"] = (
-                    "child exited 0 without a result line; stderr: "
-                    + state["last_err"]
+            # the classic line went out but the pipelined phase died
+            # before the headline line: retry once — compiles are cached,
+            # so the rerun is cheap, and a classic-only record must not
+            # silently stand in for the headline (ADVICE r04)
+            if (
+                headline_retries_left > 0
+                and deadline - time.monotonic() > 60
+            ):
+                headline_retries_left -= 1
+                sys.stderr.write(
+                    err_tail
+                    + f"\n[bench] child rc={rc} after the classic line but"
+                    " before the headline (pipelined) line; retrying once\n"
                 )
-                break
-            if rc != -1 and not _looks_transient(state["last_err"]):
-                break  # a real bug; retrying won't help
-        else:
-            state["last_err"] = probe_err
+                continue
+            sys.stderr.write(
+                err_tail
+                + f"\n[bench] note: child rc={rc}; the ' [classic]' line is"
+                " the only measured result (headline retry exhausted)\n"
+            )
+            return
+        state["last_err"] = (
+            f"backend not ready (> {min(ready_timeout_s, remaining):.0f}s, "
+            "no jax.devices() answer)"
+            if rc == -2
+            else f"bench attempt hung (> {min(attempt_timeout_s, remaining):.0f}s)"
+            if rc == -1
+            else err_tail or f"rc={rc}, no output"
+        )
+        if rc == 0:
+            # exited cleanly yet printed no result line: deterministic
+            # bug, retrying cannot help
+            state["last_err"] = (
+                "child exited 0 without a result line; stderr: "
+                + state["last_err"]
+            )
+            break
+        if rc not in (-1, -2) and not _looks_transient(state["last_err"]):
+            break  # a real bug; retrying won't help
 
         if time.monotonic() + backoff_s > deadline:
             break

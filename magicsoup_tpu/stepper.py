@@ -463,6 +463,10 @@ class _Worker:
 
         self._q: Any = queue.SimpleQueue()
         self._closed = False
+        # serializes the closed-check-and-put in submit() against close()
+        # (which runs from weakref.finalize/atexit on OTHER threads): an
+        # item enqueued behind the shutdown sentinel would never resolve
+        self._lock = threading.Lock()
         self._t = threading.Thread(target=self._run, daemon=True, name=name)
         self._t.start()
         _register_exit_join(self)
@@ -483,21 +487,26 @@ class _Worker:
         from concurrent.futures import Future
 
         fut: Future = Future()
-        if self._closed or not self._t.is_alive():
+        with self._lock:
+            closed = self._closed or not self._t.is_alive()
+            if not closed:
+                self._q.put((fn, fut))
+        if closed:
             # a submit after close() (or with a dead worker) would queue
             # behind the shutdown sentinel and hang its consumer forever;
-            # resolve inline instead — slower, never silent
+            # resolve inline instead — slower, never silent.  fn runs
+            # OUTSIDE the lock: it may block on a device fetch and close()
+            # must never wait on that.
             try:
                 fut.set_result(fn())
             except BaseException as exc:  # noqa: BLE001
                 fut.set_exception(exc)
-            return fut
-        self._q.put((fn, fut))
         return fut
 
     def close(self) -> None:
-        self._closed = True
-        self._q.put(None)
+        with self._lock:
+            self._closed = True
+            self._q.put(None)
 
     def exit_join(self, timeout: float | None = None) -> None:
         self.close()

@@ -127,6 +127,13 @@ def _run_child(
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_allow_excess_precision=false"
     ).strip()
+    # BOTH children must compile fresh: cache-loaded XLA:CPU AOT
+    # executables can differ numerically from fresh compiles (observed on
+    # this box — the loader even warns when the cached machine features
+    # don't match the host), so a persistent compile cache leaking in via
+    # the environment would compare a stale binary against a fresh one
+    # and report a spurious divergence
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     if platform == "cpu":
         # strip any PJRT shim and pin the CPU backend
         env["PYTHONPATH"] = ""

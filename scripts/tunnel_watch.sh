@@ -10,11 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 PREFIX="${1:-logs/tpu-auto}"
-INTERVAL="${2:-180}"
+INTERVAL="${2:-45}"
 
+# 75 s probe timeout + the sleep bounds worst-case window detection at
+# ~2 min (a half-dead tunnel HANGS the probe; observed windows can be as
+# short as ~5 min, so a 120+120 cadence could eat half a window)
 n=0
 while true; do
-    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         n=$((n + 1))
         OUT="$PREFIX-$(date +%Y%m%d-%H%M%S)"
         echo "$(date -Is) tunnel up — capture #$n into $OUT"

@@ -41,6 +41,50 @@ def test_baseline_interpolation_matches_reference_measurements():
     assert bench.BASELINE_S_PER_STEP == mid
 
 
+def test_run_attempt_ready_watchdog_kills_silent_child():
+    # a half-dead tunnel hangs the child inside its first jax call with
+    # zero output; the watchdog must kill it at ready_timeout_s (-2),
+    # long before the full attempt timeout
+    import sys as _sys
+    import time
+
+    state = {"printed": False, "headline": False, "proc": None}
+    t0 = time.monotonic()
+    rc, _err = bench._run_attempt(
+        [_sys.executable, "-c", "import time; time.sleep(60)"],
+        timeout_s=50.0,
+        state=state,
+        ready_timeout_s=2.0,
+    )
+    assert rc == -2
+    assert time.monotonic() - t0 < 15
+    assert not state["printed"]
+
+
+def test_run_attempt_ready_marker_lifts_watchdog():
+    # once the ready marker is on stderr only the full timeout applies;
+    # this child would die at ready_timeout_s=1 without the marker
+    import sys as _sys
+
+    state = {"printed": False, "headline": False, "proc": None}
+    code = (
+        "import sys, time;"
+        "sys.stderr.write('[bench-child] backend ready: 1 cpu device(s)\\n');"
+        "sys.stderr.flush(); time.sleep(3);"
+        "print('{\"metric\": \"m\", \"value\": 1.0, "
+        "\"pipelined_steps_per_s\": 2.0}')"
+    )
+    rc, _err = bench._run_attempt(
+        [_sys.executable, "-c", code],
+        timeout_s=30.0,
+        state=state,
+        ready_timeout_s=1.0,
+    )
+    assert rc == 0
+    assert state["printed"]
+    assert state["headline"]
+
+
 def test_transient_markers_cover_tunnel_failure_modes():
     for msg in (
         "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE",

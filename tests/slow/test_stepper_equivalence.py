@@ -1,0 +1,192 @@
+"""
+Long-horizon distributional equivalence: the pipelined driver
+(:class:`magicsoup_tpu.stepper.PipelinedStepper`) vs the classic serial
+loop on the SAME canonical selection workload (reference
+`performance/run_simulation.py:61-100`).
+
+The stepper's documented semantic deltas — fixed phenotype lag,
+slot-vs-compacted indices, bounded per-dispatch division budgets
+(`stepper.py` module docstring) — are exercised elsewhere on short
+horizons; what no short test can show is that the lag does not BIAS
+evolution outcomes over a long run.  This exhibit runs both drivers for
+1000 steps from identically-seeded worlds in a steady-churn selection
+regime (population fluctuates well below map capacity, kills and
+divisions both active every few steps) and asserts the steady-state
+population, kill/division rates and total molecule mass agree within
+statistical bands.
+
+Trajectories are NOT step-for-step comparable (different RNG consumption
+order), so the comparison is distributional over the final third of the
+horizon.  Bands were set from CPU runs at 2x the observed driver-to-
+driver spread; a real lag-induced bias (e.g. systematically stale
+phenotypes dividing less) shows up far outside them.
+
+Runtime: ~2-4 min on a warm compile cache (CPU backend).
+`MAGICSOUP_EQ_STEPS` overrides the horizon for quick smoke runs.
+"""
+import os
+import random
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.stepper import PipelinedStepper
+
+N_STEPS = int(os.environ.get("MAGICSOUP_EQ_STEPS", "1000"))
+
+_MOLS = [
+    ms.Molecule("eqv-a", 10e3),
+    ms.Molecule("eqv-atp", 8e3),
+    ms.Molecule("eqv-c", 4e3, permeability=0.3),
+]
+_REACTIONS = [([_MOLS[0]], [_MOLS[1]]), ([_MOLS[1]], [_MOLS[2]])]
+
+# steady-churn selection regime (probed on CPU): population settles
+# ~750-850 on the 32x32 map (capacity 1024), with both kills and
+# divisions firing continuously — selection pressure without the
+# capacity pin that would mask rate differences
+SEED = 11
+MAP_SIZE = 32
+TARGET_CELLS = 150
+GENOME_SIZE = 300
+KILL_BELOW = 2.0
+DIVIDE_ABOVE = 6.0
+DIVIDE_COST = 5.5
+
+
+def _chem() -> ms.Chemistry:
+    return ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS)
+
+
+def _world(chem: ms.Chemistry) -> tuple[ms.World, random.Random]:
+    rng = random.Random(SEED)
+    world = ms.World(chemistry=chem, map_size=MAP_SIZE, seed=SEED)
+    world.spawn_cells(
+        [ms.random_genome(s=GENOME_SIZE, rng=rng) for _ in range(TARGET_CELLS)]
+    )
+    return world, rng
+
+
+def _total_mass(world: ms.World) -> float:
+    mm = np.asarray(world.molecule_map)
+    cm = world.cell_molecules
+    return float(mm.sum() + cm.sum())
+
+
+def _run_classic(n_steps: int) -> dict:
+    chem = _chem()
+    world, rng = _world(chem)
+    atp = chem.molname_2_idx["eqv-atp"]
+    pops, kills, divs = [], [], []
+    for _ in range(n_steps):
+        if world.n_cells < TARGET_CELLS:
+            world.spawn_cells(
+                [
+                    ms.random_genome(s=GENOME_SIZE, rng=rng)
+                    for _ in range(TARGET_CELLS - world.n_cells)
+                ]
+            )
+        world.enzymatic_activity(prefetch_column=atp)
+        col = world.cell_molecule_column(atp)
+        kill_mask = col < KILL_BELOW
+        world.kill_cells(cell_idxs=np.nonzero(kill_mask)[0].tolist())
+        after = col[~kill_mask]
+        repl = np.nonzero(after > DIVIDE_ABOVE)[0]
+        placed = 0
+        if len(repl):
+            world.add_cell_molecules(repl.tolist(), atp, -DIVIDE_COST)
+            before = world.n_cells
+            world.divide_cells(cell_idxs=repl.tolist())
+            # count PLACEMENTS (children actually added), matching the
+            # stepper's `divisions` counter — candidates whose Moore
+            # neighborhood is full pay the cost but add no cell in
+            # either driver
+            placed = world.n_cells - before
+        world.recombinate_cells()
+        world.mutate_cells()
+        world.degrade_and_diffuse_molecules()
+        world.increment_cell_lifetimes()
+        pops.append(world.n_cells)
+        kills.append(int(kill_mask.sum()))
+        divs.append(placed)
+    return {
+        "pop": np.asarray(pops),
+        "kills": np.asarray(kills),
+        "divs": np.asarray(divs),
+        "mass": _total_mass(world),
+    }
+
+
+def _run_piped(n_steps: int) -> dict:
+    world, _rng = _world(_chem())
+    st = PipelinedStepper(
+        world,
+        mol_name="eqv-atp",
+        kill_below=KILL_BELOW,
+        divide_above=DIVIDE_ABOVE,
+        divide_cost=DIVIDE_COST,
+        target_cells=TARGET_CELLS,
+        genome_size=GENOME_SIZE,
+    )
+    pops, kills, divs = [], [], []
+    k0 = d0 = 0
+    for _ in range(n_steps):
+        st.step()
+        # stats advance on replay (lag steps behind dispatch); per-step
+        # deltas over the whole run still integrate to the true rates.
+        # NB `world.n_cells` is stale while the stepper drives — the
+        # replayed live count is `st.population`
+        pops.append(st.population)
+        kills.append(st.stats["kills"] - k0)
+        divs.append(st.stats["divisions"] - d0)
+        k0, d0 = st.stats["kills"], st.stats["divisions"]
+    st.drain()
+    # fold the last in-flight steps' events (replayed by the drain)
+    # into the final entry so the series integrates to the true totals
+    kills[-1] += st.stats["kills"] - k0
+    divs[-1] += st.stats["divisions"] - d0
+    st.flush()
+    return {
+        "pop": np.asarray(pops),
+        "kills": np.asarray(kills),
+        "divs": np.asarray(divs),
+        "mass": _total_mass(world),
+        "stats": dict(st.stats),
+    }
+
+
+def test_long_horizon_stepper_matches_classic_distributions():
+    classic = _run_classic(N_STEPS)
+    piped = _run_piped(N_STEPS)
+
+    tail = slice(-max(N_STEPS // 3, 10), None)
+
+    # steady-state population: the core outcome selection acts on.
+    # Calibration (3 seeds, 1000 steps, CPU): piped/classic tail-pop
+    # ratios 0.90-0.97 — the residual gap traces to the documented
+    # bounded-placement delta (blocked divisions), not phenotype lag
+    # (toggling overlap_evolution/lag/max_divisions moved nothing)
+    pop_c = classic["pop"][tail].mean()
+    pop_p = piped["pop"][tail].mean()
+    assert pop_c > TARGET_CELLS, "regime check: population must grow"
+    assert abs(pop_p - pop_c) / pop_c < 0.20, (pop_c, pop_p)
+
+    # churn rates over the WHOLE run (the tail goes quiescent once the
+    # population equilibrates): a lag bias — stale phenotypes being
+    # selected — would shift kills or placements systematically
+    for key in ("kills", "divs"):
+        rate_c = classic[key].mean()
+        rate_p = piped[key].mean()
+        assert rate_c > 0.05, f"regime check: classic {key} inactive"
+        assert rate_p > 0.05, f"regime check: piped {key} inactive"
+        ratio = rate_p / rate_c
+        assert 0.6 < ratio < 1.65, (key, rate_c, rate_p)
+
+    # total molecule mass: both drivers conserve mass up to (identical)
+    # degradation; a replay/accounting leak would separate them
+    assert piped["mass"] == pytest.approx(classic["mass"], rel=0.10)
+
+    # the per-step deltas must integrate to the stepper's own counters
+    assert piped["kills"].sum() == piped["stats"]["kills"]
+    assert piped["divs"].sum() == piped["stats"]["divisions"]
