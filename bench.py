@@ -74,6 +74,43 @@ CONFIGS = {
 # bench runs on whatever the driver provides and leaves this unset)
 _PLATFORM = os.environ.get("MAGICSOUP_BENCH_PLATFORM", "")
 
+
+def apply_platform_pin(jax_module) -> None:
+    """Apply the MAGICSOUP_BENCH_PLATFORM pin (shared by every harness —
+    bench, profile_step, integrator_bench — so the env-var contract has
+    exactly one implementation).  The axon TPU plugin ignores
+    JAX_PLATFORMS, so a config-level pin is the only way to force CPU."""
+    if _PLATFORM:
+        jax_module.config.update("jax_platforms", _PLATFORM)
+
+
+def probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Subprocess probe with a hard timeout, honoring the platform pin —
+    for harnesses without their own retry/watchdog machinery (bench.py
+    itself does not probe: its measurement child doubles as one).  A
+    half-dead tunnel hangs in-process backend init forever, which is why
+    this must be a killable subprocess."""
+    code = "import jax; jax.devices()"
+    if _PLATFORM:
+        code = (
+            "import jax; "
+            f"jax.config.update('jax_platforms', {_PLATFORM!r}); "
+            "jax.devices()"
+        )
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung (> {timeout_s:.0f}s)"
+    if res.returncode != 0:
+        return False, (res.stderr or "")[-2000:]
+    return True, ""
+
 # stderr markers that indicate a transient backend/tunnel failure worth retrying
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE",
@@ -149,10 +186,7 @@ def _child_main(args: argparse.Namespace) -> None:
 
     import jax
 
-    if _PLATFORM:
-        # test/CI hook: the axon TPU plugin ignores JAX_PLATFORMS, so CPU
-        # smoke runs of this harness need the config-level pin
-        jax.config.update("jax_platforms", _PLATFORM)
+    apply_platform_pin(jax)
     _setup_compile_cache(jax)
 
     # ready marker: the parent's watchdog kills a child that never gets
@@ -536,11 +570,25 @@ def main() -> None:
                     )
                 return
             # the classic line went out but the pipelined phase died
-            # before the headline line: retry once — compiles are cached,
-            # so the rerun is cheap, and a classic-only record must not
-            # silently stand in for the headline (ADVICE r04)
+            # before the headline line: a classic-only record must not
+            # silently stand in for the headline (ADVICE r04).  A
+            # TRANSIENT failure (tunnel blip / hang) goes through the
+            # normal backoff loop without consuming the retry — the
+            # budget bounds it; only a deterministic crash consumes the
+            # single headline retry (compiles are cached, so it is cheap).
+            transient = rc in (-1, -2) or _looks_transient(err_tail)
+            if transient and deadline - time.monotonic() > backoff_s + 60:
+                sys.stderr.write(
+                    f"[bench] transient failure (rc={rc}) after the classic"
+                    f" line, before the headline; backing off {backoff_s:.0f}s"
+                    " and retrying for the headline\n"
+                )
+                time.sleep(backoff_s)
+                backoff_s = min(backoff_s * 2, 120.0)
+                continue
             if (
-                headline_retries_left > 0
+                not transient
+                and headline_retries_left > 0
                 and deadline - time.monotonic() > 60
             ):
                 headline_retries_left -= 1
@@ -553,7 +601,8 @@ def main() -> None:
             sys.stderr.write(
                 err_tail
                 + f"\n[bench] note: child rc={rc}; the ' [classic]' line is"
-                " the only measured result (headline retry exhausted)\n"
+                " the only measured result (headline retries/budget"
+                " exhausted)\n"
             )
             return
         state["last_err"] = (

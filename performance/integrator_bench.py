@@ -35,6 +35,11 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    from bench import apply_platform_pin
+
+    apply_platform_pin(jax)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -128,6 +133,24 @@ def main() -> None:
     print(f"XLA    effective HBM bw (if 1x): {min_bytes / t_xla / 1e9:.1f} GB/s")
     if t_pal:
         print(f"Pallas effective HBM bw (if 1x): {min_bytes / t_pal / 1e9:.1f} GB/s")
+
+    # one machine-readable line for scripts/summarize_capture.py
+    import json
+
+    print(
+        json.dumps(
+            {
+                "ms_per_step": round(t_xla * 1e3, 3),
+                "pallas_ms_per_step": (
+                    round(t_pal * 1e3, 3) if t_pal else None
+                ),
+                "shape": [c, p, s],
+                "rtt_ms": round(rtt * 1e3, 2),
+                "backend": jax.default_backend(),
+            }
+        ),
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

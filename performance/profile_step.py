@@ -38,30 +38,18 @@ def main() -> None:
     args = ap.parse_args()
 
     # fail fast when the (possibly tunneled) backend is unreachable (a
-    # half-down tunnel hangs the first jax use forever).  bench.py no
-    # longer carries a standalone probe (its measurement child doubles as
-    # one, guarded by a ready watchdog), so this harness owns its own:
-    # a subprocess with a hard timeout, since an in-process hung backend
-    # init cannot be cancelled
-    import subprocess
+    # half-down tunnel hangs the first jax use forever); probe, platform
+    # pin and compile-cache setup are shared with the other harnesses so
+    # the MAGICSOUP_BENCH_PLATFORM contract has one implementation
+    from bench import _setup_compile_cache, apply_platform_pin, probe_backend
 
-    from bench import _setup_compile_cache
-
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.PIPE,
-            text=True,
-            timeout=120.0,
-        )
-    except subprocess.TimeoutExpired:
-        sys.exit("backend probe hung (> 120s)")
-    if res.returncode != 0:
-        sys.exit(f"backend probe failed:\n{(res.stderr or '')[-2000:]}")
+    ok, probe_err = probe_backend(timeout_s=120.0)
+    if not ok:
+        sys.exit(f"backend probe failed:\n{probe_err}")
 
     import jax
 
+    apply_platform_pin(jax)
     _setup_compile_cache(jax)
 
     import numpy as np
