@@ -579,9 +579,10 @@ def main() -> None:
             transient = rc in (-1, -2) or _looks_transient(err_tail)
             if transient and deadline - time.monotonic() > backoff_s + 60:
                 sys.stderr.write(
-                    f"[bench] transient failure (rc={rc}) after the classic"
-                    f" line, before the headline; backing off {backoff_s:.0f}s"
-                    " and retrying for the headline\n"
+                    err_tail
+                    + f"\n[bench] transient failure (rc={rc}) after the"
+                    f" classic line, before the headline; backing off"
+                    f" {backoff_s:.0f}s and retrying for the headline\n"
                 )
                 time.sleep(backoff_s)
                 backoff_s = min(backoff_s * 2, 120.0)

@@ -17,6 +17,13 @@ cd "$(dirname "$0")/.."
 OUT="${1:-logs/tpu-$(date +%Y%m%d-%H%M%S)}"
 mkdir -p "$OUT"
 
+# publish whatever WAS measured even when a mid-capture tunnel drop
+# aborts the run partway (observed windows can be ~5 min): the trap
+# fires on every exit path; the summarizer itself refuses errored /
+# classic-only lines, so partial captures only contribute clean numbers
+trap 'python scripts/summarize_capture.py "$OUT" --publish \
+    > "$OUT/summary.json" 2>>"$OUT/capture.log" || true' EXIT
+
 # bounded retries AND a bounded single attempt: a mid-capture tunnel
 # drop (or a half-dead hang inside one bench child) should fail fast
 # here and hand control back to the watcher, not poll for 30 minutes
@@ -77,3 +84,4 @@ run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8
 run check           1200 python performance/check.py
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"
+# (summarize + publish runs in the EXIT trap above, on success AND abort)

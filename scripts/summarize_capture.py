@@ -113,9 +113,13 @@ def publish(summary: dict) -> None:
             published[key] = {**entry, "capture_dir": summary["capture_dir"]}
             merged = True
     for key in ("bitrepro", "integrator"):
-        if key in summary:
+        entry = summary.get(key)
+        # same cleanliness rule as the bench entries: an errored verdict
+        # (e.g. bitrepro's {"result": "error"} after a tunnel drop) must
+        # not clobber a previous window's conclusive record
+        if entry and "error" not in entry and entry.get("result") != "error":
             published[key] = {
-                **summary[key],
+                **entry,
                 "capture_dir": summary["capture_dir"],
             }
             merged = True
