@@ -37,6 +37,11 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    from bench import apply_platform_pin
+
+    apply_platform_pin(jax)
+
     import numpy as np
 
     import magicsoup_tpu as ms
