@@ -384,6 +384,45 @@ def _looks_transient(stderr: str) -> bool:
     return any(m in stderr for m in _TRANSIENT_MARKERS)
 
 
+# keep in sync with LOCK in scripts/capture_tpu_numbers.sh (the capture
+# script wraps its non-bench harnesses in the same flock)
+_ACCEL_LOCK_PATH = "/tmp/magicsoup_tpu_accel.lock"
+
+
+def _acquire_accel_lock(max_wait_s: float):
+    """One accelerator job at a time: concurrent benchmarks through the
+    shared chip+tunnel contaminate each other's timings (the round-3
+    windows showed a single fetch storm doubling another job's step
+    times).  Returns the held lock file (kept open for the process
+    lifetime — flock releases automatically when the process dies, so a
+    crashed holder can never wedge later runs) and raises TimeoutError
+    after ``max_wait_s`` of contention.  CPU-pinned smoke runs return
+    None without locking: they touch no shared accelerator and must be
+    parallelizable in CI; any other platform pin still names a shared
+    accelerator and locks like the unpinned path."""
+    if _PLATFORM == "cpu":
+        return None
+    import fcntl
+
+    f = open(_ACCEL_LOCK_PATH, "w")
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except BlockingIOError:
+            # EWOULDBLOCK = genuine contention; any other OSError (ENOLCK
+            # on odd mounts, bad fd) propagates as a real error instead
+            # of masquerading as "held by another process"
+            if time.monotonic() >= deadline:
+                f.close()
+                raise TimeoutError(
+                    f"accelerator lock {_ACCEL_LOCK_PATH} held by another"
+                    f" process for > {max_wait_s:.0f}s"
+                )
+            time.sleep(5.0)
+
+
 def _is_result_line(line: str) -> bool:
     line = line.strip()
     if not line.startswith("{"):
@@ -538,6 +577,20 @@ def main() -> None:
         os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
+
+    # serialize against any other real-accelerator benchmark (e.g. the
+    # automated capture script firing in the same tunnel window); wait at
+    # most half the budget so the structured failure line still prints
+    try:
+        accel_lock = _acquire_accel_lock(max_wait_s=min(600.0, budget_s / 2))
+    except (TimeoutError, OSError) as exc:
+        # a lock-file error (unwritable /tmp, foreign-owner file under a
+        # sticky bit, ENOLCK) must still yield the structured failure
+        # line, never a bare traceback
+        state["last_err"] = f"accelerator lock unavailable: {exc}"
+        print(_fail_json(), flush=True)
+        sys.exit(1)
+    _ = accel_lock  # held for process lifetime; flock releases on exit
 
     backoff_s = 15.0
     attempt = 0

@@ -50,11 +50,20 @@ fi
 
 # run <name> <timeout_s> <cmd...>: per-harness hard timeout (the bench.py
 # runs ALSO bound themselves via the env vars above; the other harnesses
-# have no internal retry loop, so this cap is their only fail-fast)
+# have no internal retry loop, so this cap is their only fail-fast).
+# Non-bench harnesses take the shared accelerator flock (bench.py locks
+# itself) so a driver-initiated benchmark in the same window serializes
+# instead of contending through the one chip+tunnel; -w 300 bounds the
+# wait so a long-held lock costs one harness slot, not the capture.
+# Keep in sync with _ACCEL_LOCK_PATH in bench.py.
+LOCK=/tmp/magicsoup_tpu_accel.lock
 run() {
     name="$1"; to="$2"; shift 2
     echo "== $name (<=${to}s): $*" | tee -a "$OUT/capture.log"
-    timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+    case "$*" in
+        *bench.py*) timeout "$to" "$@" >"$OUT/$name.log" 2>&1 ;;
+        *) timeout "$to" flock -w 300 "$LOCK" "$@" >"$OUT/$name.log" 2>&1 ;;
+    esac
     rc=$?
     echo "rc=$rc (tail)" | tee -a "$OUT/capture.log"
     tail -5 "$OUT/$name.log" | tee -a "$OUT/capture.log"
