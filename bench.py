@@ -385,8 +385,12 @@ def _looks_transient(stderr: str) -> bool:
 
 
 # keep in sync with LOCK in scripts/capture_tpu_numbers.sh (the capture
-# script wraps its non-bench harnesses in the same flock)
-_ACCEL_LOCK_PATH = "/tmp/magicsoup_tpu_accel.lock"
+# script wraps its non-bench harnesses in the same flock).  Tests point
+# MAGICSOUP_BENCH_LOCK_PATH at a private file so harness contract tests
+# can never contend with (or stall) a live capture on the global lock.
+_ACCEL_LOCK_PATH = os.environ.get(
+    "MAGICSOUP_BENCH_LOCK_PATH", "/tmp/magicsoup_tpu_accel.lock"
+)
 
 
 def _acquire_accel_lock(max_wait_s: float):

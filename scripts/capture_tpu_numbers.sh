@@ -61,7 +61,10 @@ run() {
     name="$1"; to="$2"; shift 2
     echo "== $name (<=${to}s): $*" | tee -a "$OUT/capture.log"
     case "$*" in
-        *bench.py*) timeout "$to" "$@" >"$OUT/$name.log" 2>&1 ;;
+        # match " bench.py" with the leading space: a bare *bench.py*
+        # would also catch performance/integrator_bench.py and leave it
+        # running unlocked
+        *" bench.py"*) timeout "$to" "$@" >"$OUT/$name.log" 2>&1 ;;
         *) timeout "$to" flock -w 300 "$LOCK" "$@" >"$OUT/$name.log" 2>&1 ;;
     esac
     rc=$?

@@ -2,9 +2,10 @@
 # Watch for the TPU tunnel to come back, then immediately run the full
 # measurement capture (scripts/capture_tpu_numbers.sh).  The tunnel has
 # been observed down for multi-hour stretches with up-windows as short
-# as minutes (see BENCH_NOTES.md), so this loops until ONE capture runs
-# to completion — a capture aborted by a mid-window drop re-arms the
-# watch with a fresh outdir instead of giving up.
+# as minutes (see BENCH_NOTES.md).  The watch loops FOREVER: an aborted
+# capture re-arms immediately with a fresh outdir, and a completed one
+# re-arms after a 15-min cooldown so a later window can re-confirm the
+# headline or fill configs the first window missed.  Stop it with kill.
 #
 #   bash scripts/tunnel_watch.sh [outdir_prefix] [probe_interval_s]
 set -u
@@ -22,10 +23,16 @@ while true; do
         OUT="$PREFIX-$(date +%Y%m%d-%H%M%S)"
         echo "$(date -Is) tunnel up — capture #$n into $OUT"
         if bash scripts/capture_tpu_numbers.sh "$OUT"; then
-            echo "$(date -Is) capture complete: $OUT"
-            exit 0
+            echo "$(date -Is) capture complete: $OUT — re-arming after cooldown"
+            # keep watching: a later window can re-confirm the headline
+            # or fill configs this window missed (the summarizer merges
+            # per-entry, so a partial later capture only adds).  The
+            # cooldown keeps a long-lived window from being re-captured
+            # back-to-back, which would just burn the chip's time.
+            sleep 900
+        else
+            echo "$(date -Is) capture aborted (tunnel drop?); re-arming"
         fi
-        echo "$(date -Is) capture aborted (tunnel drop?); re-arming"
     else
         echo "$(date -Is) tunnel down; next probe in ${INTERVAL}s"
     fi

@@ -1,0 +1,123 @@
+"""
+Unit tests for the capture summarizer (`scripts/summarize_capture.py`):
+the filtering rules are what keep a serial-loop (" [classic]") rate or an
+errored verdict from being published into BASELINE.json as a headline
+measurement, so they are pinned here against hand-built capture dirs.
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "summarize_capture",
+    Path(__file__).resolve().parents[2] / "scripts" / "summarize_capture.py",
+)
+sc = importlib.util.module_from_spec(_spec)
+sys.modules["summarize_capture"] = sc
+_spec.loader.exec_module(sc)
+
+
+def _write(outdir: Path, name: str, lines: list) -> None:
+    outdir.mkdir(parents=True, exist_ok=True)
+    (outdir / name).write_text(
+        "\n".join(
+            json.dumps(l) if isinstance(l, dict) else l for l in lines
+        )
+        + "\n"
+    )
+
+
+def test_headline_prefers_unsuffixed_line(tmp_path):
+    _write(
+        tmp_path,
+        "bench.log",
+        [
+            "noise text",
+            {"metric": "m [classic]", "value": 1.0, "driver": "classic"},
+            {"metric": "m", "value": 5.0, "pipelined_steps_per_s": 5.0},
+        ],
+    )
+    s = sc.summarize(tmp_path)
+    assert s["headline_10k_128"]["value"] == 5.0
+    assert "classic_only" not in s["headline_10k_128"]
+
+
+def test_classic_only_run_is_marked_and_not_published(tmp_path):
+    _write(
+        tmp_path,
+        "bench.log",
+        [{"metric": "m [classic]", "value": 1.0, "driver": "classic"}],
+    )
+    s = sc.summarize(tmp_path)
+    assert s["headline_10k_128"]["classic_only"] is True
+
+    # publish() must refuse it (and errored/absent entries), leaving
+    # BASELINE.json untouched -> "nothing publishable"
+    published: dict = {}
+    baseline = {"published": published}
+    bl_path = tmp_path / "BASELINE.json"
+    bl_path.write_text(json.dumps(baseline))
+    orig = sc._REPO
+    try:
+        sc._REPO = tmp_path
+        sc.publish(s)
+    finally:
+        sc._REPO = orig
+    assert json.loads(bl_path.read_text())["published"] == {}
+
+
+def test_errored_bitrepro_not_published_but_conclusive_is(tmp_path):
+    _write(
+        tmp_path,
+        "bench.log",
+        [{"metric": "m", "value": 5.0, "pipelined_steps_per_s": 5.0}],
+    )
+    _write(
+        tmp_path,
+        "bitrepro.log",
+        [{"result": "error", "error": "accel child failed"}],
+    )
+    s = sc.summarize(tmp_path)
+    bl_path = tmp_path / "BASELINE.json"
+    bl_path.write_text(json.dumps({"published": {}}))
+    orig = sc._REPO
+    try:
+        sc._REPO = tmp_path
+        sc.publish(s)
+    finally:
+        sc._REPO = orig
+    pub = json.loads(bl_path.read_text())["published"]
+    assert pub["headline_10k_128"]["value"] == 5.0
+    assert pub["headline_10k_128"]["capture_dir"] == str(tmp_path)
+    assert "bitrepro" not in pub  # errored verdict must never clobber
+
+    # a conclusive verdict IS published
+    _write(tmp_path, "bitrepro.log", [{"result": "bit-identical", "steps_checked": 20}])
+    s2 = sc.summarize(tmp_path)
+    try:
+        sc._REPO = tmp_path
+        sc.publish(s2)
+    finally:
+        sc._REPO = orig
+    pub2 = json.loads(bl_path.read_text())["published"]
+    assert pub2["bitrepro"]["result"] == "bit-identical"
+
+
+def test_errored_bench_entry_not_published(tmp_path):
+    _write(
+        tmp_path,
+        "bench_40k.log",
+        [{"metric": "m40", "value": 0.0, "error": "RESOURCE_EXHAUSTED"}],
+    )
+    s = sc.summarize(tmp_path)
+    assert s["40k_256"]["error"] == "RESOURCE_EXHAUSTED"
+    bl_path = tmp_path / "BASELINE.json"
+    bl_path.write_text(json.dumps({"published": {}}))
+    orig = sc._REPO
+    try:
+        sc._REPO = tmp_path
+        sc.publish(s)
+    finally:
+        sc._REPO = orig
+    assert json.loads(bl_path.read_text())["published"] == {}

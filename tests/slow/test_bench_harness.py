@@ -38,6 +38,10 @@ def _env(**extra) -> dict:
             "MAGICSOUP_BENCH_PLATFORM": "cpu",
             "MAGICSOUP_BENCH_RETRY_BUDGET": "600",
             "MAGICSOUP_BENCH_ATTEMPT_TIMEOUT": "560",
+            # private lock file: non-cpu platform values (the
+            # unreachable-backend test) take the accelerator flock, and
+            # the GLOBAL one may be held by a live capture on this box
+            "MAGICSOUP_BENCH_LOCK_PATH": f"/tmp/ms_bench_test_{os.getpid()}.lock",
             **extra,
         }
     )
