@@ -63,12 +63,21 @@ def baseline_s_per_step(n_cells: int) -> float:
 BASELINE_S_PER_STEP = baseline_s_per_step(10_000)
 
 # named shape presets: the headline, the reference's second headline
-# (40k cells / 256^2 map), and the diffusion-heavy BASELINE.json config
+# (40k cells / 256^2 map), the diffusion-heavy BASELINE.json config, and
+# the rich-chemistry config (co2_fixing: 41 molecules / 46 reactions,
+# multi-domain proteins — the closest example module to BASELINE.json's
+# "32 molecules / 64 reactions" spec)
 CONFIGS = {
     "headline": {"n_cells": 10_000, "map_size": 128},
     "40k": {"n_cells": 40_000, "map_size": 256},
     "diffusion": {"n_cells": 10_000, "map_size": 512},
+    "rich": {"n_cells": 10_000, "map_size": 128, "chemistry": "co2_fixing"},
 }
+
+# chemistry modules by name; imported lazily in the child because the
+# interned Molecule registry forbids two example chemistries that share
+# molecule names (with different attributes) in one process
+_CHEMISTRIES = ("wood_ljungdahl", "co2_fixing")
 
 # optional platform pin for CPU smoke tests of this harness (the real
 # bench runs on whatever the driver provides and leaves this unset)
@@ -132,8 +141,17 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="named shape preset (overrides --n-cells/--map-size)",
     )
-    ap.add_argument("--n-cells", type=int, default=10_000)
-    ap.add_argument("--map-size", type=int, default=128)
+    # preset-controlled args default to None so an EXPLICIT value — even
+    # one equal to the fallback — is distinguishable and always wins
+    # over a --config preset; _apply_config fills the rest
+    ap.add_argument("--n-cells", type=int, default=None)
+    ap.add_argument("--map-size", type=int, default=None)
+    ap.add_argument(
+        "--chemistry",
+        choices=_CHEMISTRIES,
+        default=None,
+        help="example chemistry module driving the workload",
+    )
     ap.add_argument("--genome-size", type=int, default=500)
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--steps", type=int, default=15)
@@ -198,9 +216,14 @@ def _child_main(args: argparse.Namespace) -> None:
     )
     sys.stderr.flush()
 
+    import importlib
+
     import magicsoup_tpu as ms
-    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
     from magicsoup_tpu.util import random_genome
+
+    CHEMISTRY = importlib.import_module(
+        f"magicsoup_tpu.examples.{args.chemistry}"
+    ).CHEMISTRY
 
     sys.path.insert(0, str(Path(__file__).resolve().parent / "performance"))
     from workload import sim_step
@@ -264,7 +287,8 @@ def _child_main(args: argparse.Namespace) -> None:
     mode = " [deterministic]" if args.det else (" [pallas]" if args.pallas else "")
     metric_name = (
         f"sim steps/sec ({args.n_cells} cells, "
-        f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
+        f"{args.map_size}x{args.map_size} map, "
+        f"{args.chemistry.replace('_', '-')} "
         f"run_simulation workload){mode}"
     )
 
@@ -509,10 +533,25 @@ def _run_attempt(
     return rc, "".join(stderr_chunks)[-4000:]
 
 
+# fallbacks for the preset-controlled args (parser defaults are None so
+# explicit flags are detectable); applied after any --config preset
+_ARG_FALLBACKS = {
+    "n_cells": 10_000,
+    "map_size": 128,
+    "chemistry": "wood_ljungdahl",
+}
+
+
 def _apply_config(args: argparse.Namespace) -> None:
-    if args.config is not None:
-        for key, val in CONFIGS[args.config].items():
-            setattr(args, key, val)
+    """Resolve preset-controlled args: explicit flag > --config preset >
+    fallback.  `--config rich --n-cells 80` means a small rich-chemistry
+    run, and `--config 40k --n-cells 10000` honors the explicit 10k —
+    the parser's None default makes 'explicitly set to the fallback
+    value' distinguishable from 'omitted'."""
+    preset = CONFIGS[args.config] if args.config is not None else {}
+    for key, fallback in _ARG_FALLBACKS.items():
+        if getattr(args, key) is None:
+            setattr(args, key, preset.get(key, fallback))
 
 
 def main() -> None:
@@ -552,7 +591,8 @@ def main() -> None:
             {
                 "metric": (
                     f"sim steps/sec ({args.n_cells} cells, "
-                    f"{args.map_size}x{args.map_size} map, wood-ljungdahl "
+                    f"{args.map_size}x{args.map_size} map, "
+                    f"{args.chemistry.replace('_', '-')} "
                     f"run_simulation workload){mode}"
                 ),
                 "value": 0.0,

@@ -55,8 +55,10 @@ fi
 # itself) so a driver-initiated benchmark in the same window serializes
 # instead of contending through the one chip+tunnel; -w 300 bounds the
 # wait so a long-held lock costs one harness slot, not the capture.
-# Keep in sync with _ACCEL_LOCK_PATH in bench.py.
-LOCK=/tmp/magicsoup_tpu_accel.lock
+# Keep in sync with _ACCEL_LOCK_PATH in bench.py (including its
+# MAGICSOUP_BENCH_LOCK_PATH override, or the two sides stop excluding
+# each other).
+LOCK="${MAGICSOUP_BENCH_LOCK_PATH:-/tmp/magicsoup_tpu_accel.lock}"
 run() {
     name="$1"; to="$2"; shift 2
     echo "== $name (<=${to}s): $*" | tee -a "$OUT/capture.log"
@@ -90,6 +92,7 @@ run integrator       600 python performance/integrator_bench.py
 run bitrepro        1800 python scripts/bitrepro.py
 run bench_40k       1800 python bench.py --config 40k --warmup 4 --steps 8
 run bench_det       1800 python bench.py --det --warmup 4 --steps 8
+run bench_rich      1800 python bench.py --config rich --warmup 4 --steps 8
 run pallas_bisect   1500 python performance/pallas_bisect.py
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8

@@ -85,6 +85,32 @@ def test_run_attempt_ready_marker_lifts_watchdog():
     assert state["headline"]
 
 
+def test_config_preset_precedence():
+    # explicit flag > --config preset > fallback — even when the
+    # explicit value equals the fallback
+    ap = bench._build_parser()
+
+    args = ap.parse_args(["--config", "40k"])
+    bench._apply_config(args)
+    assert (args.n_cells, args.map_size) == (40_000, 256)
+    assert args.chemistry == "wood_ljungdahl"
+
+    args = ap.parse_args(["--config", "40k", "--n-cells", "10000"])
+    bench._apply_config(args)
+    assert (args.n_cells, args.map_size) == (10_000, 256)
+
+    args = ap.parse_args(["--config", "rich", "--chemistry", "wood_ljungdahl"])
+    bench._apply_config(args)
+    assert args.chemistry == "wood_ljungdahl"
+    assert args.n_cells == 10_000
+
+    args = ap.parse_args([])
+    bench._apply_config(args)
+    assert (args.n_cells, args.map_size, args.chemistry) == (
+        10_000, 128, "wood_ljungdahl",
+    )
+
+
 def test_transient_markers_cover_tunnel_failure_modes():
     for msg in (
         "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE",
