@@ -139,7 +139,8 @@ def _build_parser() -> argparse.ArgumentParser:
         "--config",
         choices=sorted(CONFIGS),
         default=None,
-        help="named shape preset (overrides --n-cells/--map-size)",
+        help="named shape preset; fills in any of --n-cells/--map-size/"
+        "--chemistry not passed explicitly (explicit flags win)",
     )
     # preset-controlled args default to None so an EXPLICIT value — even
     # one equal to the fallback — is distinguishable and always wins
@@ -417,7 +418,7 @@ _ACCEL_LOCK_PATH = os.environ.get(
 )
 
 
-def _acquire_accel_lock(max_wait_s: float):
+def _acquire_accel_lock(max_wait_s: float, platform: str | None = None):
     """One accelerator job at a time: concurrent benchmarks through the
     shared chip+tunnel contaminate each other's timings (the round-3
     windows showed a single fetch storm doubling another job's step
@@ -427,8 +428,10 @@ def _acquire_accel_lock(max_wait_s: float):
     after ``max_wait_s`` of contention.  CPU-pinned smoke runs return
     None without locking: they touch no shared accelerator and must be
     parallelizable in CI; any other platform pin still names a shared
-    accelerator and locks like the unpinned path."""
-    if _PLATFORM == "cpu":
+    accelerator and locks like the unpinned path.  ``platform``
+    overrides the env-derived pin for harnesses with their own flag
+    (performance/readme_slice.py)."""
+    if (_PLATFORM if platform is None else platform) == "cpu":
         return None
     import fcntl
 

@@ -1,0 +1,103 @@
+"""
+BASELINE.json config 1: the README example verbatim (reference
+`README.md:45-115` — 4-molecule CO2/NADPH->formiat chemistry, 100 cells,
+500-bp genomes, default 128x128 map) timed for N steps on the CPU
+backend.  This is the one BASELINE config defined ON CPU, so it is
+measurable without the accelerator tunnel.
+
+    python performance/readme_slice.py [--steps 300] [--platform cpu]
+
+Prints one JSON line: {"metric": ..., "value": steps/s, ...}.
+"""
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform pin; config 1 is defined on cpu (pass '' to"
+        " use whatever accelerator jax finds)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from bench import _acquire_accel_lock, _setup_compile_cache
+
+    # accelerator runs serialize on the shared flock like every other
+    # harness; cpu runs skip it (held for process lifetime when taken)
+    _lock = _acquire_accel_lock(max_wait_s=600.0, platform=args.platform)
+    _setup_compile_cache(jax)
+
+    import numpy as np
+
+    import magicsoup_tpu as ms
+
+    NADPH = ms.Molecule("NADPH", 200 * 1e3)
+    NADP = ms.Molecule("NADP", 100 * 1e3)
+    formiat = ms.Molecule("formiat", 20 * 1e3)
+    co2 = ms.Molecule("CO2", 10 * 1e3, diffusivity=1.0, permeability=1.0)
+    chemistry = ms.Chemistry(
+        molecules=[NADPH, NADP, formiat, co2],
+        reactions=[([co2, NADPH], [formiat, NADP])],
+    )
+    world = ms.World(chemistry=chemistry, seed=42)
+    world.spawn_cells(genomes=[ms.random_genome(s=500) for _ in range(100)])
+    rng = np.random.default_rng(42)
+
+    def sample(p: np.ndarray) -> list:
+        return np.nonzero(rng.random(len(p)) < p)[0].tolist()
+
+    def step() -> None:
+        world.enzymatic_activity()
+        x = world.cell_molecules[:, 2]
+        world.kill_cells(cell_idxs=sample(0.01 / (0.01 + x)))
+        x = world.cell_molecules[:, 2]
+        world.divide_cells(cell_idxs=sample(x**3 / (x**3 + 20.0**3)))
+        world.mutate_cells(p=1e-4)
+        world.recombinate_cells(p=1e-6)
+        world.diffuse_molecules()
+
+    for _ in range(args.warmup):
+        step()
+    world.wait_warm()
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    float(world._molecule_map[0, 0, 0])  # value fetch = true barrier
+    dt = (time.perf_counter() - t0) / args.steps
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "README slice steps/sec (100-cell start, 4-molecule"
+                    f" chemistry, 128x128 map, {jax.default_backend()})"
+                ),
+                "value": round(1.0 / dt, 4),
+                "unit": "steps/s",
+                "ms_per_step": round(dt * 1e3, 2),
+                "final_n_cells": world.n_cells,
+                "backend": jax.default_backend(),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,7 @@ run bitrepro        1800 python scripts/bitrepro.py
 run bench_40k       1800 python bench.py --config 40k --warmup 4 --steps 8
 run bench_det       1800 python bench.py --det --warmup 4 --steps 8
 run bench_rich      1800 python bench.py --config rich --warmup 4 --steps 8
+run bench_1k        1200 python bench.py --n-cells 1000 --warmup 4 --steps 10
 run pallas_bisect   1500 python performance/pallas_bisect.py
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8

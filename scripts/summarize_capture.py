@@ -29,6 +29,7 @@ _BENCH_LOGS = {
     "bench_det.log": "det_10k_128",
     "bench_diffusion.log": "diffusion_10k_512",
     "bench_rich.log": "rich_10k_128",
+    "bench_1k.log": "1k_128",
 }
 
 
@@ -111,10 +112,15 @@ def publish(summary: dict) -> None:
             # best-value-wins: the watcher re-arms across windows, and a
             # later congested window (shared tunnel, flaky RTT) must not
             # silently degrade an already-published healthy rate — these
-            # are capability records, keep the fastest clean measurement
+            # are capability records, keep the fastest clean measurement.
+            # ONLY when the metric string matches: a changed workload
+            # (edited preset/harness) produces a different metric name
+            # and must overwrite, or a stale higher number measuring a
+            # different workload would be pinned forever
             prev = published.get(key)
             if (
                 isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
                 and prev.get("value", 0) >= entry.get("value", 0)
             ):
                 continue
