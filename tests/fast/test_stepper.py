@@ -385,3 +385,52 @@ def test_packed_output_unpack_layout():
     assert (out.parents[out.n_placed:] == st._cap).all()
     st.drain()
     st.flush()
+
+
+def test_worker_submit_close_semantics():
+    """_Worker contract: results resolve in FIFO order; a submit after
+    close() resolves inline instead of queuing behind the shutdown
+    sentinel (where its Future would never resolve); close() is
+    idempotent and safe to race with submits (the closed-check-and-put
+    is serialized by a lock)."""
+    import threading
+
+    from magicsoup_tpu.stepper import _Worker
+
+    w = _Worker("test-worker")
+    futs = [w.submit(lambda i=i: i * 2) for i in range(20)]
+    assert [f.result(timeout=30) for f in futs] == [i * 2 for i in range(20)]
+
+    # exceptions are delivered through the Future, not swallowed
+    def boom():
+        raise ValueError("boom")
+
+    err = w.submit(boom)
+    with pytest.raises(ValueError, match="boom"):
+        err.result(timeout=30)
+
+    w.close()
+    w.close()  # idempotent
+    late = w.submit(lambda: "inline")
+    assert late.result(timeout=1) == "inline"  # resolved inline, no hang
+
+    # hammer the race: concurrent submits against a worker being closed
+    # must never leave an unresolved Future (pre-lock this could enqueue
+    # an item behind the sentinel)
+    for trial in range(30):
+        w2 = _Worker(f"race-{trial}")
+        results = []
+
+        def submit_many():
+            for k in range(50):
+                results.append(w2.submit(lambda k=k: k))
+
+        t = threading.Thread(target=submit_many)
+        t.start()
+        w2.close()
+        t.join(timeout=30)
+        # the pre-lock race made submit() hang against close(): a still-
+        # alive submitter means the regression is back
+        assert not t.is_alive()
+        for f in results:
+            f.result(timeout=30)  # every Future resolves, queued OR inline
