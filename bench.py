@@ -106,18 +106,29 @@ def probe_backend(timeout_s: float) -> tuple[bool, str]:
             f"jax.config.update('jax_platforms', {_PLATFORM!r}); "
             "jax.devices()"
         )
+    # own session so a hung probe (plus any runtime helpers it spawned)
+    # can be killed as a whole process group — subprocess.run's timeout
+    # kill only reaches the direct child and leaks its orphans
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
     try:
-        res = subprocess.run(
-            [sys.executable, "-c", code],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.PIPE,
-            text=True,
-            timeout=timeout_s,
-        )
+        _, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return False, f"backend probe hung (> {timeout_s:.0f}s)"
-    if res.returncode != 0:
-        return False, (res.stderr or "")[-2000:]
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+    if proc.returncode != 0:
+        return False, (stderr or "")[-2000:]
     return True, ""
 
 # stderr markers that indicate a transient backend/tunnel failure worth retrying
@@ -432,6 +443,11 @@ def _acquire_accel_lock(max_wait_s: float, platform: str | None = None):
     overrides the env-derived pin for harnesses with their own flag
     (performance/readme_slice.py)."""
     if (_PLATFORM if platform is None else platform) == "cpu":
+        return None
+    if os.environ.get("MAGICSOUP_BENCH_LOCK_HELD") == "1":
+        # an enclosing capture script already holds the flock around this
+        # process (scripts/capture_tpu_numbers.sh) — taking it again here
+        # would self-deadlock
         return None
     import fcntl
 

@@ -1,0 +1,28 @@
+"""
+graftlint — static analysis + runtime guards for the TPU hot path.
+
+The classes of bug that quietly destroy accelerator throughput (implicit
+device->host syncs, jit recompile churn, dtype drift off the BITREPRO.md
+float32 contract, hidden nondeterminism) are invisible to normal tests:
+the code still computes the right numbers, just 10-1000x slower or
+unreproducibly.  This package enforces them mechanically:
+
+- static half: an AST lint pass over the library (`engine`, `callgraph`,
+  `rules`) with a CLI (``python -m magicsoup_tpu.analysis --check``)
+  wired into ``scripts/test.sh``;
+- runtime half (`runtime`): ``hot_path_guard`` wraps hot-path tests in
+  ``jax.transfer_guard("disallow")`` plus a compilation-count budget.
+
+Rule codes (see `rules` for details, README.md for the user guide):
+
+- GL001 host-sync-in-hot-path
+- GL002 recompile-hazard
+- GL003 dtype-discipline
+- GL004 nondeterminism
+- GL005 blocking-transfer
+
+Suppress a finding on one line with ``# graftlint: disable=GL001`` (or a
+comma list, or ``disable=all``); mark extra hot-path roots for the
+reachability analysis with ``# graftlint: hot`` on a ``def`` line.
+"""
+from magicsoup_tpu.analysis.engine import Finding, analyze  # noqa: F401

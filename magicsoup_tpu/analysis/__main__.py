@@ -1,0 +1,5 @@
+import sys
+
+from magicsoup_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,167 @@
+"""
+Best-effort AST call graph over the linted file set, for hot-path
+reachability (rule GL001 needs "functions reachable from the step
+dispatches", not just the dispatches themselves).
+
+Resolution is intentionally conservative — an edge is only recorded when
+the callee can be pinned to a function in the linted set:
+
+- bare names defined in the same module;
+- ``self.meth(...)`` / ``cls.meth(...)`` within the defining class;
+- ``from pkg.mod import fn`` then ``fn(...)``;
+- ``import pkg.mod as m`` / ``from pkg import mod`` then ``m.fn(...)``.
+
+Anything dynamic (callbacks, dict dispatch, attribute chains through
+objects) is dropped rather than guessed: a too-eager graph would mark
+half the library hot and drown real findings in noise.  Nested ``def``s
+are folded into their enclosing function — a helper closed over by a hot
+function is hot by construction.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# The step dispatches the simulation loop actually drives, keyed by file
+# basename so the same seeds work on a checkout, an installed tree, or a
+# test fixture copy.  Extra roots can be marked in source with a
+# `# graftlint: hot` comment on (or directly above) the `def` line.
+HOT_SEEDS: dict[str, tuple[str, ...]] = {
+    "stepper.py": (
+        "PipelinedStepper.step",
+        "PipelinedStepper.drain",
+    ),
+    "world.py": (
+        "World.spawn_cells",
+        "World.add_cells",
+        "World.divide_cells",
+        "World.update_cells",
+        "World.kill_cells",
+        "World.move_cells",
+        "World.reposition_cells",
+        "World.enzymatic_activity",
+        "World.diffuse_molecules",
+        "World.degrade_molecules",
+        "World.mutate_cells",
+        "World.recombinate_cells",
+    ),
+}
+
+FuncKey = tuple[str, str]  # (file rel path, dotted qualname)
+
+
+@dataclass
+class FunctionRecord:
+    """One module- or class-level function, with nested defs folded in."""
+
+    file: object  # engine.SourceFile (duck-typed: .rel, .tree, ...)
+    qualname: str
+    node: ast.AST
+    hot_marked: bool = False
+    calls: set[FuncKey] = field(default_factory=set)
+
+
+class CallGraph:
+    def __init__(self, files: list):
+        self.files = list(files)
+        self.functions: dict[FuncKey, FunctionRecord] = {}
+        self._by_module: dict[str, object] = {}
+        self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
+        for f in self.files:
+            self._by_module[f.module] = f
+            self._index_file(f)
+        for rec in self.functions.values():
+            self._extract_calls(rec)
+
+    # ------------------------------------------------------------- index
+    def _index_file(self, f) -> None:
+        imports: dict[str, tuple[str, str | None]] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    imports[alias] = (a.name, None)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    imports[a.asname or a.name] = (node.module, a.name)
+        self._imports[f.rel] = imports
+
+        def visit(body, prefix: str) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    first = node.decorator_list[0].lineno if node.decorator_list else node.lineno
+                    marked = any(
+                        ln in f.hot_marks
+                        for ln in range(first - 1, node.lineno + 1)
+                    )
+                    q = prefix + node.name
+                    self.functions[(f.rel, q)] = FunctionRecord(
+                        file=f, qualname=q, node=node, hot_marked=marked
+                    )
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, prefix + node.name + ".")
+
+        visit(f.tree.body, "")
+
+    # ------------------------------------------------------------- edges
+    def _extract_calls(self, rec: FunctionRecord) -> None:
+        cls = rec.qualname.rsplit(".", 1)[0] if "." in rec.qualname else None
+        for node in ast.walk(rec.node):
+            if isinstance(node, ast.Call):
+                tgt = self.resolve(rec.file, cls, node.func)
+                if tgt is not None:
+                    rec.calls.add(tgt)
+
+    def resolve(self, f, cls: str | None, func: ast.expr) -> FuncKey | None:
+        """Resolve a call target expression to a linted function, or None."""
+        imports = self._imports.get(f.rel, {})
+        if isinstance(func, ast.Name):
+            if (f.rel, func.id) in self.functions:
+                return (f.rel, func.id)
+            if func.id in imports:
+                mod, name = imports[func.id]
+                if name is not None:
+                    return self._module_func(mod, name)
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            base = func.value.id
+            if base in ("self", "cls") and cls:
+                key = (f.rel, f"{cls}.{func.attr}")
+                return key if key in self.functions else None
+            if base in imports:
+                mod, name = imports[base]
+                target = mod if name is None else f"{mod}.{name}"
+                return self._module_func(target, func.attr)
+        return None
+
+    def _module_func(self, module: str, name: str) -> FuncKey | None:
+        tf = self._by_module.get(module)
+        if tf is None:
+            # linting a subtree (or a fixture dir) yields shorter dotted
+            # module names than the import strings — match by suffix
+            for m, file in self._by_module.items():
+                if module.endswith("." + m) or m.endswith("." + module):
+                    tf = file
+                    break
+        if tf is None:
+            return None
+        key = (tf.rel, name)
+        return key if key in self.functions else None
+
+    # --------------------------------------------------------------- hot
+    def hot_functions(self) -> set[FuncKey]:
+        """Transitive closure of the step-dispatch seeds + hot marks."""
+        seeds = [
+            key
+            for key, rec in self.functions.items()
+            if rec.hot_marked
+            or rec.qualname in HOT_SEEDS.get(rec.file.rel.rsplit("/", 1)[-1], ())
+        ]
+        seen = set(seeds)
+        stack = list(seeds)
+        while stack:
+            for callee in self.functions[stack.pop()].calls:
+                if callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        return seen

@@ -1,0 +1,78 @@
+"""graftlint command line: `python -m magicsoup_tpu.analysis [--check]`."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import asdict
+from pathlib import Path
+
+from magicsoup_tpu.analysis import engine
+from magicsoup_tpu.analysis.rules import RULE_INFO
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m magicsoup_tpu.analysis",
+        description="graftlint: JAX/TPU hot-path static analyzer "
+        "(host syncs, recompile churn, dtype drift, nondeterminism, "
+        "unsanctioned transfers)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: the magicsoup_tpu package)",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit nonzero when findings are not covered by the baseline "
+        "(the CI mode wired into scripts/test.sh)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline JSON path (default: the shipped — empty — "
+        "analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, (name, desc) in RULE_INFO.items():
+            print(f"{code}  {name:24s} {desc}")
+        return 0
+
+    paths = args.paths or [engine.default_target()]
+    only = args.rules.split(",") if args.rules else None
+    findings = engine.analyze(paths, rules=only)
+    baseline = engine.load_baseline(
+        Path(args.baseline) if args.baseline else None
+    )
+    fresh = engine.apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps([asdict(f) for f in fresh], indent=2))
+    else:
+        for f in fresh:
+            print(f.format())
+        n_files = len({f.path for f in fresh})
+        print(
+            f"graftlint: {len(fresh)} finding(s) in {n_files} file(s) "
+            f"({len(findings) - len(fresh)} baselined)"
+        )
+    return 1 if (args.check and fresh) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""
+graftlint rule engine: file parsing, suppression comments, the baseline,
+and the analyze() entry point the CLI and tests share.
+
+Pure stdlib (ast + tokenize) — the static half must run in CI images
+without importing jax or the library under analysis.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+# codes only — free-text rationale after the code list is encouraged
+SUPPRESS_RE = re.compile(
+    r"graftlint:\s*disable=((?:[A-Za-z]+\d*)(?:\s*,\s*[A-Za-z]+\d*)*)"
+)
+HOT_RE = re.compile(r"graftlint:\s*hot\b")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str  # "GL001"
+    name: str  # "host-sync-in-hot-path"
+    message: str
+    fixit: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"({self.name}) {self.message}\n    fix-it: {self.fixit}"
+        )
+
+    @property
+    def key(self) -> str:
+        """Baseline key: stable across line-number drift."""
+        return f"{self.path}::{self.rule}"
+
+
+class SourceFile:
+    """One parsed module: AST + suppression/hot comment maps."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = path.read_text()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.module = self.rel[:-3].replace("/", ".")
+        self.suppressions: dict[int, set[str]] = {}
+        self.hot_marks: set[int] = set()
+        self._parents: dict[ast.AST, ast.AST] | None = None
+        lines = self.text.splitlines()
+        for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if m:
+                codes = {
+                    c.strip().upper()
+                    for c in m.group(1).split(",")
+                    if c.strip()
+                }
+                line = tok.start[0]
+                self.suppressions.setdefault(line, set()).update(codes)
+                # a comment-only line suppresses the line BELOW it too
+                # (trailing comments don't fit next to long expressions)
+                if lines[line - 1].lstrip().startswith("#"):
+                    self.suppressions.setdefault(line + 1, set()).update(codes)
+            if HOT_RE.search(tok.string):
+                self.hot_marks.add(tok.start[0])
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        codes = self.suppressions.get(line, ())
+        return rule in codes or "ALL" in codes
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+
+@dataclass
+class Context:
+    """Everything a rule checker gets to see."""
+
+    files: list[SourceFile]
+    graph: object  # callgraph.CallGraph
+    hot: set  # set[FuncKey]
+
+
+def default_target() -> Path:
+    """The library source dir (the `magicsoup_tpu` package itself)."""
+    return Path(__file__).resolve().parents[1]
+
+
+def iter_python_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def load_files(paths, exclude_analysis: bool = True) -> list[SourceFile]:
+    files = []
+    seen = set()
+    for path in iter_python_files(paths):
+        resolved = path.resolve()
+        if resolved in seen:
+            continue
+        seen.add(resolved)
+        if exclude_analysis and "analysis" in resolved.parts:
+            continue  # the linter does not lint itself
+        rel = os.path.relpath(resolved)
+        files.append(SourceFile(resolved, rel))
+    return files
+
+
+def analyze(paths, rules: list[str] | None = None) -> list[Finding]:
+    """Run the (optionally filtered) rule set over `paths`.
+
+    Returns suppression-filtered findings sorted by location.  Baseline
+    subtraction is separate (see apply_baseline) so callers can report
+    both totals.
+    """
+    from magicsoup_tpu.analysis import rules as rules_mod
+    from magicsoup_tpu.analysis.callgraph import CallGraph
+
+    files = load_files(paths)
+    graph = CallGraph(files)
+    ctx = Context(files=files, graph=graph, hot=graph.hot_functions())
+
+    by_rel = {f.rel: f for f in files}
+    findings: list[Finding] = []
+    for code, checker in rules_mod.checkers(rules).items():
+        for finding in checker(ctx):
+            src = by_rel.get(finding.path)
+            if src is not None and src.suppressed(finding.line, finding.rule):
+                continue
+            findings.append(finding)
+    return sorted(set(findings))
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Path | None = None) -> dict[str, int]:
+    """Baseline: map of `path::RULE` -> tolerated finding count.  The
+    shipped baseline is EMPTY by policy — pre-existing findings are fixed
+    or annotated inline where the next reader sees them; the file exists
+    so a downstream fork can stage a large cleanup incrementally."""
+    path = path or default_baseline_path()
+    if not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text() or "{}")
+    return {str(k): int(v) for k, v in data.items()}
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, int]
+) -> list[Finding]:
+    """Drop up to the baselined count of findings per `path::RULE` key."""
+    budget = dict(baseline)
+    fresh = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            fresh.append(f)
+    return fresh

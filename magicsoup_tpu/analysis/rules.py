@@ -1,0 +1,603 @@
+"""
+The graftlint rule set.  Each checker takes an engine.Context and yields
+Findings; registration at the bottom.
+
+| code  | name                 | protects                                   |
+|-------|----------------------|--------------------------------------------|
+| GL001 | host-sync-in-hot-path| step-loop latency (no blocking D2H syncs)  |
+| GL002 | recompile-hazard     | compile-time amortization (no per-step jit)|
+| GL003 | dtype-discipline     | BITREPRO.md float32 contract               |
+| GL004 | nondeterminism       | seeded reproducibility                     |
+| GL005 | blocking-transfer    | the single audited D2H boundary            |
+
+The device-taint analysis is a deliberately shallow intra-procedural
+pass: a name is "device" when it is a parameter annotated with a device
+type, is assigned from a jax/jnp call, or flows through arithmetic /
+indexing / method calls on device values; fetching through the
+sanctioned boundary (util.fetch_host, jax.device_get) un-taints.  Shallow
+means under-approximate — the clean-tree test plus code review cover the
+rest; precision here buys a zero-noise default, which is what keeps the
+lint gate tolerable in CI.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from magicsoup_tpu.analysis.engine import Context, Finding
+
+JAX_ROOTS = {"jax", "jnp", "lax"}
+NUMPY_ROOTS = {"np", "numpy"}
+# device-resident attributes of the library's own classes
+DEVICE_ATTRS = {
+    "_state",
+    "_molecule_map",
+    "_cell_molecules",
+    "_positions_dev",
+    "_mol_idx_dev",
+    "_kill_below_dev",
+    "_divide_above_dev",
+    "_divide_cost_dev",
+}
+# metadata attributes that never touch device buffers
+HOST_META_ATTRS = {
+    "shape",
+    "ndim",
+    "dtype",
+    "size",
+    "nbytes",
+    "itemsize",
+    "sharding",
+    "is_fully_addressable",
+    "is_deleted",
+    "weak_type",
+}
+# the sanctioned boundary: fetching through these returns HOST data
+HOST_FETCHERS = {"fetch_host", "_fetch_host", "device_get", "sanctioned_transfer"}
+# jax.* calls that return host metadata, not device buffers
+JAX_HOST_FNS = {
+    "devices",
+    "local_devices",
+    "device_count",
+    "local_device_count",
+    "process_index",
+    "process_count",
+    "default_backend",
+    "eval_shape",
+}
+DEVICE_ANN = re.compile(r"\bArray\b|\bDeviceState\b|\bCellParams\b")
+
+RULE_INFO = {
+    "GL001": (
+        "host-sync-in-hot-path",
+        "blocking device->host sync inside a function reachable from the "
+        "step dispatches",
+    ),
+    "GL002": (
+        "recompile-hazard",
+        "jit/pmap wrapper constructed per call, or unhashable static "
+        "argument — every occurrence retriggers trace+compile",
+    ),
+    "GL003": (
+        "dtype-discipline",
+        "float64 / bare-Python-float array construction outside "
+        "ops/detmath.py (BITREPRO.md float32 contract)",
+    ),
+    "GL004": (
+        "nondeterminism",
+        "wall-clock or unseeded randomness in library code",
+    ),
+    "GL005": (
+        "blocking-transfer",
+        "device->host transfer outside the sanctioned util.fetch_host "
+        "boundary",
+    ),
+}
+
+
+def _root_name(node: ast.expr) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _attr_chain(node: ast.expr) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_host_fetch(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in HOST_FETCHERS
+    if isinstance(func, ast.Attribute):
+        return func.attr in HOST_FETCHERS
+    return False
+
+
+def _finding(code: str, f, node: ast.AST, message: str, fixit: str) -> Finding:
+    return Finding(
+        path=f.rel,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        rule=code,
+        name=RULE_INFO[code][0],
+        message=message,
+        fixit=fixit,
+    )
+
+
+# --------------------------------------------------------------- taint
+def device_tainted_names(fn_node: ast.AST) -> set[str]:
+    tainted: set[str] = set()
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if a.annotation is not None and DEVICE_ANN.search(
+                ast.unparse(a.annotation)
+            ):
+                tainted.add(a.arg)
+    # two fixed passes: enough for straight-line propagation without a
+    # full dataflow framework
+    for _ in range(2):
+        for node in ast.walk(fn_node):
+            value = None
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.AugAssign):
+                value, targets = node.value, [node.target]
+            if value is None:
+                continue
+            names = [
+                t.id
+                for tgt in targets
+                for t in (
+                    tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+                )
+                if isinstance(t, ast.Name)
+            ]
+            if isinstance(value, ast.Call) and _is_host_fetch(value.func):
+                tainted.difference_update(names)
+            elif expr_is_device(value, tainted):
+                tainted.update(names)
+    return tainted
+
+
+def expr_is_device(e: ast.expr, tainted: set[str]) -> bool:
+    if isinstance(e, ast.Name):
+        return e.id in tainted
+    if isinstance(e, ast.Attribute):
+        if e.attr in HOST_META_ATTRS:
+            return False
+        if e.attr in DEVICE_ATTRS:
+            return True
+        return expr_is_device(e.value, tainted)
+    if isinstance(e, ast.Call):
+        if _is_host_fetch(e.func):
+            return False
+        root = _root_name(e.func)
+        if root in JAX_ROOTS:
+            return not (
+                isinstance(e.func, ast.Attribute) and e.func.attr in JAX_HOST_FNS
+            )
+        if isinstance(e.func, ast.Attribute) and e.func.attr not in (
+            "item",
+            "tolist",
+        ):
+            # method call on a device value returns a device value
+            return expr_is_device(e.func.value, tainted)
+        return False
+    if isinstance(e, ast.BinOp):
+        return expr_is_device(e.left, tainted) or expr_is_device(e.right, tainted)
+    if isinstance(e, ast.UnaryOp):
+        return expr_is_device(e.operand, tainted)
+    if isinstance(e, ast.Subscript):
+        return expr_is_device(e.value, tainted)
+    if isinstance(e, ast.Compare):
+        # identity tests (`x is None`) read the reference, not the buffer
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+            return False
+        return expr_is_device(e.left, tainted) or any(
+            expr_is_device(c, tainted) for c in e.comparators
+        )
+    if isinstance(e, ast.BoolOp):
+        return any(expr_is_device(v, tainted) for v in e.values)
+    if isinstance(e, ast.IfExp):
+        return expr_is_device(e.body, tainted) or expr_is_device(e.orelse, tainted)
+    if isinstance(e, (ast.Tuple, ast.List)):
+        return any(expr_is_device(v, tainted) for v in e.elts)
+    return False
+
+
+# --------------------------------------------------------------- GL001
+def check_gl001(ctx: Context):
+    fix_fetch = (
+        "keep the value on device, or fetch ONCE through "
+        "magicsoup_tpu.util.fetch_host outside the step loop"
+    )
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        tainted = device_tainted_names(rec.node)
+        for node in ast.walk(rec.node):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                # .item() is unconditional (it is a sync by definition);
+                # .tolist() only on device-tainted receivers — host numpy
+                # .tolist() is idiomatic in the pure-python fallbacks
+                if isinstance(fn, ast.Attribute) and (
+                    fn.attr == "item"
+                    or (
+                        fn.attr == "tolist"
+                        and expr_is_device(fn.value, tainted)
+                    )
+                ):
+                    yield _finding(
+                        "GL001",
+                        f,
+                        node,
+                        f"`.{fn.attr}()` in hot function `{rec.qualname}` "
+                        "blocks the step loop on a device->host transfer",
+                        fix_fetch,
+                    )
+                elif (
+                    isinstance(fn, ast.Name)
+                    and fn.id in ("float", "int", "bool")
+                    and node.args
+                    and expr_is_device(node.args[0], tainted)
+                ):
+                    yield _finding(
+                        "GL001",
+                        f,
+                        node,
+                        f"`{fn.id}()` on a device value in hot function "
+                        f"`{rec.qualname}` forces a blocking sync",
+                        fix_fetch,
+                    )
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in ("asarray", "array")
+                    and _root_name(fn) in NUMPY_ROOTS
+                    and node.args
+                    and expr_is_device(node.args[0], tainted)
+                ):
+                    yield _finding(
+                        "GL001",
+                        f,
+                        node,
+                        f"`np.{fn.attr}()` on a device value in hot function "
+                        f"`{rec.qualname}` forces a blocking sync",
+                        fix_fetch,
+                    )
+            elif isinstance(node, ast.If) and expr_is_device(node.test, tainted):
+                yield _finding(
+                    "GL001",
+                    f,
+                    node,
+                    f"`if` on a device value in hot function `{rec.qualname}` "
+                    "synchronizes every step (ConcretizationTypeError under "
+                    "jit; a blocking D2H when eager)",
+                    "branch with jnp.where / lax.cond, or hoist the decision "
+                    "out of the hot loop",
+                )
+
+
+# --------------------------------------------------------------- GL002
+_JIT_NAMES = {"jit", "pmap", "shard_map"}
+
+
+def _is_jit_ctor(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in _JIT_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _JIT_NAMES and (
+            _root_name(func) in JAX_ROOTS or _root_name(func) is None
+        )
+    return False
+
+
+def _cache_guarded(f, node: ast.AST) -> bool:
+    """The sanctioned memoized-jit idiom: the wrapper is built under an
+    ``if key not in cache:`` guard or stored into a cache subscript, so
+    it is constructed once per static configuration, not per call."""
+    parents = f.parents()
+    cur = node
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            for sub in ast.walk(cur.test):
+                if isinstance(sub, ast.Compare) and any(
+                    isinstance(op, (ast.NotIn, ast.In)) for op in sub.ops
+                ):
+                    return True
+        if isinstance(cur, ast.Assign) and any(
+            isinstance(t, ast.Subscript) for t in cur.targets
+        ):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def _enclosing_function(f, node: ast.AST):
+    parents = f.parents()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _static_argnames(fn_node: ast.AST) -> set[str]:
+    """Static-arg names declared by a @jit / @partial(jax.jit, ...)
+    decorator on `fn_node` (string literals only)."""
+    out: set[str] = set()
+    for dec in getattr(fn_node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        # direct jax.jit(...) or partial(jax.jit, static_argnames=...)
+        if not _is_jit_ctor(dec.func) and not any(
+            _is_jit_ctor(a) for a in dec.args
+        ):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        out.add(sub.value)
+    return out
+
+
+_UNHASHABLE = (ast.List, ast.Set, ast.Dict, ast.ListComp, ast.SetComp, ast.DictComp)
+
+
+def check_gl002(ctx: Context):
+    # index statically-declared jit functions for the call-site check
+    static_by_key: dict = {}
+    for key, rec in ctx.graph.functions.items():
+        names = _static_argnames(rec.node)
+        if names:
+            static_by_key[key] = names
+
+    for f in ctx.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_ctor(node.func):
+                enclosing = _enclosing_function(f, node)
+                if enclosing is None:
+                    continue  # module-scope jit compiles once
+                if _cache_guarded(f, node):
+                    continue
+                yield _finding(
+                    "GL002",
+                    f,
+                    node,
+                    f"jit/pmap wrapper constructed inside "
+                    f"`{enclosing.name}()` — a fresh wrapper per call "
+                    "restarts trace+compile every step",
+                    "hoist the jit to module scope, or memoize it in a "
+                    "module-level cache keyed by its static configuration",
+                )
+                continue
+            # call-site check: unhashable value passed to a declared
+            # static argument of a jitted function in the linted set
+            cls = None
+            enclosing = _enclosing_function(f, node)
+            if enclosing is not None:
+                parents = f.parents()
+                cur = parents.get(enclosing)
+                while cur is not None:
+                    if isinstance(cur, ast.ClassDef):
+                        cls = cur.name
+                        break
+                    cur = parents.get(cur)
+            target = ctx.graph.resolve(f, cls, node.func)
+            if target is None or target not in static_by_key:
+                continue
+            statics = static_by_key[target]
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(kw.value, _UNHASHABLE):
+                    yield _finding(
+                        "GL002",
+                        f,
+                        node,
+                        f"unhashable `{kw.arg}={ast.unparse(kw.value)}` "
+                        f"passed to static argument of jitted "
+                        f"`{target[1]}` — jit static args must be hashable "
+                        "(and every new value recompiles)",
+                        "pass a tuple / frozen value, and make sure its "
+                        "cardinality is bounded",
+                    )
+
+
+# --------------------------------------------------------------- GL003
+def check_gl003(ctx: Context):
+    fix = (
+        "stay in float32 (BITREPRO.md contract); deterministic f64 "
+        "accumulation belongs in ops/detmath.py — annotate sanctioned "
+        "sites with `# graftlint: disable=GL003`"
+    )
+    for f in ctx.files:
+        if f.rel.rsplit("/", 1)[-1] == "detmath.py":
+            continue  # THE sanctioned f64 module
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                root = _root_name(node)
+                if root in JAX_ROOTS | NUMPY_ROOTS:
+                    yield _finding(
+                        "GL003",
+                        f,
+                        node,
+                        f"`{_attr_chain(node)}` outside ops/detmath.py",
+                        fix,
+                    )
+            elif (
+                isinstance(node, ast.keyword)
+                and node.arg == "dtype"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value == "float64"
+            ):
+                yield _finding(
+                    "GL003", f, node.value, 'dtype="float64" string literal', fix
+                )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("array", "asarray")
+                and _root_name(node.func) in JAX_ROOTS
+                and not any(kw.arg == "dtype" for kw in node.keywords)
+                and any(
+                    isinstance(a, ast.Constant) and isinstance(a.value, float)
+                    for a in ast.walk(node)
+                    if isinstance(a, ast.Constant)
+                )
+            ):
+                yield _finding(
+                    "GL003",
+                    f,
+                    node,
+                    "bare Python float in jnp.array(...) without an explicit "
+                    "dtype — weak typing drifts to f64 under x64",
+                    "pass dtype=jnp.float32 explicitly",
+                )
+
+
+# --------------------------------------------------------------- GL004
+_NP_RANDOM_OK = {"default_rng", "Generator", "PCG64", "SeedSequence", "Philox"}
+
+
+def check_gl004(ctx: Context):
+    for f in ctx.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain in ("time.time", "time.time_ns"):
+                yield _finding(
+                    "GL004",
+                    f,
+                    node,
+                    f"`{chain}()` in library code — wall clock breaks seeded "
+                    "reproducibility",
+                    "thread an explicit seed / step counter through instead; "
+                    "annotate telemetry-only sites with "
+                    "`# graftlint: disable=GL004`",
+                )
+            elif chain.startswith("random.") and chain.split(".")[1] not in (
+                "Random",
+            ):
+                yield _finding(
+                    "GL004",
+                    f,
+                    node,
+                    f"`{chain}()` uses process-global (or OS-entropy) "
+                    "randomness",
+                    "use a seeded random.Random(seed) instance plumbed from "
+                    "the caller",
+                )
+            elif (
+                chain.startswith(("np.random.", "numpy.random."))
+                and chain.rsplit(".", 1)[-1] not in _NP_RANDOM_OK
+            ):
+                yield _finding(
+                    "GL004",
+                    f,
+                    node,
+                    f"`{chain}()` mutates numpy's process-global RNG",
+                    "use np.random.default_rng(seed) plumbed from the caller",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "PRNGKey"
+            ):
+                bad_seed = not node.args or any(
+                    isinstance(sub, ast.Call)
+                    and _attr_chain(sub.func).split(".")[0] in ("time", "random")
+                    for a in node.args
+                    for sub in ast.walk(a)
+                )
+                if bad_seed:
+                    yield _finding(
+                        "GL004",
+                        f,
+                        node,
+                        "unseeded (or clock-seeded) jax.random.PRNGKey",
+                        "derive keys from one experiment-level seed via "
+                        "jax.random.split / fold_in",
+                    )
+
+
+# --------------------------------------------------------------- GL005
+def check_gl005(ctx: Context):
+    fix = (
+        "route the fetch through magicsoup_tpu.util.fetch_host — the one "
+        "audited device->host point (explicit jax.device_get, allowed "
+        "under transfer guards)"
+    )
+    for f in ctx.files:
+        if f.rel.rsplit("/", 1)[-1] == "util.py":
+            continue  # fetch_host lives here: the sanctioned boundary
+        for key, rec in ctx.graph.functions.items():
+            if rec.file is not f or key in ctx.hot:
+                continue  # hot functions are GL001's domain
+            tainted = device_tainted_names(rec.node)
+            for node in ast.walk(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "device_get"
+                    and _root_name(fn) in JAX_ROOTS
+                ) or (isinstance(fn, ast.Name) and fn.id == "device_get"):
+                    yield _finding(
+                        "GL005",
+                        f,
+                        node,
+                        f"`jax.device_get` in `{rec.qualname}` bypasses the "
+                        "sanctioned boundary",
+                        fix,
+                    )
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in ("asarray", "array")
+                    and _root_name(fn) in NUMPY_ROOTS
+                    and node.args
+                    and expr_is_device(node.args[0], tainted)
+                ):
+                    yield _finding(
+                        "GL005",
+                        f,
+                        node,
+                        f"`np.{fn.attr}()` on a device value in "
+                        f"`{rec.qualname}` is an implicit blocking transfer",
+                        fix,
+                    )
+
+
+CHECKERS = {
+    "GL001": check_gl001,
+    "GL002": check_gl002,
+    "GL003": check_gl003,
+    "GL004": check_gl004,
+    "GL005": check_gl005,
+}
+
+
+def checkers(only: list[str] | None = None):
+    if not only:
+        return dict(CHECKERS)
+    wanted = {c.strip().upper() for c in only}
+    unknown = wanted - CHECKERS.keys()
+    if unknown:
+        raise SystemExit(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}")
+    return {c: fn for c, fn in CHECKERS.items() if c in wanted}

@@ -1,0 +1,104 @@
+"""
+Runtime half of graftlint: turn the static invariants into test-time
+assertions.
+
+- :func:`hot_path_guard` — wraps a hot-path window in
+  ``jax.transfer_guard("disallow")`` (implicit host<->device transfers
+  raise) AND pins a compilation-count budget for the window, so a PR
+  that introduces a per-step retrace or an implicit sync fails the
+  suite instead of shipping a 10-1000x slowdown to TPU.
+- :func:`compile_count` — process-wide count of traced program variants,
+  fed by a ``jax.monitoring`` listener on the jaxpr-trace event.  The
+  trace event (unlike backend-compile time) fires for cache MISSES of
+  the in-process jit cache regardless of the persistent compilation
+  cache's state, so budgets hold on both cold and warm CI runs.
+- :func:`sanctioned_transfer` — the explicit D2H spelling that stays
+  legal under ``transfer_guard("disallow")`` (explicit transfers are
+  exempt by JAX's design; the guard exists to catch *implicit* ones).
+
+Caveat for CPU-backed tests: with everything on one host, a
+device->host "transfer" is a no-op and the D2H side of the guard cannot
+fire — but the implicit HOST->DEVICE side still does (e.g. a Python
+scalar silently promoted per step), and the compile budget is fully
+backend-independent.  The static rules (GL001/GL005) cover the D2H
+direction at review time; on real TPU runs the guard covers both.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_COMPILE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_lock = threading.Lock()
+_count = 0
+_installed = False
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    global _count
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _count += 1
+
+
+def install() -> None:
+    """Register the compile listener (idempotent; process-global)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_listener)
+
+
+def compile_count() -> int:
+    """Traced-program variants compiled so far in this process."""
+    install()
+    with _lock:
+        return _count
+
+
+class GuardStats:
+    """Filled in when the guard window closes."""
+
+    def __init__(self) -> None:
+        self.compiles: int | None = None
+
+
+class CompileBudgetExceeded(AssertionError):
+    pass
+
+
+@contextlib.contextmanager
+def hot_path_guard(compile_budget: int = 0, transfers: str = "disallow"):
+    """Guard a hot-path window: no implicit transfers, at most
+    ``compile_budget`` new program compilations.
+
+    Budget choice: warm the functions under test FIRST (run one step of
+    every variant the window will use), then wrap the steady-state loop
+    with ``compile_budget=0`` — the steady state of a well-formed step
+    loop compiles nothing.  A window that legitimately compiles (e.g. a
+    capacity regrow) gets exactly that many, pinned, so growth is a
+    reviewed decision rather than silent churn.
+    """
+    install()
+    stats = GuardStats()
+    start = compile_count()
+    with jax.transfer_guard(transfers):
+        yield stats
+    stats.compiles = compile_count() - start
+    if stats.compiles > compile_budget:
+        raise CompileBudgetExceeded(
+            f"hot-path window compiled {stats.compiles} program(s), "
+            f"budget is {compile_budget} — something in the loop is "
+            "retracing (new shapes/dtypes/static args?) or was not warmed"
+        )
+
+
+def sanctioned_transfer(arr):
+    """Explicit device->host fetch; allowed under transfer guards."""
+    return jax.device_get(arr)

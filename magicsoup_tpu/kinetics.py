@@ -92,7 +92,7 @@ class _LogNormWeightMapFact:
         nprng = _token_rng(rng)
         # vectorized rejection: redraw the whole remainder until full
         # (the acceptance rate is ~2/3, so this converges in a few rounds)
-        vals = np.empty(max_token, dtype=np.float64)
+        vals = np.empty(max_token, dtype=np.float64)  # graftlint: disable=GL003 host token-table precompute, downcast before device
         n_ok = 0
         while n_ok < max_token:
             draw = np.exp(nprng.normal(mu, sig, size=max_token - n_ok))
@@ -434,6 +434,8 @@ class Kinetics:
         kwargs = {}
         if self.cell_sharding is not None:
             kwargs["out_shardings"] = CellParams(*([self.cell_sharding] * 9))
+        # capacity regrow runs once per capacity step (capacity never
+        # shrinks), not once per simulation step — graftlint: disable=GL002
         self.params = jax.jit(_grow, **kwargs)(old)
         self.max_cells = c
         self.max_proteins = p

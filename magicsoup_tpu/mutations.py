@@ -35,7 +35,7 @@ def point_mutations(
         without any mutation are not returned.
     """
     if seed is None:
-        seed = random.SystemRandom().randrange(2**63)
+        seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
     return _engine.point_mutations(seqs, p=p, p_indel=p_indel, p_del=p_del, seed=seed)
 
 
@@ -58,5 +58,5 @@ def recombinations(
         pairs without any strand break are not returned.
     """
     if seed is None:
-        seed = random.SystemRandom().randrange(2**63)
+        seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
     return _engine.recombinations(seq_pairs, p=p, seed=seed)

@@ -39,6 +39,11 @@ dtype conversions.  Everything here is built only from that set:
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+# jax >= 0.4.26 removed the jax.enable_x64 alias; the experimental
+# context manager is the stable spelling of the same x64 scope
+from jax.experimental import enable_x64 as _enable_x64
 
 _LOG2E = 1.4426950408889634
 # Taylor coefficients of 2^f = exp(f ln2) on f in [-0.5, 0.5]
@@ -59,6 +64,50 @@ _F32_MIN_NORMAL = 1.17549435e-38
 def _f64(x: jax.Array) -> jax.Array:
     """Convert to float64 (requires the enclosing x64 context)."""
     return x.astype(jnp.float64)
+
+
+def traced_zeros32(t: jax.Array) -> jax.Array:
+    """
+    A TRACED float32 zero array shaped like ``t`` (a tracer).
+
+    The ``enable_x64`` scopes here only cover TRACING; jit lowers the
+    jaxpr later, after the scope has exited, and with x64 globally off
+    the lowering canonicalizes EVERY f64 (and i64) literal in the
+    program to 32 bits — failing the StableHLO verifier against the f64
+    avals the trace produced.  Wide constants therefore must be BUILT by
+    traced ops whose jaxpr literals are all 32-bit, and traced ops need
+    a tracer operand (ops on concrete values execute eagerly and
+    collapse back into a wide literal).  This zero is that anchor: the
+    bit pattern is integer-masked (exact even for inf/NaN inputs, unlike
+    multiplying by zero), and XLA folds the whole ladder at compile
+    time, so the runtime cost is nil.
+    """
+    bits = jax.lax.bitcast_convert_type(t.astype(jnp.float32), jnp.int32)
+    return jax.lax.bitcast_convert_type(bits & jnp.int32(0), jnp.float32)
+
+
+def _c64(value: float, zero32: jax.Array) -> jax.Array:
+    """A traced float64 constant broadcast over ``zero32``'s shape (a
+    traced f32 zero from :func:`traced_zeros32`).  Three f32 pieces
+    (hi + mid + lo, each holding the next 24 bits) are added to the
+    traced zero and converted, so every jaxpr literal stays f32; the
+    converted pieces reconstruct any normal f64 exactly — the first f64
+    sum is exact (<= 49 significant bits) and the second rounds back to
+    the original value (error < 2^-73 relative)."""
+    v = np.float64(value)
+    hi = np.float32(v)
+    mid = np.float32(v - np.float64(hi))
+    lo = np.float32(v - np.float64(hi) - np.float64(mid))
+    out = (zero32 + jnp.float32(hi)).astype(jnp.float64)
+    out = out + (zero32 + jnp.float32(mid)).astype(jnp.float64)
+    return out + (zero32 + jnp.float32(lo)).astype(jnp.float64)
+
+
+def _ci64(value: int, zero_i32: jax.Array) -> jax.Array:
+    """A traced int64 constant broadcast over ``zero_i32``'s shape: an
+    i32 literal added to a traced i32 zero, then a traced convert (see
+    traced_zeros32 for why the literal must stay 32-bit)."""
+    return (zero_i32 + jnp.int32(value)).astype(jnp.int64)
 
 
 def ipow(x: jax.Array, n: jax.Array, nonneg: bool = False) -> jax.Array:
@@ -106,23 +155,33 @@ def det_exp(x: jax.Array) -> jax.Array:
     Returns float32; accuracy ~1 ULP vs libm, saturating to 0/inf exactly
     where float32 ``np.exp`` does.
     """
-    with jax.enable_x64(True):
+    with _enable_x64(True):
+        z32 = traced_zeros32(x)
+        zi32 = jax.lax.bitcast_convert_type(z32, jnp.int32)
         x64 = _f64(x)
-        y = x64 * _LOG2E
+        y = x64 * _c64(_LOG2E, z32)
         k = jnp.round(y)
         f = y - k
 
-        p = jnp.full_like(f, _EXP2_COEFFS[-1])
+        p = _c64(_EXP2_COEFFS[-1], z32)
         for c in _EXP2_COEFFS[-2::-1]:
-            p = p * f + c
+            p = p * f + _c64(c, z32)
 
         # 2^k via f64 exponent-field assembly (one factor covers the
         # whole f64 range; overflow/underflow happens at the final f32
-        # downcast, exactly like np.exp on float32)
-        # (NaN -> 0 first: NaN-to-int conversion is backend-defined)
-        k = jnp.clip(jnp.nan_to_num(k), -1022.0, 1023.0).astype(jnp.int64)
+        # downcast, exactly like np.exp on float32).  The clamp runs in
+        # f32 — k is already integral and the post-clip range [-1022,
+        # 1023] is f32-exact, while out-of-range |k| only saturates
+        # harder (f32 overflow -> inf -> clip limit, same result).
+        # (NaN -> 0 first: NaN-to-int conversion is backend-defined;
+        # strong f32 scalars throughout — a bare Python float is WEAK
+        # f64 under the x64 trace and trips the same lowering mismatch)
+        k32 = k.astype(jnp.float32)
+        k32 = jnp.where(jnp.isnan(k32), jnp.float32(0.0), k32)
+        k32 = jnp.clip(k32, jnp.float32(-1022.0), jnp.float32(1023.0))
+        ki = k32.astype(jnp.int64)
         scale = jax.lax.bitcast_convert_type(
-            (k + 1023) << 52, jnp.float64
+            (ki + _ci64(1023, zi32)) << _ci64(52, zi32), jnp.float64
         )
         out = (p * scale).astype(jnp.float32)
     # ±inf inputs: f = inf - inf = NaN poisons the polynomial; restore the
@@ -163,14 +222,18 @@ def det_div(a: jax.Array, b: jax.Array) -> jax.Array:
         - jax.lax.bitcast_convert_type(m, jnp.int32),
         jnp.float32,
     )
-    with jax.enable_x64(True):
+    with _enable_x64(True):
+        z32 = traced_zeros32(m)
+        zi32 = jax.lax.bitcast_convert_type(z32, jnp.int32)
         m64 = _f64(m)
         r = _f64(seed)
+        two = _c64(2.0, z32)
         for _ in range(4):
-            r = r * (2.0 - m64 * r)  # f64 Newton: deterministic fused
+            r = r * (two - m64 * r)  # f64 Newton: deterministic fused
         # 1/bn = (1/m) * 2^-e; scale by exact f64 exponent assembly
         scale = jax.lax.bitcast_convert_type(
-            (jnp.int64(1023) - e.astype(jnp.int64)) << 52, jnp.float64
+            (_ci64(1023, zi32) - e.astype(jnp.int64)) << _ci64(52, zi32),
+            jnp.float64,
         )
         q = (_f64(a) * (r * scale)).astype(jnp.float32)
     q = jnp.where(jnp.signbit(b), -q, q)
@@ -213,7 +276,19 @@ def sum_axis(x: jax.Array, axis: int) -> jax.Array:
     multiply/add is itself deterministic on both backends.  Returns the
     input dtype.
     """
-    with jax.enable_x64(True):
+    # pad to the tree's power-of-two width BEFORE the f64 up-conversion:
+    # tree_reduce's pad constant would otherwise be a float64 literal,
+    # which jit canonicalizes to f32 at lowering time (the x64 scope only
+    # covers tracing — see traced_zeros32); padding the f32 input with an
+    # f32 zero is exact and leaves tree_reduce nothing to pad
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    p = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    if p != n:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, p - n)
+        x = jnp.pad(x, pad, constant_values=0.0)
+    with _enable_x64(True):
         out = tree_reduce(_f64(x), axis, jnp.add, 0.0)
         return out.astype(x.dtype)
 

@@ -18,9 +18,13 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+# jax >= 0.4.26 removed the jax.enable_x64 alias; the experimental
+# context manager is the stable spelling of the same x64 scope
+from jax.experimental import enable_x64 as _enable_x64
 import numpy as np
 
-from magicsoup_tpu.ops.detmath import det_div, sum_hw
+from magicsoup_tpu.ops.detmath import det_div, sum_hw, traced_zeros32
 
 
 def diffusion_kernels(diffusivities: list[float]) -> np.ndarray:
@@ -49,9 +53,10 @@ def permeation_factors(permeabilities: list[float]) -> np.ndarray:
 
 def degradation_factors(half_lives: list[float]) -> np.ndarray:
     """(n_mols,) per-step decay factors exp(-ln2 / half_life)"""
-    return np.exp(-np.log(2.0) / np.array(half_lives, dtype=np.float64)).astype(
-        np.float32
-    )
+    return np.exp(
+        # host-side precompute in f64 for accuracy, downcast before device
+        -np.log(2.0) / np.array(half_lives, dtype=np.float64)  # graftlint: disable=GL003
+    ).astype(np.float32)
 
 
 def stencil_3x3(map_: jax.Array, kernels: jax.Array) -> jax.Array:
@@ -61,7 +66,10 @@ def stencil_3x3(map_: jax.Array, kernels: jax.Array) -> jax.Array:
     parallel/tiled.py; the order is load-bearing for det/fast and
     sharded/unsharded agreement, so it must not drift between copies.
     Correlation semantics: out[x,y] += k[i,j] * map[x+i-1, y+j-1]."""
-    out = jnp.zeros_like(map_)
+    # TRACED zeros: in det mode map_ is float64, and a float64 zero
+    # literal would be canonicalized to f32 when jit lowers the program
+    # outside the x64 scope (see detmath.traced_zeros32)
+    out = traced_zeros32(map_).astype(map_.dtype)
     for i in range(3):
         for j in range(3):
             out = out + kernels[:, i, j][:, None, None] * jnp.roll(
@@ -95,8 +103,9 @@ def diffuse(
     # ~1e-5 rel
     total_before = sum_hw(molecule_map)  # (mols,)
     if det:
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             out = stencil_3x3(
+                # graftlint: disable=GL003 sanctioned det-mode f64 accumulation (BITREPRO.md)
                 molecule_map.astype(jnp.float64), kernels.astype(jnp.float64)
             ).astype(jnp.float32)
         total_after = sum_hw(out)
@@ -122,10 +131,11 @@ def permeate(
     computes in float64: the exchange products feed adds/subs, which f32
     would FMA-contract backend-dependently."""
     if det:
-        with jax.enable_x64(True):
-            cm = cell_molecules.astype(jnp.float64)
-            ext = ext_molecules.astype(jnp.float64)
-            fac = factors.astype(jnp.float64)
+        with _enable_x64(True):
+            # sanctioned det-mode f64 (BITREPRO.md)
+            cm = cell_molecules.astype(jnp.float64)  # graftlint: disable=GL003
+            ext = ext_molecules.astype(jnp.float64)  # graftlint: disable=GL003
+            fac = factors.astype(jnp.float64)  # graftlint: disable=GL003
             d_int = cm * fac
             d_ext = ext * fac
             return (

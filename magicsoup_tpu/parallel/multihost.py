@@ -45,6 +45,15 @@ def initialize(
     CPU-emulation test) pass them explicitly.  Must be called before the
     first JAX computation.
     """
+    # read the PIN, not jax.default_backend() — the latter would
+    # initialize the backend before the distributed runtime exists
+    platforms = getattr(jax.config, "jax_platforms", None) or ""
+    if "cpu" in platforms.split(","):
+        # the CPU backend has no cross-process collectives by default
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); the gloo TCP implementation gives the CPU-emulation
+        # path the same SPMD semantics a pod's DCN collectives have
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

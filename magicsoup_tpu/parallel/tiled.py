@@ -35,9 +35,16 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+# jax 0.4.x ships shard_map under jax.experimental; the top-level alias
+# only exists in newer releases
+try:  # pragma: no cover - version-dependent import
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+from jax.experimental import enable_x64 as _enable_x64
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from magicsoup_tpu.ops import detmath as _det
 from magicsoup_tpu.ops import diffusion as _diff
 from magicsoup_tpu.ops.integrate import CellParams, integrate_signals
 
@@ -106,9 +113,12 @@ def halo_diffuse(
         rows = jnp.concatenate([halo_for_below, local, halo_for_above], axis=1)
 
         def stencil(rows_, kern_):
-            out_ = jnp.zeros(
-                (local.shape[0], n_local, local.shape[2]), dtype=rows_.dtype
-            )
+            # TRACED zeros: a float64 zero literal would be canonicalized
+            # to f32 at lowering time in det mode (the x64 scope only
+            # covers tracing — see detmath.traced_zeros32)
+            out_ = _det.traced_zeros32(
+                rows_[:, :n_local, :]
+            ).astype(rows_.dtype)
             for i in range(3):
                 for j in range(3):
                     shifted = jnp.roll(
@@ -134,8 +144,9 @@ def halo_diffuse(
             # f64 accumulation + fixed trees + soft division, matching
             # the single-device deterministic stencil
             total_before = det_total(local)
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 out = stencil(
+                    # graftlint: disable=GL003 sanctioned det-mode f64 (BITREPRO.md)
                     rows.astype(jnp.float64), kern.astype(jnp.float64)
                 ).astype(jnp.float32)
             total_after = det_total(out)

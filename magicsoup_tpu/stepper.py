@@ -524,7 +524,9 @@ class _Fetcher(_Worker):
     def submit(self, arr):
         from functools import partial
 
-        return super().submit(partial(np.asarray, arr))
+        # through the sanctioned explicit-transfer boundary (GL005):
+        # survives jax.transfer_guard("disallow") in guarded test runs
+        return super().submit(partial(_fetch_host, arr))
 
 
 class _LazyFetch:
@@ -544,7 +546,7 @@ class _LazyFetch:
             return True
 
     def result(self, timeout=None):
-        return np.asarray(self._arr)
+        return _fetch_host(self._arr)
 
 
 class _Pending(NamedTuple):

@@ -373,7 +373,7 @@ class World:
         use_pallas: bool | None = None,
     ):
         if seed is None:
-            seed = random.SystemRandom().randrange(2**63)
+            seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
         self.seed = seed
         self._rng = random.Random(seed)
         self._nprng = np.random.default_rng(seed)

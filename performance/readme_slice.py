@@ -38,8 +38,23 @@ def main() -> None:
     from bench import _acquire_accel_lock, _setup_compile_cache
 
     # accelerator runs serialize on the shared flock like every other
-    # harness; cpu runs skip it (held for process lifetime when taken)
-    _lock = _acquire_accel_lock(max_wait_s=600.0, platform=args.platform)
+    # harness; cpu runs skip it (held for process lifetime when taken).
+    # Contention is reported as the same parseable JSON error line the
+    # other harnesses emit, so a capture driver sees a structured verdict
+    # instead of a traceback
+    try:
+        _lock = _acquire_accel_lock(max_wait_s=600.0, platform=args.platform)
+    except TimeoutError as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "README slice steps/sec",
+                    "error": f"accelerator lock contention: {exc}",
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(1)
     _setup_compile_cache(jax)
 
     import numpy as np

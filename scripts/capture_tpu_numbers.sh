@@ -62,13 +62,12 @@ LOCK="${MAGICSOUP_BENCH_LOCK_PATH:-/tmp/magicsoup_tpu_accel.lock}"
 run() {
     name="$1"; to="$2"; shift 2
     echo "== $name (<=${to}s): $*" | tee -a "$OUT/capture.log"
-    case "$*" in
-        # match " bench.py" with the leading space: a bare *bench.py*
-        # would also catch performance/integrator_bench.py and leave it
-        # running unlocked
-        *" bench.py"*) timeout "$to" "$@" >"$OUT/$name.log" 2>&1 ;;
-        *) timeout "$to" flock -w 300 "$LOCK" "$@" >"$OUT/$name.log" 2>&1 ;;
-    esac
+    # every harness serializes on the one flock; MAGICSOUP_BENCH_LOCK_HELD
+    # tells bench.py's own _acquire_accel_lock the lock is already held
+    # around it (no self-deadlock, and no fragile command-string matching
+    # to decide which harnesses lock themselves)
+    timeout "$to" flock -w 300 "$LOCK" \
+        env MAGICSOUP_BENCH_LOCK_HELD=1 "$@" >"$OUT/$name.log" 2>&1
     rc=$?
     echo "rc=$rc (tail)" | tee -a "$OUT/capture.log"
     tail -5 "$OUT/$name.log" | tee -a "$OUT/capture.log"

@@ -17,6 +17,7 @@ record lives next to the target it is judged against.
 """
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -141,7 +142,11 @@ def publish(summary: dict) -> None:
             }
             merged = True
     if merged:
-        baseline_path.write_text(json.dumps(baseline, indent=2) + "\n")
+        # atomic publish: write-then-rename so a crash (or two capture
+        # windows racing) can never leave BASELINE.json truncated
+        tmp_path = baseline_path.with_suffix(".json.tmp")
+        tmp_path.write_text(json.dumps(baseline, indent=2) + "\n")
+        os.replace(tmp_path, baseline_path)
         print(f"published -> {baseline_path}", file=sys.stderr)
     else:
         print("nothing publishable in this capture", file=sys.stderr)

@@ -4,4 +4,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 TARGET="${1:-tests/fast}"
+# graftlint gate first: the static analyzer is cheap (stdlib AST, no jax
+# import) and a hot-path violation should fail before the suite spends
+# minutes compiling
+python -m magicsoup_tpu.analysis --check
 python -m pytest "$TARGET" -q

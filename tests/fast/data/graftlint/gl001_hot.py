@@ -1,0 +1,8 @@
+"""GL001 fixture: a blocking host sync inside a hot-marked function."""
+import jax.numpy as jnp
+
+
+# graftlint: hot
+def hot_loop(state):
+    total = jnp.sum(state)
+    return total.item()  # GL001: .item() blocks the step loop

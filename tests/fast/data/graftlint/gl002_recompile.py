@@ -1,0 +1,7 @@
+"""GL002 fixture: a jit wrapper constructed per call."""
+import jax
+
+
+def per_call(fn, x):
+    wrapped = jax.jit(fn)  # GL002: fresh wrapper -> retrace every call
+    return wrapped(x)

@@ -1,0 +1,6 @@
+"""GL003 fixture: float64 outside ops/detmath.py."""
+import numpy as np
+
+
+def widen(x):
+    return np.asarray(x, dtype=np.float64)  # GL003: f64 outside detmath

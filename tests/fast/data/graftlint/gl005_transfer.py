@@ -1,0 +1,6 @@
+"""GL005 fixture: a D2H transfer outside the sanctioned boundary."""
+import jax
+
+
+def pull(arr):
+    return jax.device_get(arr)  # GL005: bypasses util.fetch_host

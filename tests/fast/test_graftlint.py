@@ -1,0 +1,253 @@
+"""
+Tests for the graftlint static analyzer (:mod:`magicsoup_tpu.analysis`)
+and its runtime guard half.
+
+Static side: every rule has a one-violation fixture under
+``tests/fast/data/graftlint/`` that must be detected at the marked line,
+suppression comments must silence findings, and — the real contract —
+the library tree at HEAD must lint clean.  The stepper-injection test
+closes the loop the linter exists for: deliberately adding a ``.item()``
+to the step dispatch makes the suite fail.
+
+Runtime side: the compile-count budget and transfer guard around a
+warmed :class:`PipelinedStepper` steady-state loop (the window that must
+never retrace or transfer implicitly).
+"""
+import json
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from magicsoup_tpu.analysis import analyze
+from magicsoup_tpu.analysis import engine as lint_engine
+from magicsoup_tpu.analysis import runtime as lint_rt
+from magicsoup_tpu.analysis.rules import RULE_INFO
+
+FIXTURES = Path(__file__).parent / "data" / "graftlint"
+PKG = Path(lint_engine.default_target())
+ALL_RULES = sorted(RULE_INFO)
+
+
+def marked_line(path: Path, code: str) -> int:
+    """1-based line of the fixture's `# GLxxx:` violation marker."""
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if f"# {code}:" in line:
+            return i
+    raise AssertionError(f"no # {code}: marker in {path}")
+
+
+# ------------------------------------------------------------- static
+@pytest.mark.parametrize(
+    "fixture, code",
+    [
+        ("gl001_hot.py", "GL001"),
+        ("gl002_recompile.py", "GL002"),
+        ("gl003_dtype.py", "GL003"),
+        ("gl004_nondet.py", "GL004"),
+        ("gl005_transfer.py", "GL005"),
+    ],
+)
+def test_rule_detects_fixture_violation(fixture, code):
+    path = FIXTURES / fixture
+    findings = analyze([path])
+    assert [f.rule for f in findings] == [code]
+    (f,) = findings
+    assert f.line == marked_line(path, code)
+    assert f.name == RULE_INFO[code][0]
+    assert f.fixit  # every finding carries an actionable fix-it
+    assert f"{f.path}:{f.line}" in f.format()
+
+
+def test_suppression_comment_silences_finding():
+    # same violation as gl004_nondet.py, annotated inline -> no findings
+    assert analyze([FIXTURES / "suppressed.py"]) == []
+
+
+def test_clean_fixture_has_no_findings():
+    assert analyze([FIXTURES / "clean.py"]) == []
+
+
+def test_rules_filter_restricts_rule_set():
+    findings = analyze([FIXTURES], rules=["GL004"])
+    assert findings and all(f.rule == "GL004" for f in findings)
+    # suppressed.py's annotated call must stay silent even when targeted
+    assert all("suppressed" not in f.path for f in findings)
+
+
+def test_library_tree_lints_clean():
+    # THE gate: the shipped baseline is empty, so any finding in the
+    # package is a regression (or needs an inline annotation a reviewer
+    # will see)
+    assert analyze([PKG]) == []
+
+
+def test_baseline_tolerates_counted_findings():
+    findings = analyze([FIXTURES / "gl004_nondet.py"])
+    assert len(findings) == 1
+    key = findings[0].key
+    assert lint_engine.apply_baseline(findings, {key: 1}) == []
+    assert lint_engine.apply_baseline(findings, {key: 0}) == findings
+    # shipped baseline is empty by policy
+    assert lint_engine.load_baseline() == {}
+
+
+def test_item_injection_into_stepper_fails_lint(tmp_path):
+    # the acceptance loop: a deliberate .item() in the step dispatch of
+    # a copy of the REAL stepper source must be flagged as GL001 (hot
+    # seeds are keyed by basename, so the copy stays hot)
+    src = (PKG / "stepper.py").read_text()
+    marker = "    def step(self) -> None:"
+    assert marker in src
+    lines = src.splitlines(keepends=True)
+    at = next(i for i, l in enumerate(lines) if l.startswith(marker))
+    lines.insert(at + 1, "        _ = self._state.n_rows.item()\n")
+    bad = tmp_path / "stepper.py"
+    bad.write_text("".join(lines))
+
+    findings = analyze([bad])
+    gl001 = [f for f in findings if f.rule == "GL001"]
+    assert len(gl001) == 1
+    assert gl001[0].line == at + 2  # 1-based line of the injected sync
+    assert "item" in gl001[0].message
+
+    # control: the unmodified copy lints clean
+    good = tmp_path / "control" / "stepper.py"
+    good.parent.mkdir()
+    good.write_text(src)
+    assert analyze([good]) == []
+
+
+# ---------------------------------------------------------------- CLI
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "magicsoup_tpu.analysis", *argv],
+        capture_output=True,
+        text=True,
+        cwd=Path(__file__).resolve().parents[2],
+    )
+
+
+def test_cli_check_flags_fixtures_with_code_and_location():
+    res = run_cli("--check", str(FIXTURES))
+    assert res.returncode == 1
+    for code in ALL_RULES:
+        assert code in res.stdout
+    # file:line anchors for each rule fixture
+    for fixture, code in [("gl001_hot.py", "GL001"), ("gl004_nondet.py", "GL004")]:
+        line = marked_line(FIXTURES / fixture, code)
+        assert f"{fixture}:{line}:" in res.stdout
+
+
+def test_cli_check_exits_zero_on_clean_tree():
+    res = run_cli("--check")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 finding(s)" in res.stdout
+
+
+def test_cli_json_output_is_machine_readable():
+    res = run_cli("--json", str(FIXTURES / "gl002_recompile.py"))
+    findings = json.loads(res.stdout)
+    assert [f["rule"] for f in findings] == ["GL002"]
+    assert findings[0]["fixit"]
+
+
+def test_cli_list_rules_and_unknown_rule():
+    res = run_cli("--list-rules")
+    assert res.returncode == 0
+    for code in ALL_RULES:
+        assert code in res.stdout
+    bad = run_cli("--rules", "GL999", str(FIXTURES))
+    assert bad.returncode != 0
+    assert "GL999" in bad.stderr + bad.stdout
+
+
+# ------------------------------------------------------------ runtime
+def test_compile_budget_exceeded_raises():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(4)  # built OUTSIDE the guard (implicit H2D)
+    with pytest.raises(lint_rt.CompileBudgetExceeded, match="budget"):
+        with lint_rt.hot_path_guard(compile_budget=0):
+            jax.jit(lambda v: v * 3 + 1)(x).block_until_ready()
+
+
+def test_warmed_window_compiles_nothing():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v * 5 - 2)
+    x = jnp.ones(8)
+    f(x).block_until_ready()  # warm
+    with lint_rt.hot_path_guard(compile_budget=0) as stats:
+        f(x).block_until_ready()
+    assert stats.compiles == 0
+
+
+def test_transfer_guard_blocks_implicit_h2d():
+    import jax.numpy as jnp
+
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with lint_rt.hot_path_guard(compile_budget=10):
+            # a Python-scalar promotion is an implicit host->device
+            # transfer — exactly the per-step leak the guard exists for
+            jnp.ones(4).block_until_ready()
+
+
+def test_sanctioned_transfer_allowed_under_guard():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magicsoup_tpu.util import fetch_host
+
+    x = jax.jit(lambda v: v + 2)(jnp.zeros(3))
+    x.block_until_ready()
+    with lint_rt.hot_path_guard(compile_budget=0):
+        host = fetch_host(x)
+        host2 = lint_rt.sanctioned_transfer(x)
+    assert isinstance(host, np.ndarray)
+    np.testing.assert_array_equal(host, host2)
+
+
+def test_stepper_steady_state_under_hot_path_guard():
+    # the flagship runtime contract: after warmup, the pipelined step
+    # loop in steady state (no deaths, divisions, spawns, or mutations)
+    # dispatches with ZERO new compilations and ZERO implicit transfers
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.stepper import PipelinedStepper
+
+    mols = [
+        ms.Molecule("gd-a", 10e3),
+        ms.Molecule("gd-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(11)
+    world = ms.World(chemistry=chem, map_size=32, seed=11)
+    world.spawn_cells([ms.random_genome(s=250, rng=rng) for _ in range(40)])
+
+    st = PipelinedStepper(
+        world,
+        mol_name="gd-atp",
+        kill_below=-1.0,  # nothing dies
+        divide_above=1e30,  # nothing divides
+        divide_cost=0.0,
+        target_cells=None,  # nothing spawns
+        genome_size=250,
+        lag=2,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+    for _ in range(8):  # warm every variant the window will use
+        st.step()
+    st.drain()
+
+    with lint_rt.hot_path_guard(compile_budget=0) as stats:
+        for _ in range(5):
+            st.step()
+        st.drain()
+    assert stats.compiles == 0
+    st.flush()

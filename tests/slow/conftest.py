@@ -1,0 +1,16 @@
+"""Everything under tests/slow/ carries the ``slow`` marker by
+directory, so `-m 'not slow'` (the fast/CI tier) and the README's
+two-tier contract (`tests/fast` vs all of `tests/`) cannot drift from
+where a test file actually lives."""
+from pathlib import Path
+
+import pytest
+
+_HERE = Path(__file__).resolve().parent
+
+
+def pytest_collection_modifyitems(items):
+    # the hook sees the WHOLE session's items, not just this directory's
+    for item in items:
+        if _HERE in Path(str(item.fspath)).resolve().parents:
+            item.add_marker(pytest.mark.slow)
