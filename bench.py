@@ -189,6 +189,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help="pipeline depth for the pipelined driver: 'auto' or an int",
     )
     ap.add_argument(
+        "--megastep",
+        type=int,
+        default=1,
+        help="fused device steps per dispatch (K) for the pipelined "
+        "driver; spawn/selection replay granularity becomes K steps "
+        "and the host lag is lag x K steps",
+    )
+    ap.add_argument(
         "--_child",
         action="store_true",
         help=argparse.SUPPRESS,  # internal: actually run the measurement
@@ -199,10 +207,14 @@ def _build_parser() -> argparse.ArgumentParser:
 def _setup_compile_cache(jax) -> None:
     """Persistent compile cache: pad-size variants recompile across
     invocations otherwise (expensive through a remote compile service).
-    Shared with performance/profile_step.py."""
-    jax.config.update("jax_compilation_cache_dir", "/tmp/magicsoup_jax_cache")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    Delegates to the library helper (magicsoup_tpu/cache.py) so bench,
+    performance harnesses, the stepper's warm scheduler, and tests all
+    share one env-overridable cache location; the ``jax`` parameter is
+    kept for import compatibility."""
+    del jax  # the helper imports jax itself (lazily)
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
 
 
 def _child_main(args: argparse.Namespace) -> None:
@@ -356,6 +368,7 @@ def _child_main(args: argparse.Namespace) -> None:
             target_cells=args.n_cells,
             genome_size=args.genome_size,
             lag="auto" if args.lag == "auto" else int(args.lag),
+            megastep=args.megastep,
         )
         for _ in range(max(args.warmup, 3)):
             st.step()
@@ -367,12 +380,15 @@ def _child_main(args: argparse.Namespace) -> None:
         for _ in range(n_pipe):
             st.step()
         st.drain()  # all outputs arrived + replayed
-        dt_pipe = (time.perf_counter() - t0) / n_pipe
+        # each dispatch is args.megastep fused device steps — normalize
+        # to SIMULATION steps so K>1 numbers compare against K=1 directly
+        dt_pipe = (time.perf_counter() - t0) / (n_pipe * args.megastep)
         trace = list(st.trace)
         st.flush()
         extra = {
             "classic_steps_per_s": round(1.0 / dt, 4),
             "pipelined_steps_per_s": round(1.0 / dt_pipe, 4),
+            "megastep": args.megastep,
             "pipeline_stats": {
                 k: int(v) for k, v in st.stats.items()
             },

@@ -32,6 +32,7 @@ HOT_SEEDS: dict[str, tuple[str, ...]] = {
         "PipelinedStepper.drain",
     ),
     "world.py": (
+        "World.step_many",
         "World.spawn_cells",
         "World.add_cells",
         "World.divide_cells",

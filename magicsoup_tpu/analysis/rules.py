@@ -9,6 +9,7 @@ Findings; registration at the bottom.
 | GL003 | dtype-discipline     | BITREPRO.md float32 contract               |
 | GL004 | nondeterminism       | seeded reproducibility                     |
 | GL005 | blocking-transfer    | the single audited D2H boundary            |
+| GL006 | missing-donation     | steady-state HBM (step buffers donated)    |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -91,6 +92,12 @@ RULE_INFO = {
         "blocking-transfer",
         "device->host transfer outside the sanctioned util.fetch_host "
         "boundary",
+    ),
+    "GL006": (
+        "missing-donation",
+        "jit over a DeviceState argument without donate_argnums — the "
+        "step returns the successor state, so an undonated input keeps "
+        "TWO copies of the world tensors live in HBM",
     ),
 }
 
@@ -584,12 +591,102 @@ def check_gl005(ctx: Context):
                     )
 
 
+# --------------------------------------------------------------- GL006
+def _jit_wrapper_kwargs(call: ast.Call) -> dict | None:
+    """Keyword args of a jit-wrapper construction — ``jax.jit(...)``
+    directly or ``(functools.)partial(jax.jit, ...)`` — else None."""
+    if _is_jit_ctor(call.func) or any(_is_jit_ctor(a) for a in call.args):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def check_gl006(ctx: Context):
+    """Step-level jits over a ``DeviceState`` must donate it: the step
+    consumes the state and returns its successor, so without
+    ``donate_argnums`` XLA keeps BOTH generations of every world tensor
+    live (the exact double-buffering the stepper exists to avoid).
+    Covers the decorator spellings (``@jax.jit``,
+    ``@partial(jax.jit, ...)``) and the assignment spelling
+    (``name = partial(jax.jit, ...)(fn)``)."""
+    fix = (
+        "add donate_argnums covering the DeviceState parameter (its "
+        "successor is returned, so the buffer can be reused in place); "
+        "annotate intentionally double-buffered programs with "
+        "`# graftlint: disable=GL006`"
+    )
+    for f in ctx.files:
+        fns_by_name = {
+            rec.qualname: rec.node
+            for rec in ctx.graph.functions.values()
+            if rec.file is f
+        }
+        # (wrapped function def, node to report, wrapper kwargs)
+        wrappers: list[tuple] = []
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        kwargs = _jit_wrapper_kwargs(dec)
+                        if kwargs is not None:
+                            wrappers.append((node, dec, kwargs))
+                    elif _is_jit_ctor(dec):  # bare @jax.jit
+                        wrappers.append((node, dec, {}))
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Call
+            ):
+                # partial(jax.jit, ...)(fn) as an expression
+                kwargs = _jit_wrapper_kwargs(node.func)
+                if (
+                    kwargs is not None
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in fns_by_name
+                ):
+                    wrappers.append((fns_by_name[node.args[0].id], node, kwargs))
+        for fn_node, where, kwargs in wrappers:
+            args = getattr(fn_node, "args", None)
+            if args is None:
+                continue
+            pos = [*args.posonlyargs, *args.args]
+            state_idxs = [
+                i
+                for i, a in enumerate(pos)
+                if a.annotation is not None
+                and re.search(r"\bDeviceState\b", ast.unparse(a.annotation))
+            ]
+            if not state_idxs:
+                continue
+            if kwargs.get("donate_argnames") is not None:
+                continue  # name-based donation: assume it covers the state
+            donated: set[int] = set()
+            dval = kwargs.get("donate_argnums")
+            if dval is not None:
+                for sub in ast.walk(dval):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, int
+                    ):
+                        donated.add(sub.value)
+            missing = [i for i in state_idxs if i not in donated]
+            if missing:
+                yield _finding(
+                    "GL006",
+                    f,
+                    where,
+                    f"jit over `{fn_node.name}` leaves its DeviceState "
+                    f"argument (position {missing[0]}) undonated — "
+                    "steady-state HBM holds two copies of the world "
+                    "tensors",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
     "GL003": check_gl003,
     "GL004": check_gl004,
     "GL005": check_gl005,
+    "GL006": check_gl006,
 }
 
 

@@ -12,6 +12,13 @@ assertions.
   trace event (unlike backend-compile time) fires for cache MISSES of
   the in-process jit cache regardless of the persistent compilation
   cache's state, so budgets hold on both cold and warm CI runs.
+- :func:`persistent_cache_hits` / :func:`persistent_cache_misses` —
+  process-wide counts of PERSISTENT compilation-cache outcomes (the
+  on-disk cache ``magicsoup_tpu.cache`` configures): a hit means a
+  backend compile was skipped by loading a prior process's executable.
+  This is the observable the warm-start contract is asserted on — a
+  second process stepping the same world shapes must hit, not recompile
+  the q-ladder.
 - :func:`sanctioned_transfer` — the explicit D2H spelling that stays
   legal under ``transfer_guard("disallow")`` (explicit transfers are
   exempt by JAX's design; the guard exists to catch *implicit* ones).
@@ -31,8 +38,14 @@ import threading
 import jax
 
 _COMPILE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+# record_event (no duration) markers emitted by jax's persistent
+# compilation cache on every lookup outcome
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _lock = threading.Lock()
 _count = 0
+_cache_hits = 0
+_cache_misses = 0
 _installed = False
 
 
@@ -43,8 +56,18 @@ def _listener(event: str, duration: float, **kwargs) -> None:
             _count += 1
 
 
+def _event_listener(event: str, **kwargs) -> None:
+    global _cache_hits, _cache_misses
+    if event == _CACHE_HIT_EVENT:
+        with _lock:
+            _cache_hits += 1
+    elif event == _CACHE_MISS_EVENT:
+        with _lock:
+            _cache_misses += 1
+
+
 def install() -> None:
-    """Register the compile listener (idempotent; process-global)."""
+    """Register the compile listeners (idempotent; process-global)."""
     global _installed
     with _lock:
         if _installed:
@@ -53,6 +76,7 @@ def install() -> None:
     from jax import monitoring
 
     monitoring.register_event_duration_secs_listener(_listener)
+    monitoring.register_event_listener(_event_listener)
 
 
 def compile_count() -> int:
@@ -60,6 +84,24 @@ def compile_count() -> int:
     install()
     with _lock:
         return _count
+
+
+def persistent_cache_hits() -> int:
+    """Backend compiles SKIPPED by loading a persistent-cache entry.
+
+    Only counts lookups after :func:`install` ran — register the
+    listener before the first jit execution (e.g. first thing in a
+    subprocess) for process-total numbers."""
+    install()
+    with _lock:
+        return _cache_hits
+
+
+def persistent_cache_misses() -> int:
+    """Persistent-cache lookups that fell through to a backend compile."""
+    install()
+    with _lock:
+        return _cache_misses
 
 
 class GuardStats:

@@ -1,0 +1,84 @@
+"""
+Library-level persistent XLA compilation cache configuration.
+
+Promoted from ``bench.py`` (which now delegates here): every process
+that steps a world pays the same q-ladder / megastep compiles, and on a
+remote-compile platform each one is seconds of stall — persisting the
+compiled executables on disk lets a second process warm from the first
+one's work instead of recompiling the whole ladder.  The stepper's
+background :class:`magicsoup_tpu.util.WarmScheduler` compiles land in
+the same cache, so "one rung ahead" warms survive process restarts.
+
+Configuration:
+
+- ``MAGICSOUP_COMPILE_CACHE_DIR`` overrides the cache directory
+  (default ``/tmp/magicsoup_jax_cache``); set it to ``""``, ``"0"``,
+  ``"off"`` or ``"none"`` to disable the cache entirely.
+- An application that already set ``jax_compilation_cache_dir`` itself
+  is respected: :func:`ensure_compile_cache` never overwrites it.
+"""
+import os
+import threading
+
+DEFAULT_CACHE_DIR = "/tmp/magicsoup_jax_cache"
+ENV_VAR = "MAGICSOUP_COMPILE_CACHE_DIR"
+
+_lock = threading.Lock()
+_done = False
+_configured: str | None = None
+
+
+def compile_cache_dir() -> str | None:
+    """The directory :func:`ensure_compile_cache` will configure — the
+    ``MAGICSOUP_COMPILE_CACHE_DIR`` override or the ``/tmp`` default —
+    or ``None`` when the env var disables the cache."""
+    raw = os.environ.get(ENV_VAR)
+    if raw is None:
+        return DEFAULT_CACHE_DIR
+    val = raw.strip()
+    if val.lower() in ("", "0", "off", "none", "disabled"):
+        return None
+    return val
+
+
+def ensure_compile_cache() -> str | None:
+    """Configure jax's persistent compilation cache (idempotent; safe
+    from any thread).  Returns the active cache directory, or ``None``
+    when disabled or already managed by the application.
+
+    Imports jax lazily so merely importing this module never initializes
+    a backend (the same discipline as the rest of the package).
+    """
+    global _done, _configured
+    if _done:
+        return _configured
+    with _lock:
+        if _done:
+            return _configured
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            # the embedding application configured its own cache — ours
+            # would silently redirect entries it expects to find there
+            _configured = jax.config.jax_compilation_cache_dir
+            _done = True
+            return _configured
+        target = compile_cache_dir()
+        if target is not None:
+            jax.config.update("jax_compilation_cache_dir", target)
+            # no size floor (-1), but only non-trivial compiles: the
+            # q-ladder / megastep variants are exactly the multi-second
+            # entries worth a disk round trip
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+            # jax latches cache-off at the FIRST compile it sees with no
+            # cache dir configured — and World construction compiles
+            # programs before any stepper exists, so a late config.update
+            # alone never takes effect in-process.  reset_cache() clears
+            # that latch; the next compile re-initializes with our dir.
+            from jax.experimental.compilation_cache import compilation_cache
+
+            compilation_cache.reset_cache()
+        _configured = target
+        _done = True
+        return target

@@ -380,7 +380,10 @@ def integrate_signals(
     return _integrate_signals_jit(X, params, det)
 
 
-@partial(jax.jit, static_argnames=("n_steps", "det"))
+# X is donated: the scan consumes the signal matrix and returns its
+# successor, so the n_steps burst updates it in place instead of holding
+# two (c, s) copies for its whole duration
+@partial(jax.jit, static_argnames=("n_steps", "det"), donate_argnums=(0,))
 def _integrate_signals_steps_jit(
     X: jax.Array, params: CellParams, n_steps: int, det: bool
 ) -> jax.Array:
@@ -394,7 +397,13 @@ def _integrate_signals_steps_jit(
 def integrate_signals_steps(
     X: jax.Array, params: CellParams, n_steps: int = 1, det: bool | None = None
 ) -> jax.Array:
-    """Multiple integrator steps fused under one jit (scan over steps)."""
+    """Multiple integrator steps fused under one jit (scan over steps).
+
+    Donates ``X`` when it is already a device array (a caller's
+    reference to the input buffer is deleted by the call); pass a copy
+    if the pre-step signals are still needed."""
     if det is None:
         det = default_deterministic()
-    return _integrate_signals_steps_jit(X, params, n_steps, det)
+    return _integrate_signals_steps_jit(
+        jnp.asarray(X, dtype=jnp.float32), params, n_steps, det
+    )

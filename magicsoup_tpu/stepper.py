@@ -229,13 +229,7 @@ def _place_global(
     return placed, spos, occ
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
-    ),
-)
-def _pipeline_step(
+def _step_body(
     state: DeviceState,
     params: CellParams,
     kernels: jax.Array,
@@ -259,10 +253,11 @@ def _pipeline_step(
     compact: bool,
     q: int | None = None,
     use_pallas: bool = False,
-) -> tuple[DeviceState, CellParams, StepOutputs]:
+) -> tuple[DeviceState, CellParams, jax.Array]:
     """One fused workload step (spawn -> activity -> select -> kill ->
     divide -> degrade/diffuse/permeate [-> compact]) — a single dispatch,
-    no host round trip.
+    no host round trip.  Traced both standalone (:func:`_pipeline_step`)
+    and as the :func:`_megastep` scan body.
 
     ``q`` (static) bounds the live-row prefix: the integrator reads only
     the first q rows of the big parameter tensors (dead-slot tax), and
@@ -428,8 +423,169 @@ def _pipeline_step(
     return new_state, params, out
 
 
-@jax.jit
-def _compact_program(
+# donate_argnums=(0, 1): the step consumes (state, params) and returns
+# their successors, so XLA reuses the input HBM in place — without it
+# steady-state holds TWO copies of every world tensor (the old and new
+# molecule map alone are the largest allocations in the program)
+_pipeline_step = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+    ),
+    donate_argnums=(0, 1),
+)(_step_body)
+
+# CPU twin WITHOUT donation: jax 0.4.37's XLA:CPU runtime races donated-
+# buffer reuse against its async execution on the compact step variant
+# (the one where CPU buffer assignment honors EVERY state/params alias) —
+# observed as nondeterministic occupancy corruption confined to map row 0
+# in ~half of fresh processes, gone with donation disabled.  CPU donation
+# buys nothing anyway (host RAM, and the big buffers are usually declined
+# on the non-compact variants), so steps retain their inputs there;
+# _donate_step_buffers() picks the variant per backend at stepper init.
+_pipeline_step_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of _pipeline_step; donation races XLA:CPU async execution
+    jax.jit,
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+    ),
+)(_step_body)
+
+
+def _donate_step_buffers() -> bool:
+    """Whether the step programs may donate (state, params) on this
+    backend — True everywhere except XLA:CPU (see the retained-twin
+    comment above for the observed CPU corruption)."""
+    return jax.default_backend() != "cpu"
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+    ),
+    donate_argnums=(0, 1),
+)
+def _megastep(
+    state: DeviceState,
+    params: CellParams,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+    degrad_factors: jax.Array,
+    mol_idx: jax.Array,
+    kill_below: jax.Array,
+    divide_above: jax.Array,
+    divide_cost: jax.Array,
+    div_budget: jax.Array,
+    spawn_dense: jax.Array,
+    spawn_valid: jax.Array,
+    push_dense: jax.Array,
+    push_rows: jax.Array,
+    tables: Any,
+    abs_temp: jax.Array,
+    *,
+    det: bool,
+    max_div: int,
+    n_rounds: int,
+    compact: bool,
+    q: int | None = None,
+    use_pallas: bool = False,
+    k: int = 1,
+) -> tuple[DeviceState, CellParams, jax.Array]:
+    """``k`` fused pipeline steps in ONE dispatch: a ``lax.scan`` over
+    :func:`_step_body`, per-step packed output records stacked into one
+    ``(k, record)`` buffer the host replay unpacks row by row — dispatch
+    count drops ``k``×, and XLA fuses across step boundaries.
+
+    Semantics are EXACTLY ``k`` serial :func:`_pipeline_step` calls
+    where the spawn/push batches ride step 0 and steps 1..k-1 run with
+    the cached empty buffers (the only schedule the host dispatch path
+    produces): inside the scan, steps after the first mask
+    ``spawn_valid`` to all-False and ``push_rows`` to the OOB sentinel,
+    which makes those phases bitwise no-ops (OOB scatters drop; pickup
+    is zeroed by the all-False spawn mask).  ``compact`` (static)
+    applies to the LAST step only, so the host's stable-argsort
+    compaction replay stays a per-dispatch tail event."""
+
+    def body(carry, first):
+        state, params = carry
+        state, params, out = _step_body(
+            state,
+            params,
+            kernels,
+            perm_factors,
+            degrad_factors,
+            mol_idx,
+            kill_below,
+            divide_above,
+            divide_cost,
+            div_budget,
+            spawn_dense,
+            spawn_valid & first,
+            push_dense,
+            jnp.where(first, push_rows, jnp.iinfo(jnp.int32).max),
+            tables,
+            abs_temp,
+            det=det,
+            max_div=max_div,
+            n_rounds=n_rounds,
+            compact=False,
+            q=q,
+            use_pallas=use_pallas,
+        )
+        return (state, params), out
+
+    if k > 1:
+        firsts = jnp.arange(k - 1, dtype=jnp.int32) == 0
+        (state, params), outs = jax.lax.scan(body, (state, params), firsts)
+        sv_last = jnp.zeros_like(spawn_valid)
+        pr_last = jnp.full_like(push_rows, jnp.iinfo(jnp.int32).max)
+    else:
+        outs = None
+        sv_last, pr_last = spawn_valid, push_rows
+    # the final step is unrolled OUTSIDE the scan so ``compact`` can stay
+    # a static flag (row compaction reshapes nothing, but keeping it out
+    # of the scan body avoids paying its sort on every iteration)
+    state, params, out_last = _step_body(
+        state,
+        params,
+        kernels,
+        perm_factors,
+        degrad_factors,
+        mol_idx,
+        kill_below,
+        divide_above,
+        divide_cost,
+        div_budget,
+        spawn_dense,
+        sv_last,
+        push_dense,
+        pr_last,
+        tables,
+        abs_temp,
+        det=det,
+        max_div=max_div,
+        n_rounds=n_rounds,
+        compact=compact,
+        q=q,
+        use_pallas=use_pallas,
+    )
+    if outs is None:
+        outs = out_last[None]
+    else:
+        outs = jnp.concatenate([outs, out_last[None]], axis=0)
+    return state, params, outs
+
+
+# CPU twin — same rationale as _pipeline_step_retained
+_megastep_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of _megastep; donation races XLA:CPU async execution
+    jax.jit,
+    static_argnames=(
+        "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+    ),
+)(_megastep.__wrapped__)
+
+
+def _compact_body(
     state: DeviceState, params: CellParams, perm: jax.Array, n_keep: jax.Array
 ) -> tuple[DeviceState, CellParams]:
     """Standalone compaction (used by :meth:`PipelinedStepper.flush`)."""
@@ -445,6 +601,13 @@ def _compact_program(
         ),
         permute_params(params, perm, n_keep),
     )
+
+
+_compact_program = functools.partial(jax.jit, donate_argnums=(0, 1))(
+    _compact_body
+)
+# CPU twin — same rationale as _pipeline_step_retained
+_compact_program_retained = jax.jit(_compact_body)  # graftlint: disable=GL006 CPU twin of _compact_program; donation races XLA:CPU async execution
 
 
 class _Worker:
@@ -550,14 +713,15 @@ class _LazyFetch:
 
 
 class _Pending(NamedTuple):
-    """One dispatched step awaiting host replay."""
+    """One dispatched step (or megastep) awaiting host replay."""
 
     out: Any  # Future[np.ndarray] — packed i32 output (see StepOutputs)
     spawn_genomes: list  # genomes queued into this dispatch (b_spawn order)
     spawn_labels: list
-    compacted: bool
+    compacted: bool  # final record of this dispatch compacted
     change_seq: int  # genome-change counter at dispatch time
-    div_budget: int  # division cap given to this dispatch (row accounting)
+    div_budget: int  # TOTAL division cap of this dispatch (k x per-step)
+    k: int  # fused steps in this dispatch (records in ``out``)
 
 
 class PipelinedStepper:
@@ -578,9 +742,22 @@ class PipelinedStepper:
         target_cells: Population size to top up to with random genomes
             (``None`` disables spawning).
         genome_size: Size of top-up genomes.
-        lag: Pipeline depth.  An integer fixes the schedule (seed-exact
-            reproducibility); ``"auto"`` processes outputs as their
-            transfers complete, bounded by ``max_lag``.
+        lag: Pipeline depth, counted in DISPATCHES.  An integer fixes
+            the schedule (seed-exact reproducibility); ``"auto"``
+            processes outputs as their transfers complete, bounded by
+            ``max_lag``.  With ``megastep=K`` each dispatch is K steps,
+            so the phenotype/spawn replay trails the device by up to
+            ``lag x K`` STEPS — choose ``lag`` and ``K`` together (see
+            README "Choosing K").
+        megastep: Fused steps per dispatch (``K``).  Each :meth:`step`
+            call dispatches ONE ``lax.scan``-fused program advancing the
+            device K steps and returning the K packed per-step records
+            in one buffer; the host replays them record by record, so
+            the replayed trajectory is the same serial one.  Spawn
+            batches and riding parameter refreshes enter at megastep
+            boundaries only (step 0 of each dispatch).  Default 1 (the
+            classic one-step dispatch, byte-identical schedule to
+            previous releases).
         max_divisions: Static per-step division budget (slot allocation
             is bounded so the step program compiles once).
         spawn_block: Static per-step spawn budget.
@@ -630,6 +807,7 @@ class PipelinedStepper:
         genome_size: int = 500,
         lag: int | str = "auto",
         max_lag: int = 8,
+        megastep: int = 1,
         max_divisions: int = 2048,
         spawn_block: int = 1024,
         push_block: int = 256,
@@ -660,6 +838,9 @@ class PipelinedStepper:
             raise ValueError("lag must be 'auto' or a non-negative int")
         self.lag = lag
         self.max_lag = max_lag if lag == "auto" else max(int(lag), 1)
+        if not isinstance(megastep, int) or megastep < 1:
+            raise ValueError("megastep must be an int >= 1")
+        self.megastep = megastep
         self.max_divisions = max_divisions
         self.spawn_block = spawn_block
         self.push_block = push_block
@@ -750,6 +931,15 @@ class PipelinedStepper:
         self._growth_hist: list[int] = []  # recent per-step row growth
         self._change_seq = 0  # bumps on every genome-change batch CREATED
         self._dispatched_seq = 0  # highest batch seq actually DISPATCHED
+        # persistent on-disk compile cache: the q-ladder / megastep
+        # variants this driver compiles are exactly the entries a second
+        # process wants warm (idempotent, env-overridable — see cache.py)
+        from magicsoup_tpu.cache import ensure_compile_cache
+
+        ensure_compile_cache()
+        # donated vs retained step programs is a per-backend choice,
+        # fixed at init (see _pipeline_step_retained)
+        self._donate = _donate_step_buffers()
         # compiled-variant bookkeeping (keys include the token capacities
         # the program shapes depend on) + cached empty spawn/push buffers
         self._warm_sched = WarmScheduler()
@@ -766,10 +956,14 @@ class PipelinedStepper:
         # bookkeeping and the cached empty buffers start over
         self._warm_sched.reset()
         self._empty_cache = {}
+        # COPIES, not the world's own arrays: the step program donates its
+        # DeviceState inputs, and donating `w._molecule_map` itself would
+        # delete the buffer the classic API (world.molecule_map & friends)
+        # still reads between pipelined phases
         self._state = DeviceState(
-            mm=w._molecule_map,
-            cm=w._cell_molecules,
-            pos=w._positions_dev,
+            mm=jnp.copy(w._molecule_map),
+            cm=jnp.copy(w._cell_molecules),
+            pos=jnp.copy(w._positions_dev),
             occ=jnp.asarray(w._np_cell_map),
             alive=jnp.arange(self._cap) < w.n_cells,
             n_rows=jnp.asarray(w.n_cells, dtype=jnp.int32),
@@ -799,8 +993,10 @@ class PipelinedStepper:
         """Drain, sync into the world, double its slot capacity, and
         reattach — the pipelined analog of the classic loop's amortized
         pow2 growth (a rare full pipeline bubble)."""
-        key = self._state.key
         self.flush()
+        # AFTER the flush: its compaction program donates the old state,
+        # so a key captured before it would be a deleted buffer
+        key = self._state.key
         self.world._ensure_capacity(self.world._capacity + 1)
         self._attach(key)
         self._needs_attach = False
@@ -811,7 +1007,8 @@ class PipelinedStepper:
     # -------------------------------------------------------------- #
 
     def step(self) -> None:
-        """Dispatch one workload step and replay any arrived outputs."""
+        """Dispatch one workload step (``megastep`` fused device steps)
+        and replay any arrived outputs."""
         import time as _time
 
         t_start = _time.perf_counter()
@@ -847,9 +1044,12 @@ class PipelinedStepper:
                 if self._cap - int(self._alive.sum()) < grow_at:
                     self._grow_capacity()
 
+        # outstanding STEPS, not dispatches: each pending megastep holds
+        # p.k fused steps' worth of unreplayed growth
+        pend_steps = sum(p.k for p in self._pending) + self.megastep
         projected = (
             self._n_rows
-            + (len(self._pending) + 1) * 2 * g_est
+            + pend_steps * 2 * g_est
             + len(self._spawn_queue)
         )
         # two triggers: (a) running out of rows, and (b) enough dead rows
@@ -920,14 +1120,16 @@ class PipelinedStepper:
         if dev_budget is None:
             dev_budget = jnp.asarray(div_budget, dtype=jnp.int32)
             self._budget_cache[div_budget] = dev_budget
-        upper = self._n_rows + div_budget + len(spawn)
+        k = self.megastep
+        upper = self._n_rows + k * div_budget + len(spawn)
         for p in self._pending:
             upper += p.div_budget + len(p.spawn_genomes)
         q = quantize_rows(upper, self._cap)
 
         cold = not self._warm_sched.is_warm(self._variant_key(q, compact))
         t_dispatch0 = _time.perf_counter()
-        self._state, self.kin.params, out = _pipeline_step(
+        step_fn = self._step_fn()
+        self._state, self.kin.params, out = step_fn(
             self._state,
             self.kin.params,
             self.world._diff_kernels,
@@ -966,12 +1168,13 @@ class PipelinedStepper:
                 # what the device saw: only DISPATCHED pushes — a batch
                 # still held in the compaction buffer is invisible to it
                 change_seq=self._dispatched_seq,
-                div_budget=div_budget,
+                div_budget=k * div_budget,
+                k=k,
             )
         )
         if compact:
             self._compact_outstanding = True
-        self.stats["steps"] += 1
+        self.stats["steps"] += k
         self._drain(block=False)
         # per-step trace: ~100 B of host bookkeeping that makes a slow
         # hardware window self-diagnosing (bench.py summarises to stderr);
@@ -995,6 +1198,7 @@ class PipelinedStepper:
                 "alive": int(self._alive.sum()),
                 "cold": cold,
                 "compact": compact,
+                "k": k,
                 "push": 0 if ride is None else len(ride[1]),
                 "spawn": len(spawn),
                 "pend": len(self._pending),
@@ -1070,12 +1274,37 @@ class PipelinedStepper:
         import time as _time
 
         t0 = _time.perf_counter()
-        # the ONE fetch — usually already pulled by the background worker;
-        # the (generous) timeout makes a dead worker or wedged tunnel
-        # surface as an exception here instead of a silent hang
-        out = self._unpack_outputs(pend.out.result(timeout=300.0))
+        # the ONE fetch per dispatch — usually already pulled by the
+        # background worker; a megastep's k per-step records arrive
+        # stacked in this single (k, record) buffer.  The (generous)
+        # timeout makes a dead worker or wedged tunnel surface as an
+        # exception here instead of a silent hang
+        arr = np.atleast_2d(np.asarray(pend.out.result(timeout=300.0)))
         self._fetch_acc += _time.perf_counter() - t0
-        # the previous replay's evolution must land before anything here
+        for i in range(pend.k):
+            # record 0 carries the dispatch's spawn batch; only the final
+            # record can be the compacting one — exactly what the device
+            # program did (see _megastep)
+            self._replay_record(
+                self._unpack_outputs(arr[i]),
+                spawn_genomes=pend.spawn_genomes if i == 0 else [],
+                spawn_labels=pend.spawn_labels if i == 0 else [],
+                compacted=pend.compacted and i == pend.k - 1,
+                change_seq=pend.change_seq,
+            )
+
+    def _replay_record(
+        self,
+        out: StepOutputs,
+        *,
+        spawn_genomes: list,
+        spawn_labels: list,
+        compacted: bool,
+        change_seq: int,
+    ) -> None:
+        """Replay ONE per-step record — the serial unit regardless of
+        how many records arrived per dispatch."""
+        # the previous record's evolution must land before anything here
         # touches genomes, positions or the push queues
         self._join_evolution()
         kill = out.kill
@@ -1087,9 +1316,9 @@ class PipelinedStepper:
 
         # 0. spawns (allocation order matches the device: queue order)
         n_spawned = 0
-        if pend.spawn_genomes:
+        if spawn_genomes:
             for i, (g, lab) in enumerate(
-                zip(pend.spawn_genomes, pend.spawn_labels)
+                zip(spawn_genomes, spawn_labels)
             ):
                 if not spawn_ok[i]:
                     continue
@@ -1103,7 +1332,7 @@ class PipelinedStepper:
                 self._alive[row] = True
             self._n_rows += n_spawned
             self.stats["spawned"] += n_spawned
-            self.stats["spawn_drops"] += len(pend.spawn_genomes) - n_spawned
+            self.stats["spawn_drops"] += len(spawn_genomes) - n_spawned
 
         # 1. kills
         self._alive[kill] = False
@@ -1126,7 +1355,7 @@ class PipelinedStepper:
             self._lifetimes[row] = 0
             self._positions[row] = child_pos[i]
             self._alive[row] = True
-            if self._last_change[p] > pend.change_seq:
+            if self._last_change[p] > change_seq:
                 repush[row] = self._genomes[row]
             else:
                 self._last_change[row] = self._last_change[p]
@@ -1141,7 +1370,7 @@ class PipelinedStepper:
         ] += 1
 
         # 4. compaction replay (same stable permutation as the device)
-        if pend.compacted:
+        if compacted:
             perm = np.argsort(~self._alive, kind="stable")
             n_keep = int(self._alive.sum())
             self._apply_perm(perm, n_keep)
@@ -1414,12 +1643,10 @@ class PipelinedStepper:
 
     def prewarm(self, *, q: int | None = None, compact: bool = False) -> None:
         """Compile (and persistently cache) the fused step program's
-        ``(q, compact)`` variant WITHOUT advancing the simulation: the
-        program is pure, so calling it on the current state and
-        discarding the results is a compile warmer.  The step dispatch
-        does this automatically one q-rung ahead in a background thread;
-        call it explicitly (plus :meth:`wait_warm`) before a timing
-        window so no remote compile can land inside it."""
+        ``(q, compact)`` variant WITHOUT advancing the simulation.  The
+        step dispatch does this automatically one q-rung ahead in a
+        background thread; call it explicitly (plus :meth:`wait_warm`)
+        before a timing window so no remote compile can land inside it."""
         if q is None:
             # warm the rung the current population uses AND the one above
             # it: before the first dispatch nothing is compiled yet, so
@@ -1431,9 +1658,19 @@ class PipelinedStepper:
             return
         spawn_dense, spawn_valid = self._empty_spawn()
         push_dense, push_rows = self._empty_push()
-        _pipeline_step(
-            self._state,
-            self.kin.params,
+        # warm on THROWAWAY zero-filled stand-ins, never the live state:
+        # the program donates (state, params), so executing it on
+        # `self._state` would DELETE the live buffers — and zeros built
+        # from shape/dtype metadata (which survives donation) also make
+        # this safe to run from the background warm thread while the
+        # main thread's dispatch consumes the real arrays
+        zeros = functools.partial(
+            jax.tree_util.tree_map, lambda t: jnp.zeros(t.shape, t.dtype)
+        )
+        step_fn = self._step_fn()
+        step_fn(
+            zeros(self._state),
+            zeros(self.kin.params),
             self.world._diff_kernels,
             self.world._perm_factors,
             self.world._degrad_factors,
@@ -1456,11 +1693,27 @@ class PipelinedStepper:
             use_pallas=self.world.use_pallas,
         )
 
+    def _step_fn(self):
+        """The dispatched step program: donated on accelerators, the
+        retained twin on CPU (see _pipeline_step_retained).  k == 1
+        keeps the classic single-step program — the megastep wrapper
+        would trace an identical body, but this preserves the exact
+        program/jit-cache identity previous releases dispatched."""
+        if self.megastep == 1:
+            return _pipeline_step if self._donate else _pipeline_step_retained
+        base = _megastep if self._donate else _megastep_retained
+        return functools.partial(base, k=self.megastep)
+
     def _variant_key(self, q: int, compact: bool) -> tuple:
         # token capacities are in the key: growing them reshapes the
         # params/spawn/push inputs, invalidating every compiled variant —
-        # stale-capacity entries then simply never match again
-        return (q, compact, self.kin.max_proteins, self.kin.max_doms)
+        # stale-capacity entries then simply never match again.  megastep
+        # is in the key so steppers with different K (fixed per instance)
+        # never mistake each other's variants for warm
+        return (
+            q, compact, self.megastep,
+            self.kin.max_proteins, self.kin.max_doms,
+        )
 
     def _note_warm(self, q: int, compact: bool) -> None:
         """Record a just-dispatched variant as compiled and keep the
@@ -1509,7 +1762,10 @@ class PipelinedStepper:
         n_keep = int(self._alive.sum())
         if self._n_rows != n_keep or not self._alive[:n_keep].all():
             perm = np.argsort(~self._alive, kind="stable")
-            self._state, self.kin.params = _compact_program(
+            compact_fn = (
+                _compact_program if self._donate else _compact_program_retained
+            )
+            self._state, self.kin.params = compact_fn(
                 self._state,
                 self.kin.params,
                 jnp.asarray(perm.astype(np.int32)),

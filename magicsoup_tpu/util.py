@@ -250,6 +250,13 @@ class WarmScheduler:
         to keep remote compiles out of measured windows)."""
         if self._stopping[0]:
             return
+        # background warms are exactly the compiles worth persisting:
+        # make sure the on-disk compile cache is configured before the
+        # first one runs, so the NEXT process warms from disk instead of
+        # recompiling the ladder (idempotent, lazy jax import)
+        from magicsoup_tpu.cache import ensure_compile_cache
+
+        ensure_compile_cache()
         queued = {k for k, _ in self._pending}
         new = [k for k in keys if k not in self._warm and k not in queued]
         if new:

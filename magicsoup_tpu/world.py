@@ -224,6 +224,55 @@ def _degrade_diffuse_permeate(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("det", "pallas", "n_steps", "q"),
+    # the burst consumes (molecule_map, cell_molecules) and returns their
+    # successors; donation lets XLA update them in place instead of
+    # holding two copies of the largest world tensors for n_steps.
+    # Donated on CPU too, unlike the stepper's step programs (see
+    # stepper._pipeline_step_retained): this conv/elementwise program has
+    # no scatter-placement loop, and its CPU donation is exercised green
+    # by tests/fast/test_megastep.py (deletion + det-mode bit-identity)
+    donate_argnums=(0, 1),
+)
+def _step_many(
+    molecule_map: jax.Array,
+    cell_molecules: jax.Array,
+    positions: jax.Array,
+    n_cells: jax.Array,
+    params: CellParams,
+    degrad_factors: jax.Array,
+    kernels: jax.Array,
+    perm_factors: jax.Array,
+    *,
+    det: bool,
+    pallas: bool,
+    n_steps: int,
+    q: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """``n_steps`` fused chemistry steps (activity -> degrade + diffuse +
+    permeate) as ONE ``lax.scan``-driven device program — the classic
+    loop's :func:`World.step_many` megastep counterpart.  The math and
+    order per iteration are exactly ``enzymatic_activity()`` followed by
+    ``degrade_and_diffuse_molecules()``."""
+    activity = _get_activity_fn(det, pallas)
+
+    def body(carry, _):
+        mm, cm = carry
+        mm, cm = activity(mm, cm, positions, n_cells, params, q=q)
+        mm, cm = _degrade_diffuse_permeate(
+            mm, cm, positions, n_cells,
+            degrad_factors, kernels, perm_factors, det=det,
+        )
+        return (mm, cm), None
+
+    (molecule_map, cell_molecules), _ = jax.lax.scan(
+        body, (molecule_map, cell_molecules), None, length=n_steps
+    )
+    return molecule_map, cell_molecules
+
+
 @jax.jit
 def _set_rows(
     cell_molecules: jax.Array,
@@ -1318,6 +1367,55 @@ class World:
             self._perm_factors,
             det=self.deterministic,
         )
+
+    def step_many(self, n_steps: int):
+        """Run ``n_steps`` chemistry steps — each exactly
+        :meth:`enzymatic_activity` followed by
+        :meth:`degrade_and_diffuse_molecules` — as ONE fused device
+        program (``lax.scan`` over the per-step body), plus the matching
+        :meth:`increment_cell_lifetimes` bookkeeping on the host.
+
+        One dispatch instead of ``2 * n_steps``: for loops that run many
+        chemistry steps between selection decisions this removes the
+        per-step dispatch latency entirely and lets XLA fuse across step
+        boundaries (in det mode the trajectory is bit-identical to the
+        serial calls).  ``n_steps`` is a static shape axis — vary it
+        sparingly (each distinct value compiles its own program).
+
+        The program DONATES the molecule buffers: any reference to the
+        previous ``world.molecule_map`` / ``world._cell_molecules``
+        arrays a caller holds across this call is deleted (re-read the
+        properties afterwards instead).
+        """
+        n_steps = int(n_steps)
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        if self.n_cells == 0:
+            # the fused program's activity phase assumes cells exist;
+            # the map-only serial path is cheap and rare
+            for _ in range(n_steps):
+                self.degrade_and_diffuse_molecules()
+            return
+        q = (
+            None
+            if self._cell_sharding is not None
+            else quantize_rows(self.n_cells, self._capacity)
+        )
+        self._molecule_map, self._cell_molecules = _step_many(
+            self._molecule_map,
+            self._cell_molecules,
+            self._positions_dev,
+            self._n_cells_dev(),
+            self.kinetics.params,
+            self._degrad_factors,
+            self._diff_kernels,
+            self._perm_factors,
+            det=self.deterministic,
+            pallas=self.use_pallas,
+            n_steps=n_steps,
+            q=q,
+        )
+        self._np_lifetimes[: self.n_cells] += n_steps
 
     def increment_cell_lifetimes(self):
         """Increment ``cell_lifetimes`` by 1"""

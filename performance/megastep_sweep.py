@@ -1,0 +1,153 @@
+"""
+Megastep K-sweep: the canonical pipelined workload (bench.py's headline
+shape by default — 10k cells, 128x128 map, wood_ljungdahl chemistry)
+timed at several ``megastep`` settings, one JSON line per K.
+
+    python performance/megastep_sweep.py [--ks 1,2,4,8] [--config headline]
+
+``K`` fuses K device steps into one dispatch (``lax.scan`` inside the
+step program), so dispatch count — and with it host dispatch overhead
+and, on remote accelerators, tunnel round trips — drops Kx, at the cost
+of selection decisions (kill/divide thresholds) replaying at K-step
+granularity and the host view trailing by ``lag * K`` steps.  Steps/s
+here are SIMULATION steps (dispatches x K), directly comparable across
+Ks.  BENCH_NOTES.md records the measured sweep.
+"""
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ks", default="1,2,4,8", help="comma-separated K values")
+    ap.add_argument("--n-cells", type=int, default=10_000)
+    ap.add_argument("--map-size", type=int, default=128)
+    ap.add_argument("--genome-size", type=int, default=500)
+    ap.add_argument("--warmup", type=int, default=6, help="warmup dispatches")
+    ap.add_argument("--steps", type=int, default=48, help="measured SIM steps per K")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform pin ('' = whatever jax finds)",
+    )
+    ap.add_argument(
+        "--pin-population",
+        action="store_true",
+        help=(
+            "disable kills/divisions/spawns so every K times the IDENTICAL "
+            "trajectory — selection replay makes populations drift apart "
+            "across Ks otherwise, and a ~1%% workload difference swamps "
+            "the per-dispatch overhead this sweep exists to measure"
+        ),
+    )
+    args = ap.parse_args()
+    ks = sorted({int(k) for k in args.ks.split(",")})
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from bench import _acquire_accel_lock
+
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    try:
+        _lock = _acquire_accel_lock(max_wait_s=600.0, platform=args.platform)
+    except TimeoutError as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "megastep sweep steps/sec",
+                    "error": f"accelerator lock contention: {exc}",
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(1)
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+
+    for k in ks:
+        # fresh world per K: each K replays selections at its own
+        # granularity, so reusing one world would let an earlier K's
+        # population shape bias a later K's timing
+        rng = random.Random(args.seed)
+        world = ms.World(
+            chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed
+        )
+        world.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        if args.pin_population:
+            sel = dict(
+                kill_below=0.0, divide_above=1e30, target_cells=None
+            )
+        else:
+            sel = dict(
+                kill_below=1.0, divide_above=5.0, target_cells=args.n_cells
+            )
+        st = ms.PipelinedStepper(
+            world,
+            mol_name="ATP",
+            divide_cost=4.0,
+            genome_size=args.genome_size,
+            megastep=k,
+            **sel,
+        )
+        for _ in range(max(args.warmup, 3)):
+            st.step()
+        st.drain()
+        st.wait_warm()
+        st.trace.clear()
+        n_disp = max(1, -(-args.steps // k))
+        t0 = time.perf_counter()
+        for _ in range(n_disp):
+            st.step()
+        st.drain()
+        dt = (time.perf_counter() - t0) / (n_disp * k)
+        trace = list(st.trace)
+        disp_ms = (
+            statistics.median(t["dispatch"] for t in trace) * 1e3
+            if trace
+            else float("nan")
+        )
+        st.flush()
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"megastep K={k} steps/sec ({args.n_cells} cells, "
+                        f"{args.map_size}x{args.map_size} map, "
+                        f"{jax.default_backend()})"
+                    ),
+                    "value": round(1.0 / dt, 4),
+                    "unit": "steps/s",
+                    "megastep": k,
+                    "dispatches": n_disp,
+                    "ms_per_step": round(dt * 1e3, 2),
+                    "dispatch_ms_median": round(disp_ms, 2),
+                    "final_n_cells": world.n_cells,
+                    "pinned_population": args.pin_population,
+                    "backend": jax.default_backend(),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,9 @@ def main() -> None:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    from bench import _acquire_accel_lock, _setup_compile_cache
+    from bench import _acquire_accel_lock
+
+    from magicsoup_tpu.cache import ensure_compile_cache
 
     # accelerator runs serialize on the shared flock like every other
     # harness; cpu runs skip it (held for process lifetime when taken).
@@ -55,7 +57,7 @@ def main() -> None:
             flush=True,
         )
         raise SystemExit(1)
-    _setup_compile_cache(jax)
+    ensure_compile_cache()
 
     import numpy as np
 

@@ -1,0 +1,97 @@
+"""
+CI steps/s smoke: a TINY pipelined run (16x16 map, a few dozen cells)
+that prints one JSON line with the measured rate and exits 0 — no
+threshold, by design.  Its job is (a) to prove the full dispatch ->
+replay -> flush path executes end to end in CI, and (b) to leave a
+steps/s number in the logs so throughput regressions are visible in
+history even where wall-clock assertions would flake (shared CI boxes).
+
+    python performance/smoke.py [--steps 6] [--megastep 2]
+
+scripts/test.sh runs this after the fast tier.
+"""
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-cells", type=int, default=24)
+    ap.add_argument("--map-size", type=int, default=16)
+    ap.add_argument("--genome-size", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=2, help="warmup dispatches")
+    ap.add_argument("--steps", type=int, default=6, help="measured dispatches")
+    ap.add_argument("--megastep", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+
+    mols = [
+        ms.Molecule("smk-a", 10e3),
+        ms.Molecule("smk-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(args.seed)
+    world = ms.World(chemistry=chem, map_size=args.map_size, seed=args.seed)
+    world.spawn_cells(
+        [
+            ms.random_genome(s=args.genome_size, rng=rng)
+            for _ in range(args.n_cells)
+        ]
+    )
+    st = ms.PipelinedStepper(
+        world,
+        mol_name="smk-atp",
+        kill_below=0.1,
+        divide_above=3.0,
+        divide_cost=1.0,
+        target_cells=args.n_cells,
+        genome_size=args.genome_size,
+        lag=1,
+        megastep=args.megastep,
+    )
+    for _ in range(args.warmup):
+        st.step()
+    st.drain()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        st.step()
+    st.drain()
+    dt = (time.perf_counter() - t0) / (args.steps * args.megastep)
+    st.flush()
+    st.check_consistency()
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"smoke steps/sec ({args.n_cells} cells, "
+                    f"{args.map_size}x{args.map_size} map, cpu)"
+                ),
+                "value": round(1.0 / dt, 4),
+                "unit": "steps/s",
+                "megastep": args.megastep,
+                "final_n_cells": world.n_cells,
+                "threshold": None,  # informational only, never gates CI
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

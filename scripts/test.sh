@@ -9,3 +9,7 @@ TARGET="${1:-tests/fast}"
 # minutes compiling
 python -m magicsoup_tpu.analysis --check
 python -m pytest "$TARGET" -q
+# steps/s smoke: prove the pipelined dispatch->replay->flush path end to
+# end and leave a throughput number in the CI log (JSON, no threshold —
+# see performance/smoke.py)
+python performance/smoke.py

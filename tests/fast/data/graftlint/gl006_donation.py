@@ -1,0 +1,23 @@
+"""GL006 fixture: a step-level jit over DeviceState without donation."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("det",))  # GL006: state undonated
+def step(state: "DeviceState", params, *, det: bool):
+    return state
+
+
+# the donating spellings are clean: decorator ...
+@functools.partial(jax.jit, donate_argnums=(0,))
+def donating_step(state: "DeviceState", params):
+    return state
+
+
+# ... and assignment-wrapped
+def _body(state: "DeviceState", params):
+    return state
+
+
+wrapped = functools.partial(jax.jit, donate_argnums=(0,))(_body)

@@ -1,0 +1,48 @@
+"""
+Unit tests for the library-level persistent-compile-cache helper
+(:mod:`magicsoup_tpu.cache`).  The cross-process warm-start behavior is
+covered by ``tests/slow/test_compile_cache.py``; here we pin the pure
+configuration logic: env-var resolution, the disable spellings, the
+respect-the-application rule, and idempotence.
+"""
+import jax
+import pytest
+
+from magicsoup_tpu import cache
+
+
+def test_compile_cache_dir_default(monkeypatch):
+    monkeypatch.delenv(cache.ENV_VAR, raising=False)
+    assert cache.compile_cache_dir() == cache.DEFAULT_CACHE_DIR
+
+
+def test_compile_cache_dir_env_override(monkeypatch):
+    monkeypatch.setenv(cache.ENV_VAR, "/tmp/somewhere-else")
+    assert cache.compile_cache_dir() == "/tmp/somewhere-else"
+
+
+@pytest.mark.parametrize("val", ["", "0", "off", "OFF", "none", "disabled", " "])
+def test_compile_cache_dir_disable_spellings(monkeypatch, val):
+    monkeypatch.setenv(cache.ENV_VAR, val)
+    assert cache.compile_cache_dir() is None
+
+
+def test_ensure_respects_application_configured_cache(monkeypatch):
+    # the test suite's conftest configures jax_compilation_cache_dir
+    # itself — exactly the embedding-application case the helper must
+    # not clobber.  Reset the module's once-latch so this call exercises
+    # the decision, not a memoized earlier one.
+    monkeypatch.setattr(cache, "_done", False)
+    monkeypatch.setattr(cache, "_configured", None)
+    preset = jax.config.jax_compilation_cache_dir
+    assert preset  # conftest always sets one
+    monkeypatch.setenv(cache.ENV_VAR, "/tmp/should-be-ignored")
+    assert cache.ensure_compile_cache() == preset
+    assert jax.config.jax_compilation_cache_dir == preset
+
+
+def test_ensure_is_idempotent_and_memoized(monkeypatch):
+    first = cache.ensure_compile_cache()
+    # a changed env AFTER the first call must not re-configure anything
+    monkeypatch.setenv(cache.ENV_VAR, "/tmp/too-late")
+    assert cache.ensure_compile_cache() == first

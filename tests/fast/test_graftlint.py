@@ -48,6 +48,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl003_dtype.py", "GL003"),
         ("gl004_nondet.py", "GL004"),
         ("gl005_transfer.py", "GL005"),
+        ("gl006_donation.py", "GL006"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):

@@ -1,0 +1,303 @@
+"""
+Tests for megastep dispatch fusion (:func:`magicsoup_tpu.stepper._megastep`,
+:meth:`World.step_many`) and the donated step buffers that ride along.
+
+The load-bearing contracts:
+
+- det mode: ``K`` fused steps in ONE dispatch are BIT-identical to ``K``
+  serial ``_pipeline_step`` calls — final DeviceState, final CellParams
+  and the stacked per-step output records all match byte for byte;
+- the step programs DONATE ``(state, params)`` on accelerators (the
+  input buffers are deleted after dispatch — no steady-state double
+  copy), dispatch non-donating retained twins on XLA:CPU (whose runtime
+  races donated-buffer reuse), and the World's own arrays stay live
+  either way (``_attach`` copies);
+- a megastep stepper survives a full lifecycle run (spawns, kills,
+  divisions, compaction, flush) with the same consistency invariants as
+  the classic single-step driver.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.stepper import (
+    PipelinedStepper,
+    _megastep,
+    _pipeline_step,
+    _pipeline_step_retained,
+)
+
+_MOLS = [
+    ms.Molecule("mgs-a", 10e3),
+    ms.Molecule("mgs-atp", 8e3, half_life=100_000),
+    ms.Molecule("mgs-c", 4e3, permeability=0.3),
+]
+_REACTIONS = [([_MOLS[0]], [_MOLS[1]]), ([_MOLS[1]], [_MOLS[2]])]
+
+
+def _world(seed=7, map_size=32, n_cells=100, **kwargs):
+    rng = random.Random(seed)
+    world = ms.World(
+        chemistry=ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS),
+        map_size=map_size,
+        seed=seed,
+        **kwargs,
+    )
+    world.spawn_cells(
+        [ms.random_genome(s=300, rng=rng) for _ in range(n_cells)]
+    )
+    return world
+
+
+def _stepper(world, **kwargs):
+    defaults = dict(
+        mol_name="mgs-atp",
+        kill_below=0.2,
+        divide_above=2.5,
+        divide_cost=1.0,
+        target_cells=100,
+        genome_size=300,
+        lag=2,
+        p_mutation=1e-4,
+        p_recombination=1e-5,
+    )
+    defaults.update(kwargs)
+    return PipelinedStepper(world, **defaults)
+
+
+def _dispatch_args(st, *, spawn=None):
+    """The positional argument tuple step() passes to the device program,
+    with cached empty spawn/push buffers (or a real spawn batch)."""
+    import jax.numpy as jnp
+
+    if spawn is None:
+        spawn_dense, spawn_valid = st._empty_spawn()
+    else:
+        flat = st.world.genetics.translate_genomes_flat(spawn)
+        st.kin.ensure_token_capacity(flat[0], flat[1])
+        dense = st.kin.build_dense_tokens(*flat)
+        pad = np.zeros((st.spawn_block,) + dense.shape[1:], dtype=dense.dtype)
+        pad[: len(spawn)] = dense
+        spawn_dense = jnp.asarray(pad)
+        valid = np.zeros(st.spawn_block, dtype=bool)
+        valid[: len(spawn)] = True
+        spawn_valid = jnp.asarray(valid)
+    push_dense, push_rows = st._empty_push()
+    return (
+        st.world._diff_kernels,
+        st.world._perm_factors,
+        st.world._degrad_factors,
+        st._mol_idx_dev,
+        st._kill_below_dev,
+        st._divide_above_dev,
+        st._divide_cost_dev,
+        jnp.asarray(64, dtype=jnp.int32),
+        spawn_dense,
+        spawn_valid,
+        push_dense,
+        push_rows,
+        st.kin.tables,
+        st._abs_temp_dev,
+    )
+
+
+def _tree_bytes(tree) -> list[bytes]:
+    import jax
+
+    return [np.asarray(leaf).tobytes() for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+@pytest.mark.parametrize("compact", [False, True])
+def test_megastep_det_mode_bit_identical_to_serial_steps(compact):
+    # THE fusion contract: one _megastep(k=K) dispatch == K serial
+    # _pipeline_step calls, bit for bit, in det mode — including a real
+    # spawn batch riding step 0 (the scan masks it off steps 1..K-1) and
+    # compaction on the last step only.  Uses the program variants the
+    # stepper would actually dispatch on this backend (the retained
+    # twins on CPU — see stepper._pipeline_step_retained)
+    import jax
+    import jax.numpy as jnp
+    from magicsoup_tpu import stepper as stepper_mod
+
+    if jax.default_backend() == "cpu":
+        step_one = stepper_mod._pipeline_step_retained
+        step_k = stepper_mod._megastep_retained
+    else:
+        step_one = _pipeline_step
+        step_k = _megastep
+
+    K = 4
+    world = _world(seed=11, n_cells=80)
+    world.deterministic = True
+    st = _stepper(world)
+    rng = random.Random(23)
+    spawn = [ms.random_genome(s=300, rng=rng) for _ in range(6)]
+    args = _dispatch_args(st, spawn=spawn)
+    statics = dict(
+        det=True,
+        max_div=st.max_divisions,
+        n_rounds=st.n_rounds,
+        q=None,
+        use_pallas=False,
+    )
+    copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+
+    # serial schedule: spawn rides step 0, empties after (exactly what
+    # the host dispatch path produces), compact on the LAST step only
+    empty_dense, empty_valid = st._empty_spawn()
+    state_s, params_s = copy(st._state), copy(st.kin.params)
+    outs_serial = []
+    for i in range(K):
+        a = list(args)
+        if i > 0:
+            a[8], a[9] = empty_dense, empty_valid
+        state_s, params_s, out = step_one(
+            state_s, params_s, *a, compact=compact and i == K - 1, **statics
+        )
+        outs_serial.append(np.asarray(out))
+
+    state_m, params_m, outs_m = step_k(
+        copy(st._state), copy(st.kin.params), *args,
+        compact=compact, k=K, **statics,
+    )
+    outs_m = np.asarray(outs_m)
+    assert outs_m.shape == (K,) + outs_serial[0].shape
+    for i in range(K):
+        assert outs_m[i].tobytes() == outs_serial[i].tobytes()
+    assert _tree_bytes(state_m) == _tree_bytes(state_s)
+    assert _tree_bytes(params_m) == _tree_bytes(params_s)
+
+
+def test_step_dispatch_donates_input_buffers():
+    # donate_argnums on the step program, asserted at the layer each
+    # half of the contract lives:
+    # (a) the LOWERED donated program declares EVERY (state, params)
+    #     leaf as an input/output alias — that declaration is what lets
+    #     XLA reuse the input HBM in place instead of holding two copies
+    #     of the world tensors (the donation is a may-alias hint: which
+    #     aliases materialize is the backend's buffer-assignment call);
+    # (b) end to end, the dispatch picks the donated program on
+    #     accelerators (inputs whose aliases the executable honors are
+    #     deleted) and the RETAINED twin on XLA:CPU, where donated-buffer
+    #     reuse races the async runtime (see
+    #     stepper._pipeline_step_retained) — on both, the World's own
+    #     device arrays stay live, because _attach copies them into the
+    #     stepper's state
+    import jax
+
+    world = _world(seed=5, n_cells=60)
+    st = _stepper(world)
+    args = _dispatch_args(st)
+    lowered = _pipeline_step.lower(
+        st._state,
+        st.kin.params,
+        *args,
+        det=False,
+        max_div=st.max_divisions,
+        n_rounds=st.n_rounds,
+        compact=False,
+        q=None,
+        use_pallas=False,
+    ).as_text()
+    n_leaves = len(jax.tree_util.tree_leaves((st._state, st.kin.params)))
+    assert lowered.count("tf.aliasing_output") == n_leaves
+
+    state0 = st._state
+    world_mm, world_cm = world._molecule_map, world._cell_molecules
+    st.step()
+    if jax.default_backend() == "cpu":
+        assert st._step_fn() is _pipeline_step_retained
+        assert not state0.key.is_deleted()
+    else:
+        assert st._step_fn() is _pipeline_step
+        assert state0.key.is_deleted()
+    assert not world_mm.is_deleted()
+    assert not world_cm.is_deleted()
+    st.flush()
+    st.check_consistency()
+
+
+def test_megastep_stepper_full_lifecycle():
+    # a K=3 stepper runs the whole lifecycle (spawns, kills, divisions,
+    # compaction, flush) and lands in a consistent world; each dispatch
+    # counts K steps
+    world = _world(seed=9, n_cells=80)
+    st = _stepper(world, megastep=3)
+    assert st.megastep == 3
+    for _ in range(8):
+        st.step()
+    assert st.stats["steps"] == 24
+    assert all(t["k"] == 3 for t in st.trace)
+    st.drain()
+    st.check_consistency()
+    st.flush()
+    st.check_consistency()
+    n = world.n_cells
+    assert n > 0
+    assert len(world.cell_genomes) == n == len(world.cell_labels)
+    pos = world.cell_positions
+    enc = pos[:, 0].astype(np.int64) * world.map_size + pos[:, 1]
+    assert len(np.unique(enc)) == n
+    assert world.cell_map.sum() == n
+
+
+def test_megastep_validation():
+    world = _world(seed=3, n_cells=20)
+    with pytest.raises(ValueError, match="megastep"):
+        _stepper(world, megastep=0)
+    with pytest.raises(ValueError, match="megastep"):
+        _stepper(world, megastep=1.5)
+
+
+def test_world_step_many_matches_serial_calls():
+    # World.step_many(n) == n x (enzymatic_activity();
+    # degrade_and_diffuse_molecules(); increment_cell_lifetimes()) —
+    # bit-identical in det mode, one dispatch instead of 2n
+    N = 4
+    worlds = []
+    for _ in range(2):
+        w = _world(seed=13, map_size=24, n_cells=40)
+        w.deterministic = True
+        worlds.append(w)
+    fused, serial = worlds
+    assert fused.cell_molecules.tobytes() == serial.cell_molecules.tobytes()
+
+    fused.step_many(N)
+    for _ in range(N):
+        serial.enzymatic_activity()
+        serial.degrade_and_diffuse_molecules()
+        serial.increment_cell_lifetimes()
+
+    assert (
+        fused._host_molecule_map().tobytes()
+        == serial._host_molecule_map().tobytes()
+    )
+    assert fused.cell_molecules.tobytes() == serial.cell_molecules.tobytes()
+    assert fused.cell_lifetimes.tolist() == serial.cell_lifetimes.tolist()
+
+
+def test_world_step_many_validation_and_empty_world():
+    world = ms.World(
+        chemistry=ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS),
+        map_size=16,
+        seed=1,
+    )
+    with pytest.raises(ValueError, match="n_steps"):
+        world.step_many(0)
+    mm0 = world._host_molecule_map().copy()
+    world.step_many(3)  # cell-less worlds take the map-only serial path
+    assert world.n_cells == 0
+    assert not np.array_equal(world._host_molecule_map(), mm0)
+
+
+def test_world_step_many_donates_molecule_buffers():
+    world = _world(seed=17, map_size=16, n_cells=20)
+    mm0, cm0 = world._molecule_map, world._cell_molecules
+    world.step_many(2)
+    assert mm0.is_deleted()
+    assert cm0.is_deleted()
+    # the world itself stays fully usable
+    world.enzymatic_activity()
+    assert np.isfinite(world.cell_molecules).all()

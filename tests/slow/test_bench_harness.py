@@ -36,6 +36,14 @@ def _env(**extra) -> dict:
     env.update(
         {
             "MAGICSOUP_BENCH_PLATFORM": "cpu",
+            # share the test suite's persistent compile cache (see
+            # magicsoup_tpu/cache.py): each bench subprocess is a cold
+            # jax process, and warming the step programs from disk is
+            # the difference between minutes and seconds per run here
+            "MAGICSOUP_COMPILE_CACHE_DIR": os.environ.get(
+                "MAGICSOUP_TEST_COMPILE_CACHE",
+                str(Path.home() / ".cache" / "magicsoup-tpu-tests-jax"),
+            ),
             "MAGICSOUP_BENCH_RETRY_BUDGET": "600",
             "MAGICSOUP_BENCH_ATTEMPT_TIMEOUT": "560",
             # private lock file: non-cpu platform values (the

@@ -1,0 +1,111 @@
+"""
+The warm-start contract of the library-level persistent compile cache
+(:mod:`magicsoup_tpu.cache`): a SECOND process stepping the same world
+shapes loads the first process's compiled q-ladder executables from disk
+instead of recompiling them.
+
+Subprocess-driven so each side is a genuinely cold jax process; the
+outcome is asserted on the ``jax.monitoring`` persistent-cache events
+(:func:`magicsoup_tpu.analysis.runtime.persistent_cache_hits`), not on
+wall-clock, so the test is timing-independent.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+# A tiny pipelined run: enough to compile the step program (the q-ladder
+# entry whose multi-second compile is exactly what the cache exists to
+# skip) and report this process's persistent-cache counters.  The
+# listener is installed BEFORE the first jit execution so the counters
+# are process totals.
+_CHILD = """
+import json, random, sys
+
+sys.path.insert(0, {repo!r})
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from magicsoup_tpu.analysis import runtime as rt
+
+rt.install()
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.cache import ensure_compile_cache
+from magicsoup_tpu.stepper import PipelinedStepper
+
+mols = [
+    ms.Molecule("cc-a", 10e3),
+    ms.Molecule("cc-atp", 8e3, half_life=100_000),
+]
+chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+rng = random.Random(3)
+world = ms.World(chemistry=chem, map_size=16, seed=3)
+world.spawn_cells([ms.random_genome(s=200, rng=rng) for _ in range(20)])
+st = PipelinedStepper(
+    world,
+    mol_name="cc-atp",
+    kill_below=0.1,
+    divide_above=3.0,
+    divide_cost=1.0,
+    target_cells=20,
+    genome_size=200,
+    lag=1,
+)
+for _ in range(3):
+    st.step()
+st.flush()
+print(json.dumps({{
+    "cache_dir": ensure_compile_cache(),
+    "hits": rt.persistent_cache_hits(),
+    "misses": rt.persistent_cache_misses(),
+    "compiles": rt.compile_count(),
+}}))
+"""
+
+
+def _run_child(cache_dir: Path) -> dict:
+    env = dict(os.environ)
+    env["MAGICSOUP_COMPILE_CACHE_DIR"] = str(cache_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the child must configure ITS OWN cache via the env override — drop
+    # the test-suite cache variable so conftest settings cannot leak in
+    env.pop("MAGICSOUP_TEST_COMPILE_CACHE", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=str(REPO))],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env=env,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def test_second_process_warms_from_first_processes_cache(tmp_path):
+    cache = tmp_path / "jax-cache"
+
+    cold = _run_child(cache)
+    assert cold["cache_dir"] == str(cache)
+    # a cold process compiles everything: misses, no hits
+    assert cold["hits"] == 0
+    assert cold["misses"] > 0
+    # ...and the expensive entries (the step program clears the 0.5 s
+    # min-compile-time floor by an order of magnitude) landed on disk
+    entries = [p for p in cache.rglob("*") if p.is_file()]
+    assert entries, "first process persisted no cache entries"
+
+    warm = _run_child(cache)
+    # THE contract: the second process loads compiled executables instead
+    # of recompiling the q-ladder — at least the heavy step-program
+    # entries hit, and strictly fewer lookups fall through to a backend
+    # compile than in the cold process
+    assert warm["hits"] >= 1, warm
+    assert warm["misses"] < cold["misses"], (cold, warm)
+    # tracing still happens in both (the in-process jit cache is always
+    # cold at startup); the cache saves the BACKEND compile, not the trace
+    assert warm["compiles"] > 0
