@@ -10,6 +10,7 @@ Findings; registration at the bottom.
 | GL004 | nondeterminism       | seeded reproducibility                     |
 | GL005 | blocking-transfer    | the single audited D2H boundary            |
 | GL006 | missing-donation     | steady-state HBM (step buffers donated)    |
+| GL007 | tolist-in-hot-loop   | batch host conversion (no per-item tolist) |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -95,9 +96,15 @@ RULE_INFO = {
     ),
     "GL006": (
         "missing-donation",
-        "jit over a DeviceState argument without donate_argnums — the "
-        "step returns the successor state, so an undonated input keeps "
-        "TWO copies of the world tensors live in HBM",
+        "jit over a DeviceState/CellParams argument without "
+        "donate_argnums — the program returns the successor buffers, so "
+        "an undonated input keeps TWO copies of the tensors live in HBM",
+    ),
+    "GL007": (
+        "tolist-in-hot-loop",
+        "per-item `.tolist()` inside a loop in a hot-path function — "
+        "each call crosses the C/Python boundary per element; convert "
+        "the whole array ONCE before the loop and slice host lists",
     ),
 }
 
@@ -601,18 +608,18 @@ def _jit_wrapper_kwargs(call: ast.Call) -> dict | None:
 
 
 def check_gl006(ctx: Context):
-    """Step-level jits over a ``DeviceState`` must donate it: the step
-    consumes the state and returns its successor, so without
-    ``donate_argnums`` XLA keeps BOTH generations of every world tensor
-    live (the exact double-buffering the stepper exists to avoid).
-    Covers the decorator spellings (``@jax.jit``,
-    ``@partial(jax.jit, ...)``) and the assignment spelling
-    (``name = partial(jax.jit, ...)(fn)``)."""
+    """Step-level jits over a ``DeviceState`` (or a ``CellParams``
+    pytree — the phenotype scatter path) must donate it: the program
+    consumes the buffers and returns their successors, so without
+    ``donate_argnums`` XLA keeps BOTH generations of every tensor live
+    (the exact double-buffering the stepper exists to avoid).  Covers
+    the decorator spellings (``@jax.jit``, ``@partial(jax.jit, ...)``)
+    and the assignment spelling (``name = partial(jax.jit, ...)(fn)``)."""
     fix = (
-        "add donate_argnums covering the DeviceState parameter (its "
-        "successor is returned, so the buffer can be reused in place); "
-        "annotate intentionally double-buffered programs with "
-        "`# graftlint: disable=GL006`"
+        "add donate_argnums covering the DeviceState/CellParams "
+        "parameter (its successor is returned, so the buffer can be "
+        "reused in place); annotate intentionally double-buffered "
+        "programs with `# graftlint: disable=GL006`"
     )
     for f in ctx.files:
         fns_by_name = {
@@ -652,7 +659,10 @@ def check_gl006(ctx: Context):
                 i
                 for i, a in enumerate(pos)
                 if a.annotation is not None
-                and re.search(r"\bDeviceState\b", ast.unparse(a.annotation))
+                and re.search(
+                    r"\bDeviceState\b|\bCellParams\b",
+                    ast.unparse(a.annotation),
+                )
             ]
             if not state_idxs:
                 continue
@@ -672,12 +682,53 @@ def check_gl006(ctx: Context):
                     "GL006",
                     f,
                     where,
-                    f"jit over `{fn_node.name}` leaves its DeviceState "
+                    f"jit over `{fn_node.name}` leaves its device-pytree "
                     f"argument (position {missing[0]}) undonated — "
-                    "steady-state HBM holds two copies of the world "
-                    "tensors",
+                    "steady-state HBM holds two copies of its tensors",
                     fix,
                 )
+
+
+# --------------------------------------------------------------- GL007
+def check_gl007(ctx: Context):
+    """Per-item ``.tolist()`` inside a loop in a hot function: every
+    call crosses the C/Python boundary and allocates a fresh list for
+    ONE row, so a batch of n items pays n round-trips.  The fast idiom
+    (genetics.translate_genomes) converts the whole array once before
+    the loop and slices host lists inside it.  GL001 already covers the
+    device-tainted case (a blocking D2H per iteration); this rule keeps
+    the host-numpy case out of the hot paths too."""
+    fix = (
+        "hoist the conversion: call `.tolist()` ONCE on the full array "
+        "before the loop and index the resulting host list per item; "
+        "waive a deliberate per-item conversion with "
+        "`# graftlint: disable=GL007`"
+    )
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        seen: set[int] = set()  # nested loops walk the same calls twice
+        for loop in ast.walk(rec.node):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if id(node) in seen:
+                    continue
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "tolist"
+                ):
+                    seen.add(id(node))
+                    yield _finding(
+                        "GL007",
+                        f,
+                        node,
+                        f"`.tolist()` inside a loop in hot function "
+                        f"`{rec.qualname}` converts per item — n "
+                        "iterations pay n C/Python round-trips",
+                        fix,
+                    )
 
 
 CHECKERS = {
@@ -687,6 +738,7 @@ CHECKERS = {
     "GL004": check_gl004,
     "GL005": check_gl005,
     "GL006": check_gl006,
+    "GL007": check_gl007,
 }
 
 

@@ -144,3 +144,40 @@ def hot_path_guard(compile_budget: int = 0, transfers: str = "disallow"):
 def sanctioned_transfer(arr):
     """Explicit device->host fetch; allowed under transfer guards."""
     return jax.device_get(arr)
+
+
+# ----------------------------------------------------------------- #
+# phenotype-cache counters                                          #
+# ----------------------------------------------------------------- #
+# process-wide accumulators fed by every PhenotypeCache instance
+# (genetics.py) — the observability hook the cache-effectiveness smoke
+# and the README's hit-rate guidance read from
+_pheno_hits = 0
+_pheno_misses = 0
+_pheno_evictions = 0
+
+
+def note_phenotype_cache(
+    hits: int = 0, misses: int = 0, evictions: int = 0
+) -> None:
+    """Accumulate phenotype-cache outcomes (called by the cache itself)."""
+    global _pheno_hits, _pheno_misses, _pheno_evictions
+    with _lock:
+        _pheno_hits += hits
+        _pheno_misses += misses
+        _pheno_evictions += evictions
+
+
+def phenotype_cache_stats() -> dict[str, int]:
+    """Process-total genome->phenotype cache outcomes.
+
+    ``hits`` counts genome lookups served from cached entries (including
+    within-batch duplicates after the first occurrence), ``misses``
+    counts unique genomes that had to be translated, ``evictions``
+    counts LRU drops."""
+    with _lock:
+        return {
+            "hits": _pheno_hits,
+            "misses": _pheno_misses,
+            "evictions": _pheno_evictions,
+        }

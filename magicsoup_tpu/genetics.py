@@ -20,11 +20,17 @@ TPU-first deltas:
 """
 import random
 import warnings
+from collections import OrderedDict
+from dataclasses import dataclass, field
 
 import numpy as np
 
 from magicsoup_tpu.constants import CODON_SIZE, ProteinSpecType
-from magicsoup_tpu.native import TranslationTables, translate_genomes_flat
+from magicsoup_tpu.native import (
+    TranslationTables,
+    pack_dense,
+    translate_genomes_flat,
+)
 from magicsoup_tpu.util import codons
 
 
@@ -163,24 +169,28 @@ class Genetics:
         if len(genomes) < 1:
             return []
         prot_counts, prots, doms = self.translate_genomes_flat(genomes)
+        # batched host conversion: ONE .tolist() per buffer plus numpy
+        # cumsum offsets, instead of a per-protein/per-domain .tolist()
+        # in the loop (the per-item form is what graftlint GL007 flags)
+        prot_rows = prots.tolist()
+        dom_rows = doms.tolist()
+        prot_offs = np.concatenate([[0], np.cumsum(prot_counts)]).tolist()
+        dom_offs = np.concatenate(
+            [[0], np.cumsum(prots[:, 3])] if len(prots) else [[0]]
+        ).tolist()
         out: list[list[ProteinSpecType]] = []
-        pi = 0
-        di = 0
-        for count in prot_counts.tolist():
+        for gi in range(len(genomes)):
             proteome: list[ProteinSpecType] = []
-            for _ in range(count):
-                cds_start, cds_end, is_fwd, n_doms = prots[pi].tolist()
+            for pi in range(prot_offs[gi], prot_offs[gi + 1]):
+                cds_start, cds_end, is_fwd, n_doms = prot_rows[pi]
+                d0 = dom_offs[pi]
                 dom_specs = [
-                    (
-                        (int(dt), int(i0), int(i1), int(i2), int(i3)),
-                        int(start),
-                        int(end),
-                    )
-                    for dt, i0, i1, i2, i3, start, end in doms[di : di + n_doms].tolist()
+                    ((dt, i0, i1, i2, i3), start, end)
+                    for dt, i0, i1, i2, i3, start, end in dom_rows[
+                        d0 : d0 + n_doms
+                    ]
                 ]
                 proteome.append((dom_specs, cds_start, cds_end, bool(is_fwd)))
-                pi += 1
-                di += n_doms
             out.append(proteome)
         return out
 
@@ -191,3 +201,151 @@ class Genetics:
     def _get_double_codons(self) -> list[str]:
         seqs = codons(n=2)
         return [d for d in seqs if d[:CODON_SIZE] not in self.stop_codons]
+
+
+@dataclass
+class PhenotypeEntry:
+    """One cached genome phenotype: the flat translation buffers plus the
+    packed dense token row per assembly rung it has been packed at."""
+
+    n_prots: int
+    max_doms: int  # max domains over this genome's proteins (0 if none)
+    prots: np.ndarray  # (n_prots, 4) i32 [cds_start, cds_end, is_fwd, n_doms]
+    doms: np.ndarray  # (sum n_doms, 7) i32
+    # (p_cap, d_cap) -> (p_cap, d_cap, 5) i16 dense token row
+    dense: dict = field(default_factory=dict)
+
+
+class PhenotypeCache:
+    """
+    Content-addressed genome -> phenotype cache, LRU-bounded.
+
+    Entries are keyed by the genome STRING and hold the flat translation
+    buffers plus packed dense token rows per assembly rung, so a batch
+    with repeated genomes (spawn bursts from shared seeds, division
+    daughters, mutation no-ops) translates and packs each unique genome
+    once, and a genome seen in an earlier step skips both entirely.
+
+    Byte-identity contract: cached rows come from the same
+    ``pack_dense`` call a cold path would make and are never mutated, so
+    cached and uncached parameter assembly are BIT-identical (pinned by
+    tests/fast/test_kinetics.py).
+
+    ``maxsize <= 0`` disables cross-call caching: lookups still dedupe
+    within the batch, but nothing is retained.  Counters (``hits`` /
+    ``misses`` / ``evictions``) count per genome occurrence and also
+    accumulate into the process-wide
+    :func:`magicsoup_tpu.analysis.runtime.phenotype_cache_stats`.
+    """
+
+    def __init__(self, genetics: Genetics, maxsize: int = 16384):
+        self.genetics = genetics
+        self.maxsize = int(maxsize)
+        self._entries: OrderedDict[str, PhenotypeEntry] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        """Drop all entries (counters are kept)."""
+        self._entries.clear()
+
+    # graftlint: hot
+    def lookup(self, genomes: list[str]) -> list[PhenotypeEntry]:
+        """Entries for ``genomes`` (one per input, duplicates aliased);
+        unique misses are translated in ONE engine batch."""
+        unique: list[str] = []
+        seen: set[str] = set()
+        for g in genomes:
+            if g not in seen:
+                seen.add(g)
+                unique.append(g)
+        entries: dict[str, PhenotypeEntry] = {}
+        misses: list[str] = []
+        for g in unique:
+            e = self._entries.get(g)
+            if e is None:
+                misses.append(g)
+            else:
+                self._entries.move_to_end(g)
+                entries[g] = e
+        if misses:
+            pc, prots, doms = self.genetics.translate_genomes_flat(misses)
+            dom_counts = (
+                prots[:, 3]
+                if len(prots)
+                else np.zeros(0, dtype=np.int32)
+            )
+            p_offs = np.concatenate([[0], np.cumsum(pc)])
+            d_offs = np.concatenate([[0], np.cumsum(dom_counts)])
+            for i, g in enumerate(misses):
+                p0, p1 = int(p_offs[i]), int(p_offs[i + 1])
+                d0, d1 = int(d_offs[p0]), int(d_offs[p1])
+                e = PhenotypeEntry(
+                    n_prots=p1 - p0,
+                    max_doms=(
+                        int(dom_counts[p0:p1].max()) if p1 > p0 else 0
+                    ),
+                    prots=np.ascontiguousarray(prots[p0:p1]),
+                    doms=np.ascontiguousarray(doms[d0:d1]),
+                )
+                entries[g] = e
+                self._store(g, e)
+        n_hits = len(genomes) - len(misses)
+        self.hits += n_hits
+        self.misses += len(misses)
+        _note_phenotype_cache(hits=n_hits, misses=len(misses))
+        return [entries[g] for g in genomes]
+
+    # graftlint: hot
+    def dense_rows(
+        self, entries: list[PhenotypeEntry], p_cap: int, d_cap: int
+    ) -> np.ndarray:
+        """Stack the entries' dense token rows at rung ``(p_cap, d_cap)``
+        into one (b, p_cap, d_cap, 5) i16 batch; rows not yet packed at
+        this rung are packed in ONE engine batch and memoized on their
+        entries."""
+        key = (int(p_cap), int(d_cap))
+        missing: list[PhenotypeEntry] = []
+        seen: set[int] = set()
+        for e in entries:
+            if key not in e.dense and id(e) not in seen:
+                seen.add(id(e))
+                missing.append(e)
+        if missing:
+            pc = np.fromiter(
+                (e.n_prots for e in missing), dtype=np.int32,
+                count=len(missing),
+            )
+            prots = np.concatenate([e.prots for e in missing])
+            doms = np.concatenate([e.doms for e in missing])
+            dense = pack_dense(pc, prots, doms, key[0], key[1])
+            for i, e in enumerate(missing):
+                e.dense[key] = dense[i]
+        if not entries:
+            return np.zeros((0, key[0], key[1], 5), dtype=np.int16)
+        return np.stack([e.dense[key] for e in entries])
+
+    def _store(self, genome: str, entry: PhenotypeEntry) -> None:
+        if self.maxsize <= 0:
+            return
+        self._entries[genome] = entry
+        evicted = 0
+        while len(self._entries) > self.maxsize:
+            self._entries.popitem(last=False)
+            evicted += 1
+        if evicted:
+            self.evictions += evicted
+            _note_phenotype_cache(evictions=evicted)
+
+
+def _note_phenotype_cache(**kwargs) -> None:
+    """Forward counters to the runtime metrics layer (imported lazily —
+    :mod:`magicsoup_tpu.analysis.runtime` pulls in jax, which this
+    host-only module otherwise never needs)."""
+    from magicsoup_tpu.analysis.runtime import note_phenotype_cache
+
+    note_phenotype_cache(**kwargs)

@@ -8,6 +8,7 @@ lookup-table containers).
 from magicsoup_tpu.native.engine import (
     TranslationTables,
     has_native,
+    pack_dense,
     point_mutations,
     recombinations,
     translate_genomes_flat,
@@ -16,6 +17,7 @@ from magicsoup_tpu.native.engine import (
 __all__ = [
     "TranslationTables",
     "has_native",
+    "pack_dense",
     "point_mutations",
     "recombinations",
     "translate_genomes_flat",

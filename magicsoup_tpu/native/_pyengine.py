@@ -281,6 +281,7 @@ def point_mutations_flat(
         positions = np.sort(rng.choice(n, size=n_muts, replace=False))
         chars = list(seq)
         offset = 0
+        # graftlint: disable=GL007 indel offsets shift per mutation; the scalar loop IS the algorithm (fallback path)
         for pos in positions.tolist():
             cur = pos + offset
             if rng.random() < p_indel:
@@ -329,18 +330,52 @@ def recombinations_flat(
 
         parts: list[str] = []
         i = 0
+        # graftlint: disable=GL007 per-pair cut lists are tiny; this is the pure-python fallback, native engine is primary
         for j in cut_positions[cut_positions < n0].tolist():
             parts.append(seq0[i:j])
             i = j
         parts.append(seq0[i:])
         i = 0
+        # graftlint: disable=GL007 see above: per-pair fallback loop
         for j in (cut_positions[cut_positions >= n0] - n0).tolist():
             parts.append(seq1[i:j])
             i = j
         parts.append(seq1[i:])
 
         order = rng.permutation(len(parts))
-        parts = [parts[k] for k in order.tolist()]
+        parts = [parts[k] for k in order.tolist()]  # graftlint: disable=GL007 per-pair fallback shuffle
         s = int(rng.integers(len(parts)))
         out.append(("".join(parts[:s]), "".join(parts[s:]), idx))
     return out
+
+
+def pack_dense(
+    prot_counts: np.ndarray,
+    prots: np.ndarray,
+    doms: np.ndarray,
+    p_cap: int,
+    d_cap: int,
+) -> np.ndarray:
+    """Pack flat translation buffers into the padded dense token tensor
+    (b, p_cap, d_cap, 5) int16 [dom_type, i0, i1, i2, i3] — the numpy
+    fallback of the native ``ms_pack_dense`` (vectorized scatter via the
+    repeat/cumsum index expansion)."""
+    b = len(prot_counts)
+    dense = np.zeros((b, p_cap, d_cap, 5), dtype=np.int16)
+    if len(doms) == 0:
+        return dense
+    n_doms_per_prot = prots[:, 3]
+    # cell index of each protein / protein index within its cell
+    prot_cell = np.repeat(np.arange(b, dtype=np.int64), prot_counts)
+    prot_starts = np.concatenate([[0], np.cumsum(prot_counts)])[:-1]
+    prot_in_cell = np.arange(len(prots), dtype=np.int64) - np.repeat(
+        prot_starts, prot_counts
+    )
+    # protein index of each domain / domain index within its protein
+    dom_prot = np.repeat(np.arange(len(prots), dtype=np.int64), n_doms_per_prot)
+    dom_starts = np.concatenate([[0], np.cumsum(n_doms_per_prot)])[:-1]
+    dom_in_prot = np.arange(len(doms), dtype=np.int64) - np.repeat(
+        dom_starts, n_doms_per_prot
+    )
+    dense[prot_cell[dom_prot], prot_in_cell[dom_prot], dom_in_prot] = doms[:, :5]
+    return dense

@@ -90,6 +90,14 @@ def _declare_abi(lib):
         ctypes.POINTER(_i32p), _i64p,  # doms, n_doms
     ]
     lib.ms_translate_genomes.restype = None
+    lib.ms_pack_dense.argtypes = [
+        _i32p, ctypes.c_int64,  # prot_counts, b
+        _i32p, ctypes.c_int64,  # prots, n_prots
+        _i32p, ctypes.c_int64,  # doms, n_doms
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int,  # p_cap, d_cap, threads
+        ctypes.POINTER(ctypes.c_int16),  # out_dense (caller-allocated, zeroed)
+    ]
+    lib.ms_pack_dense.restype = None
     lib.ms_point_mutations.argtypes = [
         _charp, _i64p, ctypes.c_int64,
         _i64p,  # pre-drawn per-seq mutation counts
@@ -187,6 +195,47 @@ def translate_genomes_flat(
         lib.ms_free(out_prots)
         lib.ms_free(out_doms)
     return prot_counts, prots, doms
+
+
+def pack_dense(
+    prot_counts: np.ndarray,
+    prots: np.ndarray,
+    doms: np.ndarray,
+    p_cap: int,
+    d_cap: int,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """
+    Pack flat translation buffers into the padded dense token tensor
+    ``(b, p_cap, d_cap, 5)`` int16 — OpenMP in the native engine,
+    vectorized numpy scatter in the fallback.  Both produce identical
+    bytes.  Proteins/domains must fit the caps (callers grow capacities
+    for every batch of a dispatch first — the capacity rule of
+    :meth:`Kinetics.ensure_token_capacity`).
+    """
+    lib = get_lib()
+    if lib is None:
+        return _pyengine.pack_dense(prot_counts, prots, doms, p_cap, d_cap)
+    b = len(prot_counts)
+    counts = np.ascontiguousarray(prot_counts, dtype=np.int32)
+    prots_c = np.ascontiguousarray(prots, dtype=np.int32)
+    doms_c = np.ascontiguousarray(doms, dtype=np.int32)
+    dense = np.zeros((b, int(p_cap), int(d_cap), 5), dtype=np.int16)
+    if b == 0 or len(doms_c) == 0:
+        return dense
+    lib.ms_pack_dense(
+        counts.ctypes.data_as(_i32p),
+        b,
+        prots_c.ctypes.data_as(_i32p),
+        len(prots_c),
+        doms_c.ctypes.data_as(_i32p),
+        len(doms_c),
+        int(p_cap),
+        int(d_cap),
+        n_threads,
+        dense.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+    )
+    return dense
 
 
 def _unpack_seqs(

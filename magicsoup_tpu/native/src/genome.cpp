@@ -251,6 +251,51 @@ void ms_translate_genomes(const char* data, const int64_t* offsets, int64_t n,
   *out_n_doms = total_doms;
 }
 
+// Pack flat translation buffers into the padded dense token tensor
+// (b, p_cap, d_cap, 5) int16 [dom_type, i0, i1, i2, i3] consumed by the
+// jitted parameter assembly — the native counterpart of the numpy scatter
+// in ops/params.flat_to_dense.  out_dense is caller-allocated and
+// ZEROED (b * p_cap * d_cap * 5 int16 entries); proteins/domains beyond
+// the caps must not occur (the caller grows capacities per batch first).
+void ms_pack_dense(const int32_t* prot_counts, int64_t b,
+                   const int32_t* prots, int64_t n_prots,
+                   const int32_t* doms, int64_t n_doms,
+                   int64_t p_cap, int64_t d_cap, int n_threads,
+                   int16_t* out_dense) {
+  (void)n_doms;
+  // per-genome protein row offsets (serial cumsum; b is small)
+  std::vector<int64_t> prot_offs((size_t)b + 1, 0);
+  for (int64_t gi = 0; gi < b; ++gi)
+    prot_offs[(size_t)gi + 1] = prot_offs[(size_t)gi] + prot_counts[gi];
+  // per-protein domain row offsets
+  std::vector<int64_t> dom_offs((size_t)n_prots + 1, 0);
+  for (int64_t pi = 0; pi < n_prots; ++pi)
+    dom_offs[(size_t)pi + 1] = dom_offs[(size_t)pi] + prots[4 * pi + 3];
+
+  const int64_t cell_stride = p_cap * d_cap * 5;
+#if defined(_OPENMP)
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#pragma omp parallel for schedule(dynamic, 64)
+#endif
+  for (int64_t gi = 0; gi < b; ++gi) {
+    int16_t* cell = out_dense + gi * cell_stride;
+    const int64_t p0 = prot_offs[(size_t)gi], p1 = prot_offs[(size_t)gi + 1];
+    for (int64_t pi = p0; pi < p1; ++pi) {
+      int16_t* prot = cell + (pi - p0) * d_cap * 5;
+      const int64_t d0 = dom_offs[(size_t)pi], d1 = dom_offs[(size_t)pi + 1];
+      for (int64_t di = d0; di < d1; ++di) {
+        const int32_t* src = doms + 7 * di;
+        int16_t* dst = prot + (di - d0) * 5;
+        dst[0] = (int16_t)src[0];
+        dst[1] = (int16_t)src[1];
+        dst[2] = (int16_t)src[2];
+        dst[3] = (int16_t)src[3];
+        dst[4] = (int16_t)src[4];
+      }
+    }
+  }
+}
+
 namespace {
 
 const char MUT_NTS[4] = {'A', 'C', 'T', 'G'};

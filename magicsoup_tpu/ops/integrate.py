@@ -344,6 +344,7 @@ def _integrate_part(
     return _equilibrium_adjusted_x(X0, X1, p.N, W, V, p, det)
 
 
+# graftlint: disable=GL006 params is read-only here; the signal matrix X is the successor (donated in the steps variant)
 @partial(jax.jit, static_argnames=("det",))
 def _integrate_signals_jit(
     X: jax.Array, params: CellParams, det: bool
@@ -383,6 +384,7 @@ def integrate_signals(
 # X is donated: the scan consumes the signal matrix and returns its
 # successor, so the n_steps burst updates it in place instead of holding
 # two (c, s) copies for its whole duration
+# graftlint: disable=GL006 params is read-only; X (the successor) is donated
 @partial(jax.jit, static_argnames=("n_steps", "det"), donate_argnums=(0,))
 def _integrate_signals_steps_jit(
     X: jax.Array, params: CellParams, n_steps: int, det: bool

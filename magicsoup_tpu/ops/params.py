@@ -28,6 +28,17 @@ from magicsoup_tpu.constants import EPS, GAS_CONSTANT, MAX
 from magicsoup_tpu.ops.detmath import det_div, det_exp, ipow, sum_axis
 from magicsoup_tpu.ops.integrate import INT_PARAM_DTYPE, CellParams
 
+# floors of the per-cell assembly rung grid (see Kinetics.set_cell_params_flat):
+# cells are grouped by the pow2 sizes that actually cover their proteome —
+# (pad_pow2(n_proteins), pad_pow2(max domains per protein)) — and each group's
+# compute runs at that rung instead of the world's grow-only worst-case
+# capacities.  The floors bound the number of compiled variants (p rungs
+# {16, 32, ...}, d rungs {4, 8, ...}) while still capturing the bulk of the
+# win: at benchmark genomes ~95% of cells fit (32, 4) while the capacities
+# sit at (64, 16) — a ~7x cut of the (b, p, d, s) assembly volume
+RUNG_P_MIN = 16
+RUNG_D_MIN = 4
+
 
 class TokenTables(NamedTuple):
     """Token -> parameter lookup tables (row 0 = empty/zero token)."""
@@ -228,6 +239,7 @@ def compute_cell_params(
     )
 
 
+# graftlint: disable=GL006 inlined into donated assemble/megastep callers; direct eager calls are cold one-off scatters
 @jax.jit
 def scatter_params(
     state: CellParams, batch: CellParams, cell_idxs: jax.Array
@@ -241,6 +253,7 @@ def scatter_params(
     )
 
 
+# graftlint: disable=GL006 cold reference path (tests/fallbacks); hot scatters go through the donated assemble twins
 @jax.jit
 def compute_and_scatter_params(
     state: CellParams,
@@ -258,6 +271,83 @@ def compute_and_scatter_params(
     )
 
 
+def rung_pow2(values: np.ndarray, minimum: int, cap: int) -> np.ndarray:
+    """Vectorized pow2 rung per value, floored at ``minimum`` and clamped
+    to ``cap`` — the group key of the rung-grouped assembly."""
+    v = np.maximum(np.asarray(values, dtype=np.int64), 1)
+    rung = np.power(2, np.ceil(np.log2(v)).astype(np.int64))
+    return np.minimum(np.maximum(rung, minimum), cap).astype(np.int64)
+
+
+def _assemble_rows(
+    state: CellParams,
+    dense: jax.Array,
+    tables: TokenTables,
+    abs_temp: jax.Array,
+    cell_idxs: jax.Array,
+) -> CellParams:
+    """:func:`compute_cell_params` at the dense batch's OWN (p, d) rung,
+    padded back out to the state's protein capacity, then scattered.
+
+    The pad rows use the values the full-capacity compute produces for
+    all-zero token slots (Ke=1, Kmf=Kmb=EPS, Kmr=1, the rest 0) — derived
+    in-program from a zero token so rung-grouped assembly stays
+    BIT-identical to assembling every cell at worst-case capacities
+    (pinned by tests/fast/test_kinetics.py)."""
+    batch = compute_cell_params(dense, tables, abs_temp)
+    p_cap = state.Vmax.shape[1]
+    pad = p_cap - batch.Vmax.shape[1]
+    if pad:
+        b = dense.shape[0]
+        fills = compute_cell_params(
+            jnp.zeros((1, 1, 1, 5), dtype=dense.dtype), tables, abs_temp
+        )
+        batch = CellParams(
+            *(
+                jnp.concatenate(
+                    [x, jnp.broadcast_to(f[:, :1], (b, pad) + x.shape[2:])],
+                    axis=1,
+                )
+                for x, f in zip(batch, fills)
+            )
+        )
+    return scatter_params(state, batch, cell_idxs)
+
+
+def _assemble_rows_scan(
+    state: CellParams,
+    dense: jax.Array,  # (n_chunks, chunk, p, d, 5)
+    tables: TokenTables,
+    abs_temp: jax.Array,
+    cell_idxs: jax.Array,  # (n_chunks, chunk)
+) -> CellParams:
+    """:func:`_assemble_rows` folded over row chunks with ``lax.scan`` —
+    a big spawn burst is ONE dispatch carrying the params through the
+    chunks instead of one dispatch (and, undonated, one full-pytree
+    copy) per chunk."""
+
+    def body(st: CellParams, xs):
+        d, i = xs
+        return _assemble_rows(st, d, tables, abs_temp, i), ()
+
+    out, _ = jax.lax.scan(body, state, (dense, cell_idxs))
+    return out
+
+
+# Donated variants for accelerator backends: steady-state assembly holds
+# ONE params copy (the scan carry aliases the input buffers) instead of
+# double-buffering the full pytree per chunk.  XLA:CPU races donated-buffer
+# reuse against its async runtime (BENCH_NOTES.md "CPU donation
+# corruption"), so Kinetics dispatches the retained twins there — exactly
+# the stepper's donation gate (stepper._donate_step_buffers).
+assemble_params = partial(jax.jit, donate_argnums=(0,))(_assemble_rows)
+assemble_params_scan = partial(jax.jit, donate_argnums=(0,))(_assemble_rows_scan)
+# retained twins — graftlint: disable=GL006 XLA:CPU donated-buffer reuse races async execution; accelerator dispatches use the donated builds above
+assemble_params_retained = jax.jit(_assemble_rows)  # graftlint: disable=GL006 CPU retained twin of assemble_params
+assemble_params_scan_retained = jax.jit(_assemble_rows_scan)  # graftlint: disable=GL006 CPU retained twin of assemble_params_scan
+
+
+# graftlint: disable=GL006 fires on discrete unset events, not per step; CPU in-place scatter reuse races (see assemble twins)
 @jax.jit
 def unset_params(state: CellParams, cell_idxs: jax.Array) -> CellParams:
     """Zero parameter rows at cell_idxs (OOB = dropped)."""
@@ -269,6 +359,7 @@ def unset_params(state: CellParams, cell_idxs: jax.Array) -> CellParams:
     )
 
 
+# graftlint: disable=GL006 fires on discrete divide events; self-referencing gather+scatter cannot alias in place
 @jax.jit
 def copy_params(
     state: CellParams, from_idxs: jax.Array, to_idxs: jax.Array
@@ -291,6 +382,7 @@ def compact_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Arra
     return jnp.where(keep, out, jnp.zeros((), dtype=out.dtype))
 
 
+# graftlint: disable=GL006 compaction gather cannot alias in place (arbitrary row permutation); fires on kill events only
 @jax.jit
 def permute_params(state: CellParams, perm: jax.Array, n_keep: jax.Array) -> CellParams:
     """:func:`compact_rows` over all nine parameter tensors."""

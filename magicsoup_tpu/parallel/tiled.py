@@ -186,6 +186,7 @@ def make_sharded_step(
     replicated = NamedSharding(mesh, P())
     param_shardings = CellParams(*(cell_sh for _ in CellParams._fields))
 
+    # graftlint: disable=GL006 params is read-only; only (molecule_map, cell_molecules) successors are returned
     @partial(
         jax.jit,
         in_shardings=(map_sh, cell_sh, cell_sh, replicated, param_shardings),

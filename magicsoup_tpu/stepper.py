@@ -1064,14 +1064,16 @@ class PipelinedStepper:
         )
 
         # spawn batch + riding parameter refreshes for this dispatch:
-        # translate BOTH first, grow token capacities for both, and only
-        # then densify — one batch's protein-capacity growth must not
-        # invalidate the other's already-built dense tensor
+        # translate BOTH first (through the phenotype cache — spawn
+        # bursts from shared seed genomes dedupe to one translation),
+        # grow token capacities for both, and only then densify — one
+        # batch's protein-capacity growth must not invalidate the
+        # other's already-built dense tensor
         spawn = self._spawn_queue[: self.spawn_block]
         self._spawn_queue = self._spawn_queue[len(spawn) :]
         has_spawn = len(spawn) > 0
-        spawn_flat = (
-            self.world.genetics.translate_genomes_flat([g for g, _ in spawn])
+        spawn_entries = (
+            self.world.phenotypes.lookup([g for g, _ in spawn])
             if has_spawn
             else None
         )
@@ -1083,12 +1085,17 @@ class PipelinedStepper:
             # permutation
             self._push_buffer += self._push_queue
             self._push_queue = []
-        for flat in (spawn_flat, ride[0] if ride else None):
-            if flat is not None:
-                self.kin.ensure_token_capacity(flat[0], flat[1])
+        for ent in (spawn_entries, ride[0] if ride else None):
+            if ent:
+                self.kin.ensure_token_limits(
+                    max(e.n_prots for e in ent),
+                    max(e.max_doms for e in ent),
+                )
 
         if has_spawn:
-            dense = self.kin.build_dense_tokens(*spawn_flat)
+            dense = self.world.phenotypes.dense_rows(
+                spawn_entries, self.kin.max_proteins, self.kin.max_doms
+            )
             pad = np.zeros(
                 (self.spawn_block,) + dense.shape[1:], dtype=dense.dtype
             )
@@ -1542,16 +1549,14 @@ class PipelinedStepper:
         """Apply one refresh batch with its own standalone program (used
         for oversized bursts and at flush, when no step dispatch
         follows)."""
-        prot_counts, prots, doms = (
-            self.world.genetics.translate_genomes_flat(genomes)
-        )
-        self.kin.set_cell_params_flat(rows, prot_counts, prots, doms)
+        entries = self.world.phenotypes.lookup(genomes)
+        self.kin.set_cell_params_cached(rows, entries, self.world.phenotypes)
         self._dispatched_seq = max(self._dispatched_seq, seq)
         self.stats["pushes"] += 1
 
     def _take_ride_push(self):
         """Pop queued refreshes (in order) up to the fixed riding block
-        and return their translated flat buffers + rows, or None.  The
+        and return their phenotype-cache entries + rows, or None.  The
         block size is FIXED so the fused step program compiles for at
         most one push shape; a batch bigger than the block gets its own
         standalone dispatch (rare burst), and queue order is never
@@ -1583,17 +1588,18 @@ class PipelinedStepper:
             top_seq = max(top_seq, seq)
         rows = sorted(merged)
         genomes = [merged[r] for r in rows]
-        flat = self.world.genetics.translate_genomes_flat(genomes)
+        entries = self.world.phenotypes.lookup(genomes)
         self._dispatched_seq = top_seq
         self.stats["pushes"] += 1
-        return flat, rows
+        return entries, rows
 
-    def _densify_push(self, flat, rows):
-        """Flat buffers -> (dense, rows) device inputs at the FIXED push
+    def _densify_push(self, entries, rows):
+        """Cache entries -> (dense, rows) device inputs at the FIXED push
         block shape.  Separate from :meth:`_take_ride_push` so all of a
         dispatch's capacity growth happens before any densify."""
-        prot_counts, prots, doms = flat
-        dense = self.kin.build_dense_tokens(prot_counts, prots, doms)
+        dense = self.world.phenotypes.dense_rows(
+            entries, self.kin.max_proteins, self.kin.max_doms
+        )
         dense_pad = np.zeros(
             (self.push_block,) + dense.shape[1:], dtype=dense.dtype
         )

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from magicsoup_tpu.containers import Cell, Chemistry
-from magicsoup_tpu.genetics import Genetics
+from magicsoup_tpu.genetics import Genetics, PhenotypeCache
 from magicsoup_tpu.kinetics import Kinetics
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import diffusion as _diff
@@ -224,6 +224,7 @@ def _degrade_diffuse_permeate(
     )
 
 
+# graftlint: disable=GL006 params is read-only in the step burst; the (map, molecules) successors ARE donated below
 @functools.partial(
     jax.jit,
     static_argnames=("det", "pallas", "n_steps", "q"),
@@ -292,6 +293,7 @@ def _add_at(
     return cell_molecules.at[idxs, col].add(delta, mode="drop")
 
 
+# graftlint: disable=GL006 compaction gather cannot alias in place; fires on kill events, not per step
 @jax.jit
 def _kill_update(
     molecule_map: jax.Array,
@@ -317,6 +319,7 @@ def _kill_update(
     return new_map, new_cm, permute_params(params, perm, n_keep), new_pos
 
 
+# graftlint: disable=GL006 self-referencing parent->child copies cannot alias in place; fires on divide events only
 @jax.jit
 def _divide_update(
     cell_molecules: jax.Array,
@@ -398,6 +401,9 @@ class World:
             (bounds memory peaks of spawn/update at many cells).
         seed: Seed driving all randomness (placement, token maps,
             mutations).  ``None`` draws a random seed.
+        phenotype_cache_size: Max entries of the genome->phenotype LRU
+            cache (``World.phenotypes``); ``0`` disables cross-call
+            caching.  Cached and uncached paths are bit-identical.
 
     State is exposed with the reference's names — ``cell_genomes``,
     ``cell_labels``, ``cell_map``, ``cell_positions``, ``cell_lifetimes``,
@@ -420,6 +426,7 @@ class World:
         seed: int | None = None,
         mesh: "jax.sharding.Mesh | None" = None,
         use_pallas: bool | None = None,
+        phenotype_cache_size: int = 16384,
     ):
         if seed is None:
             seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
@@ -503,6 +510,11 @@ class World:
             start_codons=start_codons,
             stop_codons=stop_codons,
             seed=self._rng.randrange(2**63),
+        )
+        # genome -> phenotype LRU (no RNG draw: construction here must not
+        # shift the seed-derived stream feeding Kinetics below)
+        self.phenotypes = PhenotypeCache(
+            self.genetics, maxsize=phenotype_cache_size
         )
         self.kinetics = Kinetics(
             chemistry=chemistry,
@@ -1467,34 +1479,45 @@ class World:
     # parameter updates                                                  #
     # ------------------------------------------------------------------ #
 
+    # graftlint: hot
     def _update_cell_params(self, genomes: list[str], idxs: list[int]):
-        """Translate genomes and write kinetic parameters for these cells
-        (reference world.py:880-908)."""
-        prot_counts, prots, doms = self.genetics.translate_genomes_flat(genomes)
+        """Translate genomes — through the phenotype cache, so repeated
+        genomes translate/pack once — and write kinetic parameters for
+        these cells (reference world.py:880-908)."""
         idxs_arr = np.asarray(idxs, dtype=np.int32)
-        has_prots = prot_counts > 0
-        unset_idxs = idxs_arr[~has_prots]
+        if len(idxs_arr) == 0:
+            return
+        if len(np.unique(idxs_arr)) != len(idxs_arr):
+            # duplicate target slots (e.g. repeated update pairs): pin
+            # last-wins — rung grouping reorders the scatters, so earlier
+            # duplicates are dropped up front
+            _, keep = np.unique(idxs_arr[::-1], return_index=True)
+            keep = np.sort(len(idxs_arr) - 1 - keep)
+            idxs_arr = idxs_arr[keep]
+            genomes = [genomes[i] for i in keep]
+        entries = self.phenotypes.lookup(genomes)
+        has_prots = np.fromiter(
+            (e.n_prots > 0 for e in entries),
+            dtype=bool,
+            count=len(entries),
+        )
+        self.kinetics.unset_cell_params(idxs_arr[~has_prots])
         set_idxs = idxs_arr[has_prots]
-
-        self.kinetics.unset_cell_params(unset_idxs)
         if len(set_idxs) == 0:
             return
-
-        set_counts = prot_counts[has_prots]
+        set_entries = [e for e, h in zip(entries, has_prots) if h]
+        # capacity rule: grow for the WHOLE dispatch before packing any
+        # batch of it, so no batch's growth invalidates another's rows
+        self.kinetics.ensure_token_limits(
+            max(e.n_prots for e in set_entries),
+            max(e.max_doms for e in set_entries),
+        )
         batch = self.batch_size or len(set_idxs)
         # chunk over cells to bound assembly memory peaks
-        prot_offs = np.concatenate([[0], np.cumsum(set_counts)])
-        dom_counts_per_prot = prots[:, 3]
-        dom_offs = np.concatenate([[0], np.cumsum(dom_counts_per_prot)])
         for a in range(0, len(set_idxs), batch):
             b = min(a + batch, len(set_idxs))
-            pa, pb = prot_offs[a], prot_offs[b]
-            da, db = dom_offs[pa], dom_offs[pb]
-            self.kinetics.set_cell_params_flat(
-                set_idxs[a:b],
-                set_counts[a:b],
-                prots[pa:pb],
-                doms[da:db],
+            self.kinetics.set_cell_params_cached(
+                set_idxs[a:b], set_entries[a:b], self.phenotypes
             )
 
     # ------------------------------------------------------------------ #
@@ -1513,6 +1536,10 @@ class World:
         state.pop("_col_prefetch", None)
         state["_mm_cache"] = None
         state["_cm_cache"] = None
+        # the phenotype cache is runtime state: entries re-fill on demand
+        # and pickling cached rows would bloat saves — persist the knob only
+        state["phenotypes"] = None
+        state["_phenotype_cache_size"] = self.phenotypes.maxsize
         # WarmScheduler pickles itself empty (thread handles are not
         # picklable; warm state is runtime-local)
         # meshes/shardings/devices are bound to live runtimes — a restored
@@ -1548,6 +1575,11 @@ class World:
             self.use_pallas = False
         self.__dict__.setdefault("_mm_cache", None)
         self.__dict__.setdefault("_cm_cache", None)
+        _pheno_size = self.__dict__.pop("_phenotype_cache_size", 16384)
+        if self.__dict__.get("phenotypes") is None:
+            self.phenotypes = PhenotypeCache(
+                self.genetics, maxsize=_pheno_size
+            )
         if "_warm_sched" not in self.__dict__:
             self._warm_sched = WarmScheduler()
         self.__dict__.setdefault("_mesh", None)

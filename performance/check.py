@@ -4,14 +4,19 @@ harness (`performance/check.py:48-182`): spawn_cells, update_cells,
 divide_cells (replicate), enzymatic_activity, and
 mutations+neighbors+recombinations, at 10k cells with 1k-bp genomes.
 
-    python performance/check.py [--n 10000] [--s 1000] [--r 5]
+    python performance/check.py [--n 10000] [--s 1000] [--r 5] [--json]
 
 Reference numbers to compare against (see BASELINE.md): on a g4dn.xlarge
 CUDA GPU the reference measured 6.64 s spawn, 5.95 s update, 0.28 s
 replicate, 0.16 s enzymatic activity, 0.46 s mutations.
 
 Runs on whatever device JAX finds; timings block on device results.
+
+``--json`` streams one JSON result line per op (seconds, lower is
+better) alongside the human lines; `scripts/summarize_capture.py` folds
+a `check.log` of these into BASELINE.json's per-op trend record.
 """
+import json
 import random
 import statistics
 import sys
@@ -28,19 +33,51 @@ def _summary(tds: list[float]) -> str:
     return f"({mu:.2f}+-{sd:.2f})s"
 
 
+def result_row(
+    op: str,
+    tds: list[float],
+    n_cells: int,
+    genome_size: int,
+    backend: str,
+) -> dict:
+    """The structured form of one op's measurement — seconds per op
+    call, LOWER is better (``"unit": "s"``), unlike the steps/s
+    headline rows.  Parsing is pinned by tests/fast/test_bench_parsing.py."""
+    return {
+        "metric": (
+            f"check.{op} ({n_cells} cells, {genome_size} nt, {backend})"
+        ),
+        "op": op,
+        "value": round(statistics.fmean(tds), 4),
+        "unit": "s",
+        "sd": round(statistics.pstdev(tds), 4),
+        "repeats": len(tds),
+        "n_cells": n_cells,
+        "genome_size": genome_size,
+        "backend": backend,
+    }
+
+
 def main() -> None:
     ap = ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000, help="number of cells")
     ap.add_argument("--s", type=int, default=1_000, help="genome size")
     ap.add_argument("--r", type=int, default=5, help="repeats")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="also print one JSON result line per op",
+    )
     args = ap.parse_args()
 
     import jax
 
     from bench import apply_platform_pin
+    from magicsoup_tpu.cache import ensure_compile_cache
 
     apply_platform_pin(jax)
+    ensure_compile_cache()
 
     import numpy as np
 
@@ -60,12 +97,20 @@ def main() -> None:
         float(world._cell_molecules[0, 0])
         float(world.kinetics.params.Vmax[0, 0])
 
+    backend = jax.devices()[0].platform
     print(
         f"Benchmarking spawn_cells, update_cells, divide_cells, "
         f"enzymatic_activity, mutations\n"
-        f"{args.n:,} cells, {args.s:,} genome size, "
-        f"on {jax.devices()[0].platform}"
+        f"{args.n:,} cells, {args.s:,} genome size, on {backend}"
     )
+
+    def emit(op: str, tds: list[float], label: str) -> None:
+        print(f"{_summary(tds)} - {label}")
+        if args.json:
+            print(
+                json.dumps(result_row(op, tds, args.n, args.s, backend)),
+                flush=True,
+            )
 
     # -- spawn
     tds = []
@@ -76,7 +121,7 @@ def main() -> None:
         world.spawn_cells(genomes=genomes)
         sync(world)
         tds.append(time.perf_counter() - t0)
-    print(f"{_summary(tds)} - spawn cells")
+    emit("spawn_cells", tds, "spawn cells")
 
     # -- update
     tds = []
@@ -89,7 +134,7 @@ def main() -> None:
         world.update_cells(genome_idx_pairs=pairs)
         sync(world)
         tds.append(time.perf_counter() - t0)
-    print(f"{_summary(tds)} - update cells")
+    emit("update_cells", tds, "update cells")
 
     # -- replicate (divide): a 256² map has room for all n children, so
     # this is a true n-division burst (the reference's 0.28 s number is a
@@ -106,7 +151,7 @@ def main() -> None:
         n_divided = len(world.divide_cells(cell_idxs=list(range(world.n_cells))))
         sync(world)
         tds.append(time.perf_counter() - t0)
-    print(f"{_summary(tds)} - replicate cells ({n_divided:,} divided)")
+    emit("divide_cells", tds, f"replicate cells ({n_divided:,} divided)")
 
     # -- enzymatic activity (steady-state timing: warm the jit first)
     world = ms.World(chemistry=CHEMISTRY, seed=rng.randrange(2**31))
@@ -119,7 +164,7 @@ def main() -> None:
         world.enzymatic_activity()
         sync(world)
         tds.append(time.perf_counter() - t0)
-    print(f"{_summary(tds)} - enzymatic activity")
+    emit("enzymatic_activity", tds, "enzymatic activity")
 
     # -- mutations + neighbors + recombinations
     tds = []
@@ -133,7 +178,7 @@ def main() -> None:
         ms.recombinations(seq_pairs=pairs)
         sync(world)
         tds.append(time.perf_counter() - t0)
-    print(f"{_summary(tds)} - mutations")
+    emit("mutations", tds, "mutations")
 
     _ = np.asarray(world.cell_molecules)  # keep linters honest about use
 

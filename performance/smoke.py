@@ -6,6 +6,10 @@ replay -> flush path executes end to end in CI, and (b) to leave a
 steps/s number in the logs so throughput regressions are visible in
 history even where wall-clock assertions would flake (shared CI boxes).
 
+A second JSON line reports the phenotype-cache smoke: a duplicate-genome
+spawn burst must produce cache hits AND parameters bit-identical to a
+cache-disabled world — this one DOES gate (correctness, not speed).
+
     python performance/smoke.py [--steps 6] [--megastep 2]
 
 scripts/test.sh runs this after the fast tier.
@@ -91,6 +95,45 @@ def main() -> None:
         ),
         flush=True,
     )
+
+    # -- phenotype-cache effectiveness: a duplicate-heavy burst must
+    # actually HIT the cache, and the cache-served parameters must be
+    # bit-identical to a fresh-translation (cache-disabled) world
+    import numpy as np
+
+    uniq = [ms.random_genome(s=args.genome_size, rng=rng) for _ in range(8)]
+    burst = [uniq[i % len(uniq)] for i in range(4 * len(uniq))]
+    cached = ms.World(chemistry=chem, map_size=args.map_size, seed=11)
+    cold = ms.World(
+        chemistry=chem, map_size=args.map_size, seed=11,
+        phenotype_cache_size=0,
+    )
+    cached.spawn_cells(burst)
+    cold.spawn_cells(burst)
+    identical = all(
+        np.array_equal(np.nan_to_num(a), np.nan_to_num(np.asarray(b)))
+        for a, b in zip(
+            (np.asarray(t) for t in cached.kinetics.params),
+            cold.kinetics.params,
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "smoke phenotype cache (dup-genome burst, cpu)",
+                "value": cached.phenotypes.hits,
+                "unit": "hits",
+                "misses": cached.phenotypes.misses,
+                "bit_identical_vs_cold": identical,
+            }
+        ),
+        flush=True,
+    )
+    if cached.phenotypes.hits <= 0 or not identical:
+        raise SystemExit(
+            "phenotype cache smoke FAILED: "
+            f"hits={cached.phenotypes.hits} identical={identical}"
+        )
 
 
 if __name__ == "__main__":

@@ -57,6 +57,13 @@ def summarize(outdir: Path) -> dict:
         rows = [r for r in _json_lines(outdir / log_name) if "value" in r]
         if not rows:
             continue
+        # a failed bench emits {"value": 0.0, "error": ...} — that is a
+        # capture outcome, not a measurement: drop error rows whenever a
+        # clean row exists (keep the last error row only when the whole
+        # log failed, so the summary still shows WHY)
+        clean = [r for r in rows if "error" not in r]
+        if clean:
+            rows = clean
         # never let an early " [classic]"-suffixed line stand in for the
         # headline: prefer the last UNSUFFIXED line; fall back to the
         # classic line only with an explicit marker so publish() skips it
@@ -88,6 +95,20 @@ def summarize(outdir: Path) -> dict:
         }
         entry["metric"] = last.get("metric", "")
         summary[key] = entry
+    # performance/check.py --json per-op rows (seconds, LOWER is better):
+    # one entry per op, last clean row wins; error rows are skipped — a
+    # failed bench is not a measurement (BENCH_r05's {"value": 0.0,
+    # "error": "backend not ready"} must never enter the trend)
+    check_rows = [
+        r
+        for r in _json_lines(outdir / "check.log")
+        if "op" in r and "value" in r and "error" not in r
+    ]
+    if check_rows:
+        ops: dict = {}
+        for r in check_rows:
+            ops[str(r["op"])] = r
+        summary["check_ops"] = ops
     reps = [r for r in _json_lines(outdir / "bitrepro.log") if "result" in r]
     if reps:
         summary["bitrepro"] = reps[-1]
@@ -129,6 +150,25 @@ def publish(summary: dict) -> None:
             # coexist without misattributing one window's numbers to
             # another's capture dir
             published[key] = {**entry, "capture_dir": summary["capture_dir"]}
+            merged = True
+    ops = summary.get("check_ops")
+    if ops:
+        pub_ops = published.setdefault("check_ops", {})
+        for op, entry in ops.items():
+            # per-op best-value-wins with the metric-match rule of the
+            # bench entries — but check rows are SECONDS per op (lower
+            # is better), so "best" flips direction for unit "s"
+            prev = pub_ops.get(op)
+            if (
+                isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
+            ):
+                lower_better = entry.get("unit") == "s"
+                prev_v = prev.get("value", 0)
+                new_v = entry.get("value", 0)
+                if (prev_v <= new_v) if lower_better else (prev_v >= new_v):
+                    continue
+            pub_ops[op] = {**entry, "capture_dir": summary["capture_dir"]}
             merged = True
     for key in ("bitrepro", "integrator"):
         entry = summary.get(key)

@@ -11,5 +11,7 @@ python -m magicsoup_tpu.analysis --check
 python -m pytest "$TARGET" -q
 # steps/s smoke: prove the pipelined dispatch->replay->flush path end to
 # end and leave a throughput number in the CI log (JSON, no threshold —
-# see performance/smoke.py)
+# see performance/smoke.py).  Its second JSON line is the phenotype-cache
+# effectiveness gate: a duplicate-genome burst must hit the cache and
+# stay bit-identical to a cache-disabled world (exits nonzero otherwise)
 python performance/smoke.py

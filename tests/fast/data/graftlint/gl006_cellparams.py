@@ -1,0 +1,16 @@
+"""GL006 fixture: jit over a CellParams pytree without donation —
+the phenotype-scatter spelling of the missing-donation hazard."""
+from functools import partial
+
+import jax
+
+
+@jax.jit  # GL006: params undonated
+def scatter(params: "CellParams", rows, idxs):
+    return params
+
+
+# the donating spelling is clean
+@partial(jax.jit, donate_argnums=(0,))
+def scatter_donated(params: "CellParams", rows, idxs):
+    return params

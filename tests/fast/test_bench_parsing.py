@@ -1,18 +1,29 @@
 """
 Unit tests for the benchmark harness's pure helpers: result-line
-detection (what the parent forwards to the driver) and the CUDA-baseline
-interpolation the `vs_baseline` field is computed from.
+detection (what the parent forwards to the driver), the CUDA-baseline
+interpolation the `vs_baseline` field is computed from, check.py's
+per-op JSON rows, and summarize_capture's error-row skipping + per-op
+publish direction.
 """
 import importlib.util
+import json
 import sys
 from pathlib import Path
 
-_spec = importlib.util.spec_from_file_location(
-    "bench", Path(__file__).resolve().parents[2] / "bench.py"
-)
-bench = importlib.util.module_from_spec(_spec)
-sys.modules["bench"] = bench
-_spec.loader.exec_module(bench)
+_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _load(name: str, rel: str):
+    spec = importlib.util.spec_from_file_location(name, _ROOT / rel)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load("bench", "bench.py")
+check = _load("check", "performance/check.py")
+summarize_capture = _load("summarize_capture", "scripts/summarize_capture.py")
 
 
 def test_result_line_detection():
@@ -109,6 +120,95 @@ def test_config_preset_precedence():
     assert (args.n_cells, args.map_size, args.chemistry) == (
         10_000, 128, "wood_ljungdahl",
     )
+
+
+def test_check_result_row_format():
+    # the per-op JSON contract summarize_capture folds into BASELINE.json
+    row = check.result_row(
+        "spawn_cells", [3.0, 4.0], n_cells=10_000,
+        genome_size=1_000, backend="cpu",
+    )
+    assert row["metric"] == "check.spawn_cells (10000 cells, 1000 nt, cpu)"
+    assert row["op"] == "spawn_cells"
+    assert row["value"] == 3.5
+    assert row["unit"] == "s"  # seconds per op: LOWER is better
+    assert row["sd"] == 0.5
+    assert row["repeats"] == 2
+    assert row["n_cells"] == 10_000
+    assert row["genome_size"] == 1_000
+    # the row is a bench-driver result line too (metric + value)
+    assert bench._is_result_line(json.dumps(row))
+
+
+def _check_row(op: str, value: float, **extra) -> str:
+    row = {
+        "metric": f"check.{op} (10000 cells, 1000 nt, cpu)",
+        "op": op,
+        "value": value,
+        "unit": "s",
+        "sd": 0.1,
+        "repeats": 3,
+        **extra,
+    }
+    return json.dumps(row)
+
+
+def test_summarize_skips_error_rows(tmp_path):
+    # a BENCH_r05-style failure row ({"value": 0.0, "error": ...}) is an
+    # outcome, not a measurement: clean rows win, error-only logs keep
+    # the error marker (so publish() skips them)
+    (tmp_path / "bench.log").write_text(
+        json.dumps(
+            {"metric": "m", "value": 0.0, "unit": "steps/s",
+             "error": "backend not ready"}
+        )
+        + "\n"
+        + json.dumps({"metric": "m", "value": 2.5, "unit": "steps/s"})
+        + "\n"
+    )
+    (tmp_path / "bench_40k.log").write_text(
+        json.dumps(
+            {"metric": "m40", "value": 0.0, "unit": "steps/s",
+             "error": "backend not ready"}
+        )
+        + "\n"
+    )
+    (tmp_path / "check.log").write_text(
+        _check_row("spawn_cells", 9.9)
+        + "\n"
+        + _check_row("spawn_cells", 3.5)
+        + "\n"
+        + _check_row("update_cells", 0.0, error="backend not ready")
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    # clean row beat the earlier error row
+    assert summary["headline_10k_128"]["value"] == 2.5
+    assert "error" not in summary["headline_10k_128"]
+    # error-only log: the error survives into the summary (visibility)
+    assert summary["40k_256"]["error"] == "backend not ready"
+    # per-op map: last clean row wins, errored op is absent
+    assert summary["check_ops"]["spawn_cells"]["value"] == 3.5
+    assert "update_cells" not in summary["check_ops"]
+
+
+def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(value: float) -> dict:
+        cap = tmp_path / f"cap-{value}"
+        cap.mkdir(exist_ok=True)
+        (cap / "check.log").write_text(_check_row("spawn_cells", value) + "\n")
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]["check_ops"]
+
+    assert pub(5.0)["spawn_cells"]["value"] == 5.0
+    # seconds are lower-is-better: 3.5 replaces 5.0 ...
+    assert pub(3.5)["spawn_cells"]["value"] == 3.5
+    # ... and a slower later window does NOT degrade the record
+    assert pub(4.5)["spawn_cells"]["value"] == 3.5
 
 
 def test_transient_markers_cover_tunnel_failure_modes():

@@ -49,6 +49,8 @@ def marked_line(path: Path, code: str) -> int:
         ("gl004_nondet.py", "GL004"),
         ("gl005_transfer.py", "GL005"),
         ("gl006_donation.py", "GL006"),
+        ("gl006_cellparams.py", "GL006"),
+        ("gl007_tolist_loop.py", "GL007"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -69,6 +71,20 @@ def test_suppression_comment_silences_finding():
 
 def test_clean_fixture_has_no_findings():
     assert analyze([FIXTURES / "clean.py"]) == []
+
+
+def test_gl007_waivable_like_the_other_rules(tmp_path):
+    # the library's deliberate per-item fallbacks (_pyengine) waive with
+    # the standard inline annotation; pin that the machinery covers GL007
+    src = (FIXTURES / "gl007_tolist_loop.py").read_text()
+    waived = src.replace(
+        "out.append(row.tolist())  # GL007: per-item conversion",
+        "out.append(row.tolist())  # graftlint: disable=GL007 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl007_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
 
 
 def test_rules_filter_restricts_rule_set():

@@ -1213,10 +1213,171 @@ def test_set_cell_params_flat_chunked_matches_unchunked():
     kin = world.kinetics
     ref = [np.asarray(t).copy() for t in kin.params]
 
-    assert kin._assembly_chunk() >= 256  # default stays batch-friendly
-    kin._assembly_chunk = lambda: 8  # force many chunks through one pad
+    assert (
+        kin._assembly_chunk(kin.max_proteins, kin.max_doms) >= 256
+    )  # default stays batch-friendly
+    kin._assembly_chunk = lambda p, d: 8  # force many chunks through one pad
     world._update_cell_params(genomes=genomes, idxs=list(range(60)))
     for before, after in zip(ref, kin.params):
         a = np.nan_to_num(before)
         b = np.nan_to_num(np.asarray(after))
+        assert np.array_equal(a, b)
+
+
+# ------------------------------------------------------------------ #
+# phenotype pipeline: cache bit-identity, rung parity, donation        #
+# ------------------------------------------------------------------ #
+def _spawn_world(genomes, seed=5, **kwargs):
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY as _WL
+    from magicsoup_tpu.world import World as _World
+
+    world = _World(chemistry=_WL, map_size=32, seed=seed, **kwargs)
+    world.spawn_cells(genomes)
+    return world
+
+
+def _param_leaves(world):
+    return [np.nan_to_num(np.asarray(t)) for t in world.kinetics.params]
+
+
+@pytest.mark.parametrize("det", [False, True])
+def test_phenotype_cache_hits_bit_identical_to_fresh_translation(
+    det, monkeypatch
+):
+    """Cache-served parameter rows must be byte-identical to freshly
+    translated+packed ones in both numeric modes — the cache is a pure
+    memoization, never an approximation."""
+    import random as _random
+
+    from magicsoup_tpu.util import random_genome as _rg
+
+    monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1" if det else "0")
+    rng = _random.Random(13)
+    genomes = [_rg(s=300, rng=rng) for _ in range(40)]
+    genomes = genomes + genomes[:20]  # duplicates hit within-batch dedup
+    cached = _spawn_world(genomes)
+    fresh = _spawn_world(genomes, phenotype_cache_size=0)
+    assert len(fresh.phenotypes) == 0  # size 0 retains nothing
+    # the SAME genomes again: the cached world now serves pure hits
+    h0 = cached.phenotypes.hits
+    cached._update_cell_params(genomes=genomes, idxs=list(range(len(genomes))))
+    fresh._update_cell_params(genomes=genomes, idxs=list(range(len(genomes))))
+    assert cached.phenotypes.hits >= h0 + len(genomes)
+    for a, b in zip(_param_leaves(cached), _param_leaves(fresh)):
+        assert np.array_equal(a, b)
+
+
+def test_rung_grouped_assembly_matches_full_capacity():
+    """Rung-grouped assembly (compute at the group's own pow2 capacity,
+    sentinel-pad back out) must be BIT-identical to assembling every
+    cell at worst-case capacities."""
+    import random as _random
+
+    from magicsoup_tpu.util import random_genome as _rg
+
+    rng = _random.Random(23)
+    # mixed genome sizes spread the cells across several rungs
+    genomes = [_rg(s=rng.choice((120, 300, 700)), rng=rng) for _ in range(50)]
+    grouped = _spawn_world(genomes)
+    fullcap = _spawn_world(genomes)
+    kin = fullcap.kinetics
+    kin._rung_groups = lambda counts, dmax: [
+        (np.arange(len(counts)), kin.max_proteins, kin.max_doms)
+    ]
+    idxs = list(range(len(genomes)))
+    grouped._update_cell_params(genomes=genomes, idxs=idxs)
+    fullcap._update_cell_params(genomes=genomes, idxs=idxs)
+    # more than one rung actually exercised on the grouped side
+    counts = np.array(
+        [e.n_prots for e in grouped.phenotypes.lookup(genomes)]
+    )
+    dmax = np.array(
+        [e.max_doms for e in grouped.phenotypes.lookup(genomes)]
+    )
+    assert len(grouped.kinetics._rung_groups(counts, dmax)) >= 1
+    for a, b in zip(_param_leaves(grouped), _param_leaves(fullcap)):
+        assert np.array_equal(a, b)
+
+
+def test_scatter_dense_donation_contract():
+    """The donated assembly program aliases all nine params leaves; the
+    retained twin aliases none.  Which one dispatches is platform-gated:
+    XLA:CPU keeps the retained twins (donated-buffer reuse races the
+    async runtime there), accelerators donate (same contract as the
+    stepper's megastep gate in tests/fast/test_megastep.py)."""
+    import jax
+
+    from magicsoup_tpu.ops import params as P
+
+    world = _spawn_world(["A" * 40])
+    kin = world.kinetics
+    dense = jnp.zeros(
+        (256, kin.max_proteins, kin.max_doms, 5), dtype=jnp.int16
+    )
+    idxs = jnp.asarray(
+        P.pad_idxs(np.arange(4, dtype=np.int32), oob=kin.max_cells)
+    )
+    lower_args = (kin.params, dense, kin.tables, kin._abs_temp_arr, idxs)
+    donated_text = P.assemble_params.lower(*lower_args).as_text()
+    assert donated_text.count("tf.aliasing_output") == len(kin.params)
+    retained_text = P.assemble_params_retained.lower(*lower_args).as_text()
+    assert retained_text.count("tf.aliasing_output") == 0
+
+    buf = kin.params.Vmax
+    kin.scatter_dense(
+        np.arange(4, dtype=np.int32), np.asarray(dense[:4])
+    )
+    if jax.default_backend() == "cpu":
+        # CPU: retained twin dispatched, the input buffer survives
+        assert not kin._donate_param_buffers()
+        assert not buf.is_deleted()
+    else:
+        # accelerator: donated program consumed the input buffer
+        assert kin._donate_param_buffers()
+        assert buf.is_deleted()
+
+
+def test_update_cell_params_batch_size_edges():
+    """World.batch_size chunking of the phenotype write path: batch=1,
+    a chunk-boundary-straddling batch, batch=n, and oversized batches
+    must all write bit-identical parameters — including the unset path
+    for empty proteomes."""
+    import random as _random
+
+    from magicsoup_tpu.util import random_genome as _rg
+
+    rng = _random.Random(3)
+    genomes = [_rg(s=250, rng=rng) for _ in range(21)]
+    genomes[5] = ""  # empty genome: all-empty-proteome slot
+    genomes[6] = "ATTTAT"  # too short to encode a protein
+    ref = None
+    for batch in (None, 1, 7, 21, 64):
+        world = _spawn_world(genomes, seed=9, batch_size=batch)
+        leaves = _param_leaves(world)
+        # the proteome-less slots are fully unset in every variant
+        assert not np.any(leaves[3][5])  # Vmax rows
+        assert not np.any(leaves[3][6])
+        if ref is None:
+            ref = leaves
+        else:
+            for a, b in zip(ref, leaves):
+                assert np.array_equal(a, b)
+
+
+def test_update_cell_params_duplicate_idxs_last_wins():
+    """Duplicate target slots in one update keep the LAST genome's
+    parameters (rung grouping reorders scatters, so this ordering must
+    be pinned up front, not left to scatter order)."""
+    import random as _random
+
+    from magicsoup_tpu.util import random_genome as _rg
+
+    rng = _random.Random(17)
+    genomes = [_rg(s=300, rng=rng) for _ in range(4)]
+    g_a, g_b = _rg(s=300, rng=rng), _rg(s=700, rng=rng)
+    dup = _spawn_world(genomes)
+    single = _spawn_world(genomes)
+    dup._update_cell_params(genomes=[g_a, g_b], idxs=[2, 2])
+    single._update_cell_params(genomes=[g_b], idxs=[2])
+    for a, b in zip(_param_leaves(dup), _param_leaves(single)):
         assert np.array_equal(a, b)
