@@ -51,6 +51,11 @@ MODULES = {
         " sequence strings."
     ),
     "magicsoup_tpu.util": "Helper functions.",
+    "magicsoup_tpu.telemetry": (
+        "graftscope run telemetry: zero-sync JSONL recorder, unified"
+        " runtime counter snapshots, profiler tracing, and the"
+        " `python -m magicsoup_tpu.telemetry summarize` CLI."
+    ),
     "magicsoup_tpu.parallel.tiled": (
         "Tile-sharded world stepping across a TPU device mesh"
         " (halo-exchange diffusion, sharded cell axis)."

@@ -106,6 +106,12 @@ RULE_INFO = {
         "each call crosses the C/Python boundary per element; convert "
         "the whole array ONCE before the loop and slice host lists",
     ),
+    "GL008": (
+        "host-callback-in-jit",
+        "io_callback/pure_callback/jax.debug host work inside a jitted "
+        "body — a host round trip compiled into the device program; "
+        "telemetry must ride the packed output record instead",
+    ),
 }
 
 
@@ -607,6 +613,43 @@ def _jit_wrapper_kwargs(call: ast.Call) -> dict | None:
     return None
 
 
+def _jit_wrapped_defs(ctx: Context, f) -> list[tuple]:
+    """(wrapped function def, node to report, wrapper kwargs) for every
+    jit-wrapped function in the file — the decorator spellings
+    (``@jax.jit``, ``@partial(jax.jit, ...)``) and the assignment
+    spelling (``name = partial(jax.jit, ...)(fn)``).  Shared by GL006
+    (donation) and GL008 (host callbacks) so "what counts as a jitted
+    body" cannot drift between the rules."""
+    fns_by_name = {
+        rec.qualname: rec.node
+        for rec in ctx.graph.functions.values()
+        if rec.file is f
+    }
+    wrappers: list[tuple] = []
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    kwargs = _jit_wrapper_kwargs(dec)
+                    if kwargs is not None:
+                        wrappers.append((node, dec, kwargs))
+                elif _is_jit_ctor(dec):  # bare @jax.jit
+                    wrappers.append((node, dec, {}))
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Call
+        ):
+            # partial(jax.jit, ...)(fn) as an expression
+            kwargs = _jit_wrapper_kwargs(node.func)
+            if (
+                kwargs is not None
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in fns_by_name
+            ):
+                wrappers.append((fns_by_name[node.args[0].id], node, kwargs))
+    return wrappers
+
+
 def check_gl006(ctx: Context):
     """Step-level jits over a ``DeviceState`` (or a ``CellParams``
     pytree — the phenotype scatter path) must donate it: the program
@@ -622,35 +665,7 @@ def check_gl006(ctx: Context):
         "programs with `# graftlint: disable=GL006`"
     )
     for f in ctx.files:
-        fns_by_name = {
-            rec.qualname: rec.node
-            for rec in ctx.graph.functions.values()
-            if rec.file is f
-        }
-        # (wrapped function def, node to report, wrapper kwargs)
-        wrappers: list[tuple] = []
-        for node in ast.walk(f.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if isinstance(dec, ast.Call):
-                        kwargs = _jit_wrapper_kwargs(dec)
-                        if kwargs is not None:
-                            wrappers.append((node, dec, kwargs))
-                    elif _is_jit_ctor(dec):  # bare @jax.jit
-                        wrappers.append((node, dec, {}))
-            elif isinstance(node, ast.Call) and isinstance(
-                node.func, ast.Call
-            ):
-                # partial(jax.jit, ...)(fn) as an expression
-                kwargs = _jit_wrapper_kwargs(node.func)
-                if (
-                    kwargs is not None
-                    and len(node.args) == 1
-                    and isinstance(node.args[0], ast.Name)
-                    and node.args[0].id in fns_by_name
-                ):
-                    wrappers.append((fns_by_name[node.args[0].id], node, kwargs))
-        for fn_node, where, kwargs in wrappers:
+        for fn_node, where, kwargs in _jit_wrapped_defs(ctx, f):
             args = getattr(fn_node, "args", None)
             if args is None:
                 continue
@@ -731,6 +746,58 @@ def check_gl007(ctx: Context):
                     )
 
 
+# --------------------------------------------------------------- GL008
+_HOST_CALLBACK_LEAVES = {"io_callback", "pure_callback"}
+_DEBUG_LEAVES = {"print", "callback", "breakpoint"}
+
+
+def check_gl008(ctx: Context):
+    """Telemetry must stay off the device: a host callback
+    (``io_callback`` / ``pure_callback`` / ``host_callback`` /
+    ``jax.debug.print|callback|breakpoint``) inside a jit-wrapped body
+    compiles a host round trip into the device program — paid on EVERY
+    execution, exactly the per-step sync the pipelined stepper exists
+    to avoid.  The sanctioned design packs metrics into the step's
+    output record on device (stepper._step_body's telemetry lanes) and
+    times phases host-side around the dispatch
+    (telemetry.TelemetryRecorder)."""
+    fix = (
+        "compute the metric on device and pack it into the step output "
+        "record (it rides the existing fetch for free); host-side spans "
+        "belong in TelemetryRecorder AROUND the dispatch, not inside "
+        "the jitted body; waive a deliberate debugging callback with "
+        "`# graftlint: disable=GL008`"
+    )
+    for f in ctx.files:
+        seen: set[int] = set()
+        for fn_node, _where, _kwargs in _jit_wrapped_defs(ctx, f):
+            for node in ast.walk(fn_node):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                chain = _attr_chain(node.func)
+                if not chain:
+                    continue
+                leaf = chain.rsplit(".", 1)[-1]
+                if (
+                    leaf in _HOST_CALLBACK_LEAVES
+                    or "host_callback" in chain.split(".")
+                    or (
+                        "debug" in chain.split(".")
+                        and leaf in _DEBUG_LEAVES
+                    )
+                ):
+                    seen.add(id(node))
+                    yield _finding(
+                        "GL008",
+                        f,
+                        node,
+                        f"host callback `{chain}` inside jitted body "
+                        f"`{fn_node.name}` compiles a host round trip "
+                        "into the device program",
+                        fix,
+                    )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -739,6 +806,7 @@ CHECKERS = {
     "GL005": check_gl005,
     "GL006": check_gl006,
     "GL007": check_gl007,
+    "GL008": check_gl008,
 }
 
 

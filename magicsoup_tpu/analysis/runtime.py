@@ -181,3 +181,48 @@ def phenotype_cache_stats() -> dict[str, int]:
             "misses": _pheno_misses,
             "evictions": _pheno_evictions,
         }
+
+
+# ----------------------------------------------------------------- #
+# unified counter API (telemetry / tests)                           #
+# ----------------------------------------------------------------- #
+def snapshot() -> dict[str, int]:
+    """Every process-global runtime counter as one flat dict.
+
+    This is the read side the telemetry recorder and tests consume:
+    one atomic view (single lock acquisition) instead of six separate
+    accessor calls that could interleave with concurrent compiles.
+    Keys: ``compiles``, ``persistent_cache_hits``,
+    ``persistent_cache_misses``, ``phenotype_hits``,
+    ``phenotype_misses``, ``phenotype_evictions``.
+    """
+    install()
+    with _lock:
+        return {
+            "compiles": _count,
+            "persistent_cache_hits": _cache_hits,
+            "persistent_cache_misses": _cache_misses,
+            "phenotype_hits": _pheno_hits,
+            "phenotype_misses": _pheno_misses,
+            "phenotype_evictions": _pheno_evictions,
+        }
+
+
+def reset_counters() -> None:
+    """Zero every counter in :func:`snapshot` (listeners stay installed).
+
+    For test isolation: assert on absolute values after a reset instead
+    of diffing raw process totals.  NOT safe inside an open
+    :func:`hot_path_guard` window — the guard diffs
+    :func:`compile_count` across the window, so zeroing mid-window
+    underflows its budget math.
+    """
+    global _count, _cache_hits, _cache_misses
+    global _pheno_hits, _pheno_misses, _pheno_evictions
+    with _lock:
+        _count = 0
+        _cache_hits = 0
+        _cache_misses = 0
+        _pheno_hits = 0
+        _pheno_misses = 0
+        _pheno_evictions = 0

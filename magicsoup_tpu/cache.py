@@ -57,6 +57,16 @@ def ensure_compile_cache() -> str | None:
             return _configured
         import jax
 
+        # register the runtime counter listeners before this process's
+        # first compile: jax.monitoring listeners only see events fired
+        # after registration, and every entry point that compiles goes
+        # through here first — so analysis.runtime.snapshot() (and the
+        # telemetry counters built on it) report process TOTALS, not
+        # "since whenever a test happened to call install()"
+        from magicsoup_tpu.analysis import runtime as _runtime
+
+        _runtime.install()
+
         if jax.config.jax_compilation_cache_dir:
             # the embedding application configured its own cache — ours
             # would silently redirect entries it expects to find there

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from magicsoup_tpu.native import engine as _engine
+from magicsoup_tpu.ops import detmath as _detmath
 from magicsoup_tpu.ops import diffusion as _diff
 from magicsoup_tpu.ops.integrate import CellParams, _integrate_signals_jit
 from magicsoup_tpu.ops.params import (
@@ -101,9 +102,18 @@ class StepOutputs(NamedTuple):
     spawn_pos: Any  # (b_spawn, 2) i32 spawn pixels
     n_rows: int  # high-water row count after the step
     n_alive: int  # live cells after the step
+    # telemetry lanes (graftscope): computed on device every step so the
+    # recorder's per-step rows cost zero extra transfers
+    n_occupied: int  # occupied map pixels after the step
+    mm_mass: float  # total molecule mass on the map (pre-compaction sum)
+    cm_mass: float  # total intracellular molecule mass
 
 
 _BITS = 16  # bits packed per i32 word (16 keeps every value positive)
+# leading scalar words of the packed record: [n_placed, n_candidates,
+# n_attempted, n_rows, n_alive, n_occupied, mm_mass(f32 bits),
+# cm_mass(f32 bits)] — _step_body's pack and _unpack_outputs must agree
+_HEADER_WORDS = 8
 
 
 def _pack_bits(b: jax.Array) -> jax.Array:
@@ -282,141 +292,183 @@ def _step_body(
         jnp.float32
     )
 
+    # jax.named_scope on every phase: pure metadata (op name prefixes),
+    # zero effect on lowering/results, but a jax.profiler trace captured
+    # via telemetry.trace_window resolves XLA ops to simulation phases
     # ---- -1. parameter pushes riding this dispatch ---------------------
     # the phenotype refresh for genomes changed in recent replays — rides
     # the step program instead of paying its own dispatch round trip;
     # rows whose proteome emptied carry all-zero token rows (their
     # computed params are inert)
-    params = scatter_params(
-        params,
-        compute_cell_params(push_dense, tables, abs_temp),
-        push_rows,
-    )
+    with jax.named_scope("ms:push_params"):
+        params = scatter_params(
+            params,
+            compute_cell_params(push_dense, tables, abs_temp),
+            push_rows,
+        )
 
     # ---- 0. spawn queued newcomers ------------------------------------
-    budget = q - n_rows
-    valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
-    spawn_ok, spawn_pos, occ = _place_global(k_spawn, occ, valid, n_rounds)
-    srank = jnp.cumsum(spawn_ok) - 1
-    srow = jnp.where(spawn_ok, n_rows + srank, cap).astype(jnp.int32)
-    sx, sy = spawn_pos[:, 0], spawn_pos[:, 1]
-    pickup = mm[:, sx, sy] * 0.5 * spawn_ok[None, :]  # (mols, b)
-    mm = mm.at[:, sx, sy].add(-pickup)
-    cm = cm.at[srow].set(pickup.T, mode="drop")
-    pos = pos.at[srow].set(spawn_pos, mode="drop")
-    alive = alive.at[srow].set(True, mode="drop")
-    params = scatter_params(
-        params, compute_cell_params(spawn_dense, tables, abs_temp), srow
-    )
-    n_rows = n_rows + spawn_ok.sum(dtype=jnp.int32)
+    with jax.named_scope("ms:spawn"):
+        budget = q - n_rows
+        valid = spawn_valid & ((jnp.cumsum(spawn_valid) - 1) < budget)
+        spawn_ok, spawn_pos, occ = _place_global(
+            k_spawn, occ, valid, n_rounds
+        )
+        srank = jnp.cumsum(spawn_ok) - 1
+        srow = jnp.where(spawn_ok, n_rows + srank, cap).astype(jnp.int32)
+        sx, sy = spawn_pos[:, 0], spawn_pos[:, 1]
+        pickup = mm[:, sx, sy] * 0.5 * spawn_ok[None, :]  # (mols, b)
+        mm = mm.at[:, sx, sy].add(-pickup)
+        cm = cm.at[srow].set(pickup.T, mode="drop")
+        pos = pos.at[srow].set(spawn_pos, mode="drop")
+        alive = alive.at[srow].set(True, mode="drop")
+        params = scatter_params(
+            params, compute_cell_params(spawn_dense, tables, abs_temp), srow
+        )
+        n_rows = n_rows + spawn_ok.sum(dtype=jnp.int32)
 
     # ---- 1. enzymatic activity (live-row prefix only) ------------------
-    xs_q, ys_q = pos[:q, 0], pos[:q, 1]
-    ext = mm[:, xs_q, ys_q].T  # (q, mols)
-    params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
-    X0q = jnp.concatenate([cm[:q], ext], axis=1)
-    if use_pallas:
-        from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+    with jax.named_scope("ms:activity"):
+        xs_q, ys_q = pos[:q, 0], pos[:q, 1]
+        ext = mm[:, xs_q, ys_q].T  # (q, mols)
+        params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
+        X0q = jnp.concatenate([cm[:q], ext], axis=1)
+        if use_pallas:
+            from magicsoup_tpu.ops.pallas_integrate import (
+                integrate_signals_pallas,
+            )
 
-        X1 = integrate_signals_pallas(
-            X0q, params_q, interpret=jax.default_backend() != "tpu"
+            X1 = integrate_signals_pallas(
+                X0q, params_q, interpret=jax.default_backend() != "tpu"
+            )
+        else:
+            X1 = _integrate_signals_jit(X0q, params_q, det)
+        alive_q = alive[:q, None]
+        cm = jax.lax.dynamic_update_slice_in_dim(
+            cm, jnp.where(alive_q, X1[:, :n_mols], cm[:q]), 0, axis=0
         )
-    else:
-        X1 = _integrate_signals_jit(X0q, params_q, det)
-    alive_q = alive[:q, None]
-    cm = jax.lax.dynamic_update_slice_in_dim(
-        cm, jnp.where(alive_q, X1[:, :n_mols], cm[:q]), 0, axis=0
-    )
-    mm = mm.at[:, xs_q, ys_q].add(
-        jnp.where(alive_q, X1[:, n_mols:] - ext, 0.0).T
-    )
+        mm = mm.at[:, xs_q, ys_q].add(
+            jnp.where(alive_q, X1[:, n_mols:] - ext, 0.0).T
+        )
 
     # ---- 2. selection + kill ------------------------------------------
-    xs, ys = pos[:, 0], pos[:, 1]
-    atp = jnp.einsum("cm,m->c", cm, mol_onehot)
-    kill = alive & (atp < kill_below)
-    spill = jnp.where(kill[:, None], cm, 0.0)
-    mm = mm.at[:, xs, ys].add(spill.T)
-    cm = jnp.where(kill[:, None], 0.0, cm)
-    occ = occ.at[
-        jnp.where(kill, xs, m), jnp.where(kill, ys, m)
-    ].set(False, mode="drop")
-    alive = alive & ~kill
+    with jax.named_scope("ms:select_kill"):
+        xs, ys = pos[:, 0], pos[:, 1]
+        atp = jnp.einsum("cm,m->c", cm, mol_onehot)
+        kill = alive & (atp < kill_below)
+        spill = jnp.where(kill[:, None], cm, 0.0)
+        mm = mm.at[:, xs, ys].add(spill.T)
+        cm = jnp.where(kill[:, None], 0.0, cm)
+        occ = occ.at[
+            jnp.where(kill, xs, m), jnp.where(kill, ys, m)
+        ].set(False, mode="drop")
+        alive = alive & ~kill
 
     # ---- 3. divide -----------------------------------------------------
-    cand = alive & (atp > divide_above)
-    n_candidates = cand.sum(dtype=jnp.int32)
-    budget = jnp.minimum(jnp.minimum(max_div, div_budget), q - n_rows)
-    cand = cand & ((jnp.cumsum(cand) - 1) < budget)
-    n_attempted = cand.sum(dtype=jnp.int32)
-    # every attempting candidate pays the division cost, whether or not a
-    # free pixel is found — exactly the canonical workload's order
-    # (performance/workload.py:69-75 subtracts before divide_cells)
-    cm = cm - (jnp.where(cand, divide_cost, 0.0)[:, None] * mol_onehot)
-    placed, cpos, occ = _place_moore(k_div, occ, pos, cand, n_rounds)
-    crank = jnp.cumsum(placed) - 1
-    crow = jnp.where(placed, n_rows + crank, cap).astype(jnp.int32)
-    half = jnp.where(placed[:, None], cm * 0.5, cm)
-    cm = half.at[crow].add(
-        jnp.where(placed[:, None], half, 0.0), mode="drop"
-    )
-    pos = pos.at[crow].set(cpos, mode="drop")
-    alive = alive.at[crow].set(True, mode="drop")
-    p_idx = jnp.nonzero(placed, size=max_div, fill_value=cap)[0].astype(
-        jnp.int32
-    )
-    c_idx = jnp.where(
-        p_idx < cap, n_rows + jnp.arange(max_div, dtype=jnp.int32), cap
-    )
-    params = copy_params(params, p_idx, c_idx)
-    n_placed = placed.sum(dtype=jnp.int32)
-    n_rows = n_rows + n_placed
+    with jax.named_scope("ms:divide"):
+        cand = alive & (atp > divide_above)
+        n_candidates = cand.sum(dtype=jnp.int32)
+        budget = jnp.minimum(jnp.minimum(max_div, div_budget), q - n_rows)
+        cand = cand & ((jnp.cumsum(cand) - 1) < budget)
+        n_attempted = cand.sum(dtype=jnp.int32)
+        # every attempting candidate pays the division cost, whether or
+        # not a free pixel is found — exactly the canonical workload's
+        # order (performance/workload.py:69-75 subtracts before
+        # divide_cells)
+        cm = cm - (jnp.where(cand, divide_cost, 0.0)[:, None] * mol_onehot)
+        placed, cpos, occ = _place_moore(k_div, occ, pos, cand, n_rounds)
+        crank = jnp.cumsum(placed) - 1
+        crow = jnp.where(placed, n_rows + crank, cap).astype(jnp.int32)
+        half = jnp.where(placed[:, None], cm * 0.5, cm)
+        cm = half.at[crow].add(
+            jnp.where(placed[:, None], half, 0.0), mode="drop"
+        )
+        pos = pos.at[crow].set(cpos, mode="drop")
+        alive = alive.at[crow].set(True, mode="drop")
+        p_idx = jnp.nonzero(placed, size=max_div, fill_value=cap)[0].astype(
+            jnp.int32
+        )
+        c_idx = jnp.where(
+            p_idx < cap, n_rows + jnp.arange(max_div, dtype=jnp.int32), cap
+        )
+        params = copy_params(params, p_idx, c_idx)
+        n_placed = placed.sum(dtype=jnp.int32)
+        n_rows = n_rows + n_placed
 
     # ---- 4. degrade + diffuse + permeate ------------------------------
-    mm = mm * degrad_factors[:, None, None]
-    cm = cm * degrad_factors[None, :]
-    mm = _diff.diffuse(mm, kernels, det=det)
-    xs, ys = pos[:, 0], pos[:, 1]
-    ext = mm[:, xs, ys].T
-    new_cm, new_ext = _diff.permeate(cm, ext, perm_factors, det=det)
-    alive_c = alive[:, None]
-    cm = jnp.where(alive_c, new_cm, cm)
-    mm = mm.at[:, xs, ys].add(jnp.where(alive_c, new_ext - ext, 0.0).T)
+    with jax.named_scope("ms:physics"):
+        mm = mm * degrad_factors[:, None, None]
+        cm = cm * degrad_factors[None, :]
+        mm = _diff.diffuse(mm, kernels, det=det)
+        xs, ys = pos[:, 0], pos[:, 1]
+        ext = mm[:, xs, ys].T
+        new_cm, new_ext = _diff.permeate(cm, ext, perm_factors, det=det)
+        alive_c = alive[:, None]
+        cm = jnp.where(alive_c, new_cm, cm)
+        mm = mm.at[:, xs, ys].add(jnp.where(alive_c, new_ext - ext, 0.0).T)
+
+    # ---- 4.5 telemetry metric lanes -----------------------------------
+    # computed UNCONDITIONALLY (the compiled program is identical whether
+    # a recorder is attached or not, so det-mode trajectories cannot
+    # differ telemetry on vs off) and BEFORE compaction (the det-mode
+    # fixed-tree reduction must not see a permuted row order).  Dead rows
+    # hold zeros by invariant (kill zeroes cm, compaction folds), so the
+    # full-cap sums are the true mass totals.
+    with jax.named_scope("ms:metrics"):
+        if det:
+            mm_mass = _detmath.sum_axis(mm.reshape(-1), 0)
+            cm_mass = _detmath.sum_axis(cm.reshape(-1), 0)
+        else:
+            mm_mass = jnp.sum(mm)
+            cm_mass = jnp.sum(cm)
+        n_occupied = occ.sum(dtype=jnp.int32)
 
     # ---- 5. optional compaction ---------------------------------------
     child_pos_out = cpos[jnp.clip(p_idx, 0, cap - 1)]
     if compact:
-        # stable sort of ~alive: live rows keep order, dead fold out.
-        # np.argsort(~alive, kind="stable") on the host replay produces
-        # the IDENTICAL permutation (stability makes it unique), so the
-        # host needs no extra fetch to follow.
-        perm = jnp.argsort(~alive, stable=True).astype(jnp.int32)
-        n_keep = alive.sum(dtype=jnp.int32)
-        cm = compact_rows(cm, perm, n_keep)
-        pos = compact_rows(pos, perm, n_keep)
-        params = permute_params(params, perm, n_keep)
-        alive = rows < n_keep
-        n_rows = n_keep
+        with jax.named_scope("ms:compact"):
+            # stable sort of ~alive: live rows keep order, dead fold out.
+            # np.argsort(~alive, kind="stable") on the host replay
+            # produces the IDENTICAL permutation (stability makes it
+            # unique), so the host needs no extra fetch to follow.
+            perm = jnp.argsort(~alive, stable=True).astype(jnp.int32)
+            n_keep = alive.sum(dtype=jnp.int32)
+            cm = compact_rows(cm, perm, n_keep)
+            pos = compact_rows(pos, perm, n_keep)
+            params = permute_params(params, perm, n_keep)
+            alive = rows < n_keep
+            n_rows = n_keep
 
-    # one packed i32 output vector = one device->host transfer per replay
-    out = jnp.concatenate(
-        [
-            jnp.stack(
-                [
-                    n_placed,
-                    n_candidates,
-                    n_attempted,
-                    n_rows,
-                    alive.sum(dtype=jnp.int32),
-                ]
-            ).astype(jnp.int32),
-            _pack_bits(kill),
-            p_idx,
-            child_pos_out.reshape(-1).astype(jnp.int32),
-            _pack_bits(spawn_ok),
-            spawn_pos.reshape(-1).astype(jnp.int32),
-        ]
-    )
+    # one packed i32 output vector = one device->host transfer per replay.
+    # header words 5-7 are the telemetry lanes: occupied-pixel count and
+    # the two f32 mass totals bitcast into i32 (the host re-views the
+    # bits as float32 — exact, no rounding through a cast)
+    with jax.named_scope("ms:pack_record"):
+        out = jnp.concatenate(
+            [
+                jnp.stack(
+                    [
+                        n_placed,
+                        n_candidates,
+                        n_attempted,
+                        n_rows,
+                        alive.sum(dtype=jnp.int32),
+                        n_occupied,
+                        jax.lax.bitcast_convert_type(
+                            mm_mass.astype(jnp.float32), jnp.int32
+                        ),
+                        jax.lax.bitcast_convert_type(
+                            cm_mass.astype(jnp.float32), jnp.int32
+                        ),
+                    ]
+                ).astype(jnp.int32),
+                _pack_bits(kill),
+                p_idx,
+                child_pos_out.reshape(-1).astype(jnp.int32),
+                _pack_bits(spawn_ok),
+                spawn_pos.reshape(-1).astype(jnp.int32),
+            ]
+        )
     new_state = DeviceState(
         mm=mm, cm=cm, pos=pos, occ=occ, alive=alive, n_rows=n_rows, key=key
     )
@@ -866,6 +918,7 @@ class PipelinedStepper:
             "spawned": 0,
             "spawn_drops": 0,
             "pushes": 0,
+            "genome_changes": 0,  # mutated/recombined genomes applied
             # whole-run aggregates mirroring the (bounded) trace ring, so
             # totals stay exact for windows longer than the ring
             "cold_dispatches": 0,
@@ -873,6 +926,14 @@ class PipelinedStepper:
             "dispatch_ms": 0,
             "step_ms": 0,
         }
+        # graftscope: share the world's recorder so one JSONL stream
+        # carries both; detached recorders cost one dict update per
+        # dispatch and emit nothing
+        from magicsoup_tpu.telemetry import TelemetryRecorder
+
+        self.telemetry = TelemetryRecorder.coerce(
+            getattr(world, "telemetry", None)
+        )
 
         # constant device scalars, built once — jnp.asarray per dispatch
         # would put five tiny host->device transfers on the very critical
@@ -1069,9 +1130,11 @@ class PipelinedStepper:
         # grow token capacities for both, and only then densify — one
         # batch's protein-capacity growth must not invalidate the
         # other's already-built dense tensor
+        t_asm0 = _time.perf_counter()
         spawn = self._spawn_queue[: self.spawn_block]
         self._spawn_queue = self._spawn_queue[len(spawn) :]
         has_spawn = len(spawn) > 0
+        t_spawn0 = _time.perf_counter()
         spawn_entries = (
             self.world.phenotypes.lookup([g for g, _ in spawn])
             if has_spawn
@@ -1104,15 +1167,20 @@ class PipelinedStepper:
             valid = np.zeros(self.spawn_block, dtype=bool)
             valid[: len(spawn)] = True
             spawn_valid = jnp.asarray(valid)
+            self.telemetry.note("spawn", _time.perf_counter() - t_spawn0)
         else:
             # cached all-zero device buffers: the spawn path always runs
             # (no extra compiled variant) but places nothing and scatters
             # inert rows — and nothing is re-uploaded on spawnless steps
             spawn_dense, spawn_valid = self._empty_spawn()
         if ride is not None:
-            push_dense, push_rows = self._densify_push(*ride)
+            with self.telemetry.span("push"):
+                push_dense, push_rows = self._densify_push(*ride)
         else:
             push_dense, push_rows = self._empty_push()
+        self.telemetry.note(
+            "param_assembly", _time.perf_counter() - t_asm0
+        )
 
         # Live-row prefix for this dispatch: an EXACT upper bound on the
         # device's row count (replayed rows + each outstanding step's
@@ -1211,6 +1279,24 @@ class PipelinedStepper:
                 "pend": len(self._pending),
             }
         )
+        # graftscope: per-dispatch phase attribution + one JSONL row.
+        # take_dispatch() drains the since-last-dispatch window, so the
+        # fetch/replay spans _drain noted above land on THIS row
+        rec = self.telemetry
+        rec.note("dispatch", t_dispatched - t_dispatch0)
+        if rec.attached:
+            rec.emit(
+                {
+                    "type": "dispatch",
+                    "phases": rec.take_dispatch(),
+                    "k": k,
+                    "q": q,
+                    "rows": self._n_rows,
+                    "pending": len(self._pending),
+                    "cold": bool(cold),
+                    "compact": bool(compact),
+                }
+            )
 
     # -------------------------------------------------------------- #
     # replay side                                                    #
@@ -1237,7 +1323,7 @@ class PipelinedStepper:
         sb = self.spawn_block
         nw_k = -(-self._cap // _BITS)
         nw_s = -(-sb // _BITS)
-        off = 5
+        off = _HEADER_WORDS
         kill = _unpack_bits(arr[off : off + nw_k], self._cap)
         off += nw_k
         parents = arr[off : off + md]
@@ -1247,6 +1333,9 @@ class PipelinedStepper:
         spawn_ok = _unpack_bits(arr[off : off + nw_s], sb)
         off += nw_s
         spawn_pos = arr[off : off + 2 * sb].reshape(sb, 2)
+        # header words 6-7 are f32 mass totals bitcast into the i32
+        # record on device; re-view the bits, don't value-cast them
+        masses = np.ascontiguousarray(arr[6:8]).view(np.float32)
         return StepOutputs(
             kill=kill,
             parents=parents,
@@ -1258,6 +1347,9 @@ class PipelinedStepper:
             spawn_pos=spawn_pos,
             n_rows=int(arr[3]),
             n_alive=int(arr[4]),
+            n_occupied=int(arr[5]),
+            mm_mass=float(masses[0]),
+            cm_mass=float(masses[1]),
         )
 
     def _drain(self, block: bool) -> None:
@@ -1287,7 +1379,10 @@ class PipelinedStepper:
         # timeout makes a dead worker or wedged tunnel surface as an
         # exception here instead of a silent hang
         arr = np.atleast_2d(np.asarray(pend.out.result(timeout=300.0)))
-        self._fetch_acc += _time.perf_counter() - t0
+        dt_fetch = _time.perf_counter() - t0
+        self._fetch_acc += dt_fetch
+        self.telemetry.note("fetch", dt_fetch)
+        t1 = _time.perf_counter()
         for i in range(pend.k):
             # record 0 carries the dispatch's spawn batch; only the final
             # record can be the compacting one — exactly what the device
@@ -1299,6 +1394,7 @@ class PipelinedStepper:
                 compacted=pend.compacted and i == pend.k - 1,
                 change_seq=pend.change_seq,
             )
+        self.telemetry.note("replay", _time.perf_counter() - t1)
 
     def _replay_record(
         self,
@@ -1343,7 +1439,8 @@ class PipelinedStepper:
 
         # 1. kills
         self._alive[kill] = False
-        self.stats["kills"] += int(kill.sum())
+        n_kills = int(kill.sum())
+        self.stats["kills"] += n_kills
 
         # 2. divisions (parents ascending; children appended in order).
         # The device copied the parent's params as of this step's
@@ -1424,6 +1521,41 @@ class PipelinedStepper:
                     )
                     for _ in range(missing)
                 )
+
+        # 7. graftscope per-step row: the device metric lanes are already
+        # host scalars (they rode the packed record through the one
+        # sanctioned fetch), so emission touches no device state
+        if self.telemetry.attached:
+            self.telemetry.emit(
+                self._step_row(out, n_kills, n_placed, n_spawned)
+            )
+
+    def _step_row(
+        self, out: StepOutputs, n_kills: int, n_divided: int, n_spawned: int
+    ) -> dict:
+        """One JSONL ``step`` row (schema: telemetry/summary.py)."""
+        lens = [
+            len(self._genomes[i]) for i in np.nonzero(self._alive)[0]
+        ]
+        n = len(lens)
+        return {
+            "type": "step",
+            "step": self.stats["replayed"],
+            "alive": out.n_alive,
+            "rows": out.n_rows,
+            "occupied": out.n_occupied,
+            "mm_mass": out.mm_mass,
+            "cm_mass": out.cm_mass,
+            "kills": n_kills,
+            "divisions": n_divided,
+            "spawned": n_spawned,
+            "genome_len_mean": round(sum(lens) / n, 3) if n else 0.0,
+            "genome_len_max": max(lens, default=0),
+            "total_kills": self.stats["kills"],
+            "total_divisions": self.stats["divisions"],
+            "total_spawned": self.stats["spawned"],
+            "total_mutations": self.stats["genome_changes"],
+        }
 
     def _apply_perm(self, perm: np.ndarray, n_keep: int) -> None:
         self._genomes = [self._genomes[i] for i in perm]
@@ -1520,6 +1652,7 @@ class PipelinedStepper:
         for r, g in changed.items():
             self._genomes[r] = g
         if changed:
+            self.stats["genome_changes"] += len(changed)
             rows_c = sorted(changed)
             genomes_c = [changed[r] for r in rows_c]
             self._change_seq += 1
@@ -1771,12 +1904,13 @@ class PipelinedStepper:
             compact_fn = (
                 _compact_program if self._donate else _compact_program_retained
             )
-            self._state, self.kin.params = compact_fn(
-                self._state,
-                self.kin.params,
-                jnp.asarray(perm.astype(np.int32)),
-                jnp.asarray(n_keep, dtype=jnp.int32),
-            )
+            with self.telemetry.span("compact"):
+                self._state, self.kin.params = compact_fn(
+                    self._state,
+                    self.kin.params,
+                    jnp.asarray(perm.astype(np.int32)),
+                    jnp.asarray(n_keep, dtype=jnp.int32),
+                )
             self._apply_perm(perm, n_keep)
 
         w = self.world
@@ -1798,6 +1932,11 @@ class PipelinedStepper:
         # the World is now the source of truth; the next step() re-pulls
         # it so classic-API mutations in between are picked up
         self._needs_attach = True
+        # a flush is a natural reporting boundary: land a counters row
+        # (gives the summarizer a fresh "last" for deltas) and push the
+        # buffered JSONL through to disk
+        self.telemetry.emit_counters()
+        self.telemetry.flush()
 
     def check_consistency(self) -> None:
         """Assert device and replayed-host state agree (test helper; costs

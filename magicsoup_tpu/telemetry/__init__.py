@@ -1,0 +1,45 @@
+"""
+graftscope — zero-sync telemetry for the magicsoup_tpu step loop.
+
+Three layers, documented in their modules:
+
+- :mod:`.recorder` — :class:`TelemetryRecorder` (host-side phase spans
+  + buffered JSONL emission), :class:`TelemetrySnapshot` (unified
+  runtime-counter view), :func:`trace_window` (jax.profiler capture of
+  a steady-state window), and the ``note_fetch``/``fetch_stats`` D2H
+  accounting fed by ``util.fetch_host``.
+- :mod:`.summary` — stdlib-pure JSONL parsing/validation/aggregation
+  (shared by the CLI and ``scripts/summarize_capture.py``).
+- ``python -m magicsoup_tpu.telemetry summarize run.jsonl`` — per-phase
+  p50/p95 and counter deltas from a recorded run.
+
+The on-device half lives in ``stepper._step_body``: per-step metric
+lanes (alive/occupancy/mass totals) are packed into the step record
+unconditionally, so attaching a recorder changes nothing on device —
+det-mode trajectories are bit-identical telemetry on vs off.
+"""
+from magicsoup_tpu.telemetry.recorder import (
+    TelemetryRecorder,
+    TelemetrySnapshot,
+    fetch_stats,
+    note_fetch,
+    runtime_counters,
+    trace_window,
+)
+from magicsoup_tpu.telemetry.summary import (
+    read_jsonl,
+    summarize_rows,
+    validate_rows,
+)
+
+__all__ = [
+    "TelemetryRecorder",
+    "TelemetrySnapshot",
+    "fetch_stats",
+    "note_fetch",
+    "runtime_counters",
+    "trace_window",
+    "read_jsonl",
+    "summarize_rows",
+    "validate_rows",
+]

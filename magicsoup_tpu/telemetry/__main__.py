@@ -1,0 +1,60 @@
+"""
+CLI for graftscope telemetry files::
+
+    python -m magicsoup_tpu.telemetry summarize run.jsonl [--json]
+    python -m magicsoup_tpu.telemetry validate run.jsonl
+
+``summarize`` prints per-phase p50/p95 timings and counter deltas
+(``--json`` for the machine-readable aggregate); ``validate`` exits
+nonzero listing every schema problem.  Both run schema validation —
+``summarize`` also fails on an invalid file so the CI smoke can gate on
+its exit code alone.
+
+Imports stay stdlib-only (``summary`` module): summarizing a capture
+never initializes a jax backend.
+"""
+import argparse
+import json
+import sys
+
+from magicsoup_tpu.telemetry.summary import (
+    format_summary,
+    read_jsonl,
+    summarize_rows,
+    validate_rows,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m magicsoup_tpu.telemetry")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_sum = sub.add_parser("summarize", help="per-phase p50/p95 + deltas")
+    p_sum.add_argument("path")
+    p_sum.add_argument("--json", action="store_true", dest="as_json")
+    p_val = sub.add_parser("validate", help="schema-check a JSONL file")
+    p_val.add_argument("path")
+    args = ap.parse_args(argv)
+
+    try:
+        rows = read_jsonl(args.path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    problems = validate_rows(rows)
+    if problems:
+        for p in problems:
+            print(f"invalid: {p}", file=sys.stderr)
+        return 1
+    if args.cmd == "validate":
+        print(f"{args.path}: {len(rows)} rows, schema OK")
+        return 0
+    summary = summarize_rows(rows)
+    if args.as_json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

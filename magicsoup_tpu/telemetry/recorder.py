@@ -1,0 +1,319 @@
+"""
+graftscope: the zero-sync telemetry recorder.
+
+Design constraints (the whole point of this module):
+
+- **Zero extra D2H.**  Per-step simulation metrics (alive count, grid
+  occupancy, kill/divide/spawn counts, molecule-mass totals) are packed
+  into the step record *on device* by ``stepper._step_body`` and ride
+  the one sanctioned ``util.fetch_host`` transfer the pipeline already
+  performs.  The recorder only ever sees host-side Python scalars.
+- **Zero retraces.**  Nothing here is called from inside a jitted body;
+  all timing is host-side ``time.perf_counter`` spans around dispatch
+  phases.  graftlint rule GL008 enforces the inverse direction: no
+  ``io_callback``/host work may be planted inside jitted hot bodies in
+  the name of telemetry.
+- **Bit-identity.**  The metric lanes are computed unconditionally (the
+  device program is identical whether a recorder is attached or not),
+  so det-mode trajectories cannot differ telemetry-on vs -off.
+- **Bounded memory.**  Per-phase timing keeps exact count/total/max
+  plus a bounded ring of recent samples for percentiles; the JSONL
+  buffer flushes every ``flush_every`` rows.
+
+Usage::
+
+    world = World(..., telemetry="run.jsonl")     # or:
+    world.telemetry.attach("run.jsonl")
+    ... step ...
+    stepper.flush()                               # drains + flushes rows
+    print(world.telemetry.snapshot().to_dict())
+
+then ``python -m magicsoup_tpu.telemetry summarize run.jsonl``.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+import weakref
+
+from magicsoup_tpu.telemetry.summary import percentile
+
+# per-phase sample rings are trimmed at this size (same bound as the
+# stepper's trace ring): percentiles come from recent samples, totals
+# and maxima stay exact over the full run
+_RING = 4096
+_TRIM = _RING // 2
+
+# process-wide D2H fetch accounting, fed by util.fetch_host
+_fetch_lock = threading.Lock()
+_fetch_count = 0
+_fetch_bytes = 0
+
+
+def note_fetch(nbytes: int) -> None:
+    """Count one sanctioned device->host fetch (called by fetch_host)."""
+    global _fetch_count, _fetch_bytes
+    with _fetch_lock:
+        _fetch_count += 1
+        _fetch_bytes += int(nbytes)
+
+
+def fetch_stats() -> dict[str, int]:
+    """Process-total sanctioned D2H fetches and bytes moved."""
+    with _fetch_lock:
+        return {"fetches": _fetch_count, "fetch_bytes": _fetch_bytes}
+
+
+def runtime_counters() -> dict[str, int]:
+    """One flat dict of every process-global counter: compiles,
+    persistent-cache and phenotype-cache outcomes (from
+    ``analysis.runtime.snapshot``) plus the fetch accounting above.
+    Imported lazily so stdlib-only consumers of this module's sibling
+    ``summary`` never pull jax in."""
+    from magicsoup_tpu.analysis import runtime as _rt
+
+    out = dict(_rt.snapshot())
+    out.update(fetch_stats())
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetrySnapshot:
+    """Point-in-time union of runtime counters and phase timings."""
+
+    counters: dict
+    phases: dict
+    rows_emitted: int
+    path: str | None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _close_handle(fh, buffered: list[str]) -> None:
+    # weakref.finalize target: flush whatever the recorder still holds
+    # buffered if it is garbage-collected while attached
+    try:
+        if buffered:
+            fh.write("\n".join(buffered) + "\n")
+        fh.close()
+    except Exception:
+        pass
+
+
+class TelemetryRecorder:
+    """Host-side span timing + buffered JSONL emission.
+
+    Always constructible and always cheap: an unattached recorder still
+    accumulates phase timings (``span``/``note``/``phase_stats``) so the
+    performance harnesses can share this implementation, but ``emit`` is
+    a no-op until :meth:`attach` opens a JSONL sink.
+    """
+
+    def __init__(self, path=None, *, flush_every: int = 256) -> None:
+        self._lock = threading.Lock()
+        # phase -> [count, total_s, max_s, ring-of-recent-samples]
+        self._phases: dict[str, list] = {}
+        # phase -> seconds since last take_dispatch() (per-dispatch rows)
+        self._window: dict[str, float] = {}
+        self._buffer: list[str] = []
+        self._fh = None
+        self._finalizer = None
+        self.path: str | None = None
+        self.flush_every = max(1, int(flush_every))
+        self.rows_emitted = 0
+        if path is not None:
+            self.attach(path)
+
+    # ------------------------------------------------------- lifecycle
+    @classmethod
+    def coerce(cls, value) -> "TelemetryRecorder":
+        """Normalize ``World(telemetry=...)``: None -> fresh detached
+        recorder, str/PathLike -> recorder attached to that path, an
+        existing recorder passes through (shared across worlds)."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        return cls(path=value)
+
+    @property
+    def attached(self) -> bool:
+        return self._fh is not None
+
+    def attach(self, path) -> "TelemetryRecorder":
+        """Open ``path`` for append and start emitting JSONL rows."""
+        with self._lock:
+            if self._fh is not None:
+                raise ValueError(
+                    f"already attached to {self.path}; detach() first"
+                )
+            self.path = str(path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._finalizer = weakref.finalize(
+                self, _close_handle, self._fh, self._buffer
+            )
+        self.emit(
+            {
+                "type": "meta",
+                "version": 1,
+                # wall-clock on purpose: correlates the run with external
+                # logs; never used for measurement (spans use perf_counter)
+                "wall": time.time(),  # graftlint: disable=GL004 telemetry timestamp, not simulation state
+            }
+        )
+        self.emit_counters()
+        self.flush()
+        return self
+
+    def detach(self) -> None:
+        """Emit a final counters row, flush, and close the sink."""
+        if self._fh is None:
+            return
+        self.emit_counters()
+        with self._lock:
+            fh = self._fh
+            if fh is None:
+                return
+            self._flush_locked()
+            self._fh = None
+            if self._finalizer is not None:
+                self._finalizer.detach()
+                self._finalizer = None
+        fh.close()
+
+    def __getstate__(self):
+        # recorders ride on pickled Worlds; the file handle and lock do
+        # not survive — the unpickled twin starts detached
+        return {"flush_every": self.flush_every}
+
+    def __setstate__(self, state):
+        self.__init__(flush_every=state.get("flush_every", 256))
+
+    # ---------------------------------------------------- span timing
+    @contextlib.contextmanager
+    def span(self, phase: str):
+        """Time a host-side dispatch phase with ``perf_counter``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.note(phase, time.perf_counter() - t0)
+
+    def note(self, phase: str, seconds: float) -> None:
+        """Record an externally measured duration under ``phase``."""
+        with self._lock:
+            rec = self._phases.get(phase)
+            if rec is None:
+                rec = self._phases[phase] = [0, 0.0, 0.0, []]
+            rec[0] += 1
+            rec[1] += seconds
+            if seconds > rec[2]:
+                rec[2] = seconds
+            ring = rec[3]
+            ring.append(seconds)
+            if len(ring) > _RING:
+                del ring[:_TRIM]
+            self._window[phase] = self._window.get(phase, 0.0) + seconds
+
+    def take_dispatch(self) -> dict[str, float]:
+        """Milliseconds per phase since the previous call (and reset).
+
+        The stepper calls this once per dispatch to build the
+        ``dispatch`` JSONL row, so phase costs attribute to the dispatch
+        that paid them."""
+        with self._lock:
+            out = {k: round(v * 1e3, 6) for k, v in self._window.items()}
+            self._window.clear()
+        return out
+
+    def phase_stats(self) -> dict[str, dict]:
+        """Aggregate per-phase stats (count/mean/p50/p95/max/total ms).
+
+        count/total/max are exact over the recorder's lifetime; the
+        percentiles come from the bounded recent-sample ring."""
+        with self._lock:
+            items = {
+                name: (rec[0], rec[1], rec[2], list(rec[3]))
+                for name, rec in self._phases.items()
+            }
+        out: dict[str, dict] = {}
+        for name in sorted(items):
+            n, total, mx, ring = items[name]
+            out[name] = {
+                "n": n,
+                "mean_ms": round(total / n * 1e3, 4) if n else 0.0,
+                "p50_ms": round(percentile(ring, 50) * 1e3, 4),
+                "p95_ms": round(percentile(ring, 95) * 1e3, 4),
+                "max_ms": round(mx * 1e3, 4),
+                "total_ms": round(total * 1e3, 4),
+            }
+        return out
+
+    # ------------------------------------------------------- emission
+    def emit(self, row: dict) -> None:
+        """Buffer one JSONL row (no-op when detached); auto-flushes
+        every ``flush_every`` rows."""
+        if self._fh is None:
+            return
+        line = json.dumps(row, separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:
+                return
+            self._buffer.append(line)
+            self.rows_emitted += 1
+            if len(self._buffer) >= self.flush_every:
+                self._flush_locked()
+
+    def emit_counters(self) -> None:
+        """Emit a ``counters`` row (attach/flush boundaries call this,
+        giving the summarizer first/last values for delta reporting)."""
+        if self._fh is None:
+            return
+        self.emit({"type": "counters", "counters": runtime_counters()})
+
+    def flush(self) -> None:
+        """Write buffered rows through to disk."""
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._fh is None or not self._buffer:
+            return
+        self._fh.write("\n".join(self._buffer) + "\n")
+        self._buffer.clear()
+        self._fh.flush()
+
+    # ------------------------------------------------------- snapshot
+    def snapshot(self) -> TelemetrySnapshot:
+        """Unified point-in-time view: process counters + phase stats."""
+        return TelemetrySnapshot(
+            counters=runtime_counters(),
+            phases=self.phase_stats(),
+            rows_emitted=self.rows_emitted,
+            path=self.path,
+        )
+
+
+@contextlib.contextmanager
+def trace_window(trace_dir: str):
+    """Capture a ``jax.profiler`` trace of the wrapped window.
+
+    Wrap N *steady-state* steps (after warmup, after ``drain()``) so the
+    trace shows the repeating dispatch pattern rather than compile
+    noise; the ``jax.named_scope`` phase tags the stepper plants
+    (``ms:activity``, ``ms:physics``, ``ms:divide``, ...) make the XLA
+    ops attributable to simulation phases in the viewer::
+
+        with telemetry.trace_window("/tmp/msoup-trace"):
+            for _ in range(20):
+                stepper.step()
+            stepper.drain()
+    """
+    import jax
+
+    with jax.profiler.trace(str(trace_dir)):
+        yield

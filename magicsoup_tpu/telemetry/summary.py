@@ -1,0 +1,239 @@
+"""
+Pure-stdlib summarization and validation of graftscope JSONL telemetry.
+
+Kept free of jax/numpy imports ON PURPOSE: ``scripts/summarize_capture.py``
+loads this file directly (``spec_from_file_location``) to fold a
+capture's ``telemetry.jsonl`` into ``BASELINE.json`` without initializing
+a backend, and the ``python -m magicsoup_tpu.telemetry`` CLI reuses the
+same functions so the two consumers cannot drift.
+
+Row schema (one JSON object per line; ``type`` discriminates):
+
+- ``meta``     — one per attach: ``{"version": 1, "wall": <epoch s>}``.
+- ``counters`` — process-total runtime counters (compiles, persistent
+  cache, phenotype cache, D2H fetches) at attach / flush boundaries.
+- ``step``     — one per simulation step, built from the on-device
+  metric lanes of the packed step record plus host replay bookkeeping:
+  ``step``, ``alive``, ``rows``, ``occupied``, ``mm_mass``, ``cm_mass``,
+  per-step ``kills``/``divisions``/``spawned``, genome-length stats,
+  and cumulative ``total_*`` counters (monotone by contract).
+- ``dispatch`` — one per host dispatch: ``k`` (megastep), queue depth,
+  cold/compact flags, and ``phases`` mapping phase name -> milliseconds
+  spent since the previous dispatch row.
+"""
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+
+# keys every step row must carry (the on-device metric lanes)
+REQUIRED_STEP_KEYS = (
+    "step",
+    "alive",
+    "rows",
+    "occupied",
+    "mm_mass",
+    "cm_mass",
+)
+# cumulative counters that must never decrease across step rows
+MONOTONE_STEP_KEYS = (
+    "step",
+    "total_kills",
+    "total_divisions",
+    "total_spawned",
+    "total_mutations",
+)
+
+
+def read_jsonl(path) -> list[dict]:
+    """Parse a JSONL telemetry file into row dicts (blank lines skipped).
+
+    Raises ``ValueError`` naming the offending line number on malformed
+    JSON — a truncated final line from a crashed run is the common case,
+    and the line number makes it obvious.
+    """
+    rows: list[dict] = []
+    with open(Path(path), "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: malformed JSONL row: {e}"
+                ) from e
+    return rows
+
+
+def percentile(values, q: float) -> float:
+    """Nearest-rank-with-interpolation percentile (q in [0, 100]).
+
+    Matches numpy's default 'linear' method so the published p50/p95
+    stay comparable if a future consumer recomputes them with numpy.
+    """
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return math.nan
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * (q / 100.0)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+def phase_quantiles(rows: list[dict]) -> dict[str, dict]:
+    """Per-phase timing stats from the ``dispatch`` rows' ``phases``."""
+    samples: dict[str, list[float]] = {}
+    for row in rows:
+        if row.get("type") != "dispatch":
+            continue
+        for name, ms in (row.get("phases") or {}).items():
+            samples.setdefault(name, []).append(float(ms))
+    out: dict[str, dict] = {}
+    for name in sorted(samples):
+        vals = samples[name]
+        out[name] = {
+            "n": len(vals),
+            "p50_ms": round(percentile(vals, 50), 4),
+            "p95_ms": round(percentile(vals, 95), 4),
+            "max_ms": round(max(vals), 4),
+            "total_ms": round(sum(vals), 4),
+        }
+    return out
+
+
+def counter_deltas(rows: list[dict]) -> dict[str, dict]:
+    """first/last/delta for every counter across the ``counters`` rows."""
+    first: dict[str, float] = {}
+    last: dict[str, float] = {}
+    for row in rows:
+        if row.get("type") != "counters":
+            continue
+        for name, val in (row.get("counters") or {}).items():
+            first.setdefault(name, val)
+            last[name] = val
+    return {
+        name: {
+            "first": first[name],
+            "last": last[name],
+            "delta": last[name] - first[name],
+        }
+        for name in sorted(first)
+    }
+
+
+def validate_rows(rows: list[dict]) -> list[str]:
+    """Schema check; returns human-readable problems (empty == valid).
+
+    The gate the CI smoke runs: required keys on every step row, the
+    ``step`` index strictly increasing, cumulative counters monotone,
+    and dispatch phase timings well-formed non-negative numbers.
+    """
+    problems: list[str] = []
+    prev_step: dict[str, float] = {}
+    prev_index: float | None = None
+    for i, row in enumerate(rows):
+        where = f"row {i}"
+        if not isinstance(row, dict) or "type" not in row:
+            problems.append(f"{where}: not an object with a 'type' key")
+            continue
+        kind = row["type"]
+        if kind == "step":
+            missing = [k for k in REQUIRED_STEP_KEYS if k not in row]
+            if missing:
+                problems.append(f"{where}: step row missing {missing}")
+                continue
+            if prev_index is not None and row["step"] <= prev_index:
+                problems.append(
+                    f"{where}: step index {row['step']} not increasing "
+                    f"(previous {prev_index})"
+                )
+            prev_index = row["step"]
+            for key in MONOTONE_STEP_KEYS:
+                if key not in row:
+                    continue
+                if key in prev_step and row[key] < prev_step[key]:
+                    problems.append(
+                        f"{where}: {key} decreased "
+                        f"({prev_step[key]} -> {row[key]})"
+                    )
+                prev_step[key] = row[key]
+        elif kind == "dispatch":
+            phases = row.get("phases")
+            if not isinstance(phases, dict):
+                problems.append(f"{where}: dispatch row missing 'phases'")
+                continue
+            for name, ms in phases.items():
+                if not isinstance(ms, (int, float)) or ms < 0:
+                    problems.append(
+                        f"{where}: phase {name!r} timing {ms!r} invalid"
+                    )
+        elif kind == "counters":
+            if not isinstance(row.get("counters"), dict):
+                problems.append(f"{where}: counters row missing 'counters'")
+        elif kind != "meta":
+            problems.append(f"{where}: unknown row type {kind!r}")
+    return problems
+
+
+def summarize_rows(rows: list[dict]) -> dict:
+    """The aggregate the CLI prints and ``summarize_capture`` publishes."""
+    steps = [r for r in rows if r.get("type") == "step"]
+    dispatches = [r for r in rows if r.get("type") == "dispatch"]
+    final = {}
+    if steps:
+        final = {k: steps[-1].get(k) for k in REQUIRED_STEP_KEYS}
+        final["total_kills"] = steps[-1].get("total_kills")
+        final["total_divisions"] = steps[-1].get("total_divisions")
+        final["total_spawned"] = steps[-1].get("total_spawned")
+        final["total_mutations"] = steps[-1].get("total_mutations")
+    return {
+        "rows": len(rows),
+        "steps": len(steps),
+        "dispatches": len(dispatches),
+        "phases": phase_quantiles(rows),
+        "counters": counter_deltas(rows),
+        "final": final,
+    }
+
+
+def format_summary(summary: dict) -> str:
+    """Render :func:`summarize_rows` output as an aligned text report."""
+    lines = [
+        f"rows={summary['rows']} steps={summary['steps']} "
+        f"dispatches={summary['dispatches']}"
+    ]
+    if summary["phases"]:
+        lines.append("phase timings (ms):")
+        width = max(len(n) for n in summary["phases"])
+        lines.append(
+            f"  {'phase':<{width}}  {'n':>6}  {'p50':>9}  {'p95':>9}"
+            f"  {'max':>9}  {'total':>10}"
+        )
+        for name, st in summary["phases"].items():
+            lines.append(
+                f"  {name:<{width}}  {st['n']:>6}  {st['p50_ms']:>9.3f}"
+                f"  {st['p95_ms']:>9.3f}  {st['max_ms']:>9.3f}"
+                f"  {st['total_ms']:>10.3f}"
+            )
+    if summary["counters"]:
+        lines.append("counter deltas:")
+        width = max(len(n) for n in summary["counters"])
+        for name, st in summary["counters"].items():
+            lines.append(
+                f"  {name:<{width}}  {st['first']} -> {st['last']}"
+                f"  (+{st['delta']})"
+            )
+    if summary["final"]:
+        fin = summary["final"]
+        lines.append(
+            f"final step: step={fin.get('step')} alive={fin.get('alive')} "
+            f"occupied={fin.get('occupied')} "
+            f"mm_mass={fin.get('mm_mass')} cm_mass={fin.get('cm_mass')}"
+        )
+    return "\n".join(lines)

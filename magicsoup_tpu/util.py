@@ -364,11 +364,31 @@ def fetch_host(arr) -> "np.ndarray":  # noqa: F821 - numpy imported lazily
         if hasattr(arr, "devices"):  # jax.Array -> explicit transfer
             import jax
 
-            return np.asarray(jax.device_get(arr))
+            out = np.asarray(jax.device_get(arr))
+            _note_fetch(out.nbytes)
+            return out
         return np.asarray(arr)  # already host (numpy / scalar / list)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    out = np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    _note_fetch(out.nbytes)
+    return out
+
+
+def _note_fetch(nbytes: int) -> None:
+    """Feed the telemetry D2H accounting; every transfer through the
+    sanctioned boundary is counted, so a fetch-volume regression shows
+    up in ``telemetry.fetch_stats()`` / the counters JSONL rows.
+
+    Bound lazily (the first call rebinds the module global to the real
+    counter) so importing util never drags the telemetry package in —
+    and the steady-state cost is one counter increment, not an import.
+    """
+    global _note_fetch
+    from magicsoup_tpu.telemetry.recorder import note_fetch
+
+    _note_fetch = note_fetch
+    note_fetch(nbytes)
 
 
 def moore_pairs(positions, map_size: int):

@@ -260,12 +260,16 @@ def _step_many(
     activity = _get_activity_fn(det, pallas)
 
     def body(carry, _):
+        # named_scope: profiler-trace phase labels only, no lowering
+        # change (same tags as the pipelined stepper's _step_body)
         mm, cm = carry
-        mm, cm = activity(mm, cm, positions, n_cells, params, q=q)
-        mm, cm = _degrade_diffuse_permeate(
-            mm, cm, positions, n_cells,
-            degrad_factors, kernels, perm_factors, det=det,
-        )
+        with jax.named_scope("ms:activity"):
+            mm, cm = activity(mm, cm, positions, n_cells, params, q=q)
+        with jax.named_scope("ms:physics"):
+            mm, cm = _degrade_diffuse_permeate(
+                mm, cm, positions, n_cells,
+                degrad_factors, kernels, perm_factors, det=det,
+            )
         return (mm, cm), None
 
     (molecule_map, cell_molecules), _ = jax.lax.scan(
@@ -404,6 +408,11 @@ class World:
         phenotype_cache_size: Max entries of the genome->phenotype LRU
             cache (``World.phenotypes``); ``0`` disables cross-call
             caching.  Cached and uncached paths are bit-identical.
+        telemetry: graftscope sink — ``None`` (default) keeps a detached
+            :class:`~magicsoup_tpu.telemetry.TelemetryRecorder` (phase
+            timing only), a path opens a JSONL sink, or pass a recorder
+            to share one stream across worlds.  Attaching a recorder
+            never changes simulation results (README "Telemetry").
 
     State is exposed with the reference's names — ``cell_genomes``,
     ``cell_labels``, ``cell_map``, ``cell_positions``, ``cell_lifetimes``,
@@ -427,12 +436,22 @@ class World:
         mesh: "jax.sharding.Mesh | None" = None,
         use_pallas: bool | None = None,
         phenotype_cache_size: int = 16384,
+        telemetry=None,
     ):
         if seed is None:
             seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
         self.seed = seed
         self._rng = random.Random(seed)
         self._nprng = np.random.default_rng(seed)
+
+        # graftscope recorder (magicsoup_tpu.telemetry): None -> detached
+        # recorder (phase timing only, no emission), a path -> JSONL sink
+        # opened now, an existing TelemetryRecorder -> shared.  Steppers
+        # built on this world pick it up; attach later any time with
+        # ``world.telemetry.attach(path)``.
+        from magicsoup_tpu.telemetry import TelemetryRecorder
+
+        self.telemetry = TelemetryRecorder.coerce(telemetry)
 
         if device is not None and mesh is not None:
             raise ValueError(
@@ -1580,6 +1599,12 @@ class World:
             self.phenotypes = PhenotypeCache(
                 self.genetics, maxsize=_pheno_size
             )
+        # recorders pickle themselves detached (no file handle survives a
+        # save); pre-telemetry pickles get a fresh detached one
+        if self.__dict__.get("telemetry") is None:
+            from magicsoup_tpu.telemetry import TelemetryRecorder
+
+            self.telemetry = TelemetryRecorder()
         if "_warm_sched" not in self.__dict__:
             self._warm_sched = WarmScheduler()
         self.__dict__.setdefault("_mesh", None)

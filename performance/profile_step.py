@@ -10,6 +10,12 @@ optionally captures a `jax.profiler` trace of the hot phases.
 
 Also prints the device round-trip latency (tiny transfer) so remote-tunnel
 overhead is visible separately from compute.
+
+Per-phase timing comes from the graftscope recorder
+(``magicsoup_tpu.telemetry.TelemetryRecorder``) — the same implementation
+the in-loop telemetry uses, so harness numbers and production numbers
+cannot drift; ``--telemetry`` additionally streams the phase rows to a
+JSONL file for ``python -m magicsoup_tpu.telemetry summarize``.
 """
 import json
 import random
@@ -17,8 +23,6 @@ import statistics
 import sys
 import time
 from argparse import ArgumentParser
-from collections import defaultdict
-from contextlib import contextmanager
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
@@ -35,6 +39,8 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--trace-dir", type=str, default=None,
                     help="capture a jax.profiler trace of the timed steps")
+    ap.add_argument("--telemetry", type=str, default=None,
+                    help="also emit graftscope JSONL rows to this path")
     args = ap.parse_args()
 
     # fail fast when the (possibly tunneled) backend is unreachable (a
@@ -75,16 +81,15 @@ def main() -> None:
     )
     atp = CHEMISTRY.molname_2_idx["ATP"]
 
-    times: dict[str, list[float]] = defaultdict(list)
+    # ONE timing implementation for harness and in-loop telemetry: the
+    # recorder's span() feeds workload.sim_step's timeit hook, and its
+    # phase_stats() replaces the old private defaultdict aggregation
+    from magicsoup_tpu.telemetry import TelemetryRecorder, trace_window
 
-    @contextmanager
-    def timeit(label: str):
-        t0 = time.perf_counter()
-        yield
-        times[label].append(time.perf_counter() - t0)
+    rec = TelemetryRecorder(path=args.telemetry)
 
     def step(record: bool) -> None:
-        kwargs = {"timeit": timeit} if record else {}
+        kwargs = {"timeit": rec.span} if record else {}
         sim_step(
             world,
             rng,
@@ -94,18 +99,25 @@ def main() -> None:
             sync=True,
             **kwargs,
         )
+        if record and rec.attached:
+            # one JSONL row per timed step, phases attributed to it
+            rec.emit({"type": "dispatch", "phases": rec.take_dispatch()})
 
     for _ in range(args.warmup):
         step(record=False)
 
-    if args.trace_dir:
-        jax.profiler.start_trace(args.trace_dir)
+    import contextlib
+
+    tracer = (
+        trace_window(args.trace_dir)
+        if args.trace_dir
+        else contextlib.nullcontext()
+    )
     t0 = time.perf_counter()
-    for _ in range(args.steps):
-        step(record=True)
+    with tracer:
+        for _ in range(args.steps):
+            step(record=True)
     total = time.perf_counter() - t0
-    if args.trace_dir:
-        jax.profiler.stop_trace()
 
     per_step = total / args.steps
     print(json.dumps({
@@ -115,10 +127,15 @@ def main() -> None:
         "s_per_step": round(per_step, 4),
         "steps_per_s": round(1.0 / per_step, 3),
     }))
-    for label, vals in sorted(times.items(), key=lambda kv: -sum(kv[1])):
-        print(f"  {label:20s} mean {statistics.mean(vals)*1e3:8.1f} ms"
-              f"  median {statistics.median(vals)*1e3:8.1f} ms"
-              f"  max {max(vals)*1e3:8.1f} ms  n={len(vals)}")
+    stats = rec.phase_stats()
+    for label, st in sorted(
+        stats.items(), key=lambda kv: -kv[1]["total_ms"]
+    ):
+        print(f"  {label:20s} mean {st['mean_ms']:8.1f} ms"
+              f"  p50 {st['p50_ms']:8.1f} ms  p95 {st['p95_ms']:8.1f} ms"
+              f"  max {st['max_ms']:8.1f} ms  n={st['n']}")
+    if args.telemetry:
+        rec.detach()
 
 
 if __name__ == "__main__":

@@ -10,13 +10,21 @@ A second JSON line reports the phenotype-cache smoke: a duplicate-genome
 spawn burst must produce cache hits AND parameters bit-identical to a
 cache-disabled world — this one DOES gate (correctness, not speed).
 
+A third JSON line reports the graftscope telemetry smoke: the pipelined
+run above streams JSONL telemetry, and every row must parse with the
+required keys, cumulative counters must be monotone, the expected number
+of per-step rows must have landed, and the ``summarize`` CLI must accept
+the file — this one also GATES (schema contract, not speed).
+
     python performance/smoke.py [--steps 6] [--megastep 2]
 
 scripts/test.sh runs this after the fast tier.
 """
 import argparse
 import json
+import subprocess
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -52,6 +60,12 @@ def main() -> None:
     chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
     rng = random.Random(args.seed)
     world = ms.World(chemistry=chem, map_size=args.map_size, seed=args.seed)
+    # graftscope rides the whole pipelined run below; validated (GATING)
+    # after the flush
+    tel_path = (
+        Path(tempfile.mkdtemp(prefix="msoup-smoke-")) / "telemetry.jsonl"
+    )
+    world.telemetry.attach(tel_path)
     world.spawn_cells(
         [
             ms.random_genome(s=args.genome_size, rng=rng)
@@ -133,6 +147,59 @@ def main() -> None:
         raise SystemExit(
             "phenotype cache smoke FAILED: "
             f"hits={cached.phenotypes.hits} identical={identical}"
+        )
+
+    # -- telemetry smoke (GATING): schema contract of the JSONL stream
+    # the pipelined run produced, plus the summarize CLI's exit code
+    from magicsoup_tpu.telemetry import read_jsonl, validate_rows
+
+    rows = read_jsonl(tel_path)
+    problems = validate_rows(rows)
+    step_rows = [r for r in rows if r.get("type") == "step"]
+    dispatch_rows = [r for r in rows if r.get("type") == "dispatch"]
+    expect_steps = (args.warmup + args.steps) * args.megastep
+    if len(step_rows) != expect_steps:
+        problems.append(
+            f"expected {expect_steps} step rows, got {len(step_rows)}"
+        )
+    # grid occupancy is computed on device; with one cell per pixel it
+    # must equal the alive count in every row
+    problems += [
+        f"step {r['step']}: occupied {r['occupied']} != alive {r['alive']}"
+        for r in step_rows
+        if r["occupied"] != r["alive"]
+    ]
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "magicsoup_tpu.telemetry",
+            "summarize",
+            str(tel_path),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(Path(__file__).resolve().parents[1]),
+    )
+    if res.returncode != 0:
+        problems.append(
+            f"summarize exited {res.returncode}: {res.stderr[-500:]}"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "smoke telemetry (graftscope JSONL, cpu)",
+                "value": len(step_rows),
+                "unit": "step rows",
+                "dispatch_rows": len(dispatch_rows),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit(
+            "telemetry smoke FAILED: " + "; ".join(problems)
         )
 
 

@@ -23,6 +23,16 @@ from pathlib import Path
 
 _REPO = Path(__file__).resolve().parents[1]
 
+# source of the stdlib-pure telemetry summary helpers; its own constant
+# (not derived from _REPO at call time) so tests can repoint _REPO at a
+# tmp BASELINE.json without losing the module
+_TELEMETRY_SUMMARY_SRC = (
+    Path(__file__).resolve().parents[1]
+    / "magicsoup_tpu"
+    / "telemetry"
+    / "summary.py"
+)
+
 # harness log -> key in BASELINE.json "published"
 _BENCH_LOGS = {
     "bench.log": "headline_10k_128",
@@ -48,6 +58,34 @@ def _json_lines(path: Path) -> list[dict]:
             continue
         if isinstance(d, dict):
             out.append(d)
+    return out
+
+
+def _telemetry_summary(path: Path) -> dict | None:
+    """Fold a capture's graftscope ``telemetry.jsonl`` into per-phase
+    p50/p95 timings and counter deltas.  Loads telemetry/summary.py by
+    FILE PATH (it is stdlib-pure by contract) instead of importing
+    magicsoup_tpu — summarizing a capture must not initialize a jax
+    backend."""
+    if not path.exists():
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_msoup_telemetry_summary", _TELEMETRY_SUMMARY_SRC
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        rows = mod.read_jsonl(path)
+    except ValueError as e:
+        return {"error": str(e)}
+    out = mod.summarize_rows(rows)
+    problems = mod.validate_rows(rows)
+    if problems:
+        # an invalid stream is a capture outcome, not a measurement —
+        # carry WHY so publish() can refuse it
+        out["error"] = "; ".join(problems[:5])
     return out
 
 
@@ -117,6 +155,9 @@ def summarize(outdir: Path) -> dict:
     ]
     if integ:
         summary["integrator"] = integ[-1]
+    tel = _telemetry_summary(outdir / "telemetry.jsonl")
+    if tel is not None:
+        summary["telemetry"] = tel
     return summary
 
 
@@ -170,6 +211,22 @@ def publish(summary: dict) -> None:
                     continue
             pub_ops[op] = {**entry, "capture_dir": summary["capture_dir"]}
             merged = True
+    tel = summary.get("telemetry")
+    # per-phase dispatch timings (p50/p95) live next to check_ops: both
+    # are "how long does the hot path take" records.  Unlike check_ops
+    # these are whole-capture distributions, not single best numbers, so
+    # best-value-wins does not apply — the last CLEAN capture's stream
+    # wins wholesale (an invalid stream carries "error" and is refused,
+    # same cleanliness rule as the bench entries)
+    if tel and "error" not in tel and tel.get("phases"):
+        published["telemetry"] = {
+            "phases": tel["phases"],
+            "counters": tel.get("counters", {}),
+            "steps": tel.get("steps", 0),
+            "dispatches": tel.get("dispatches", 0),
+            "capture_dir": summary["capture_dir"],
+        }
+        merged = True
     for key in ("bitrepro", "integrator"):
         entry = summary.get(key)
         # same cleanliness rule as the bench entries: an errored verdict

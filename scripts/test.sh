@@ -13,5 +13,8 @@ python -m pytest "$TARGET" -q
 # end and leave a throughput number in the CI log (JSON, no threshold —
 # see performance/smoke.py).  Its second JSON line is the phenotype-cache
 # effectiveness gate: a duplicate-genome burst must hit the cache and
-# stay bit-identical to a cache-disabled world (exits nonzero otherwise)
+# stay bit-identical to a cache-disabled world; its third is the
+# graftscope telemetry gate: the run's JSONL stream must validate
+# (schema + monotone counters) and `python -m magicsoup_tpu.telemetry
+# summarize` must accept it (exits nonzero otherwise)
 python performance/smoke.py

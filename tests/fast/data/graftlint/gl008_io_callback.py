@@ -1,0 +1,15 @@
+"""GL008 fixture: a host callback planted inside a jitted body —
+telemetry (or any host work) compiled into the device program."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+
+def _record_metric(x):
+    return x
+
+
+@jax.jit
+def bad_step(x):
+    io_callback(_record_metric, x, x)  # GL008: host callback in jit
+    return x * jnp.int32(2)

@@ -211,6 +211,79 @@ def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
     assert pub(4.5)["spawn_cells"]["value"] == 3.5
 
 
+def _telemetry_lines(phase_ms: list[float], *, bad_counter: bool = False) -> str:
+    # a minimal valid graftscope stream: meta, counters, steps, dispatch
+    # rows with one timed phase, closing counters
+    step_common = {
+        "rows": 8, "occupied": 4, "mm_mass": 1.0, "cm_mass": 0.5,
+    }
+    rows = [
+        {"type": "meta", "version": 1, "wall": 1.0},
+        {"type": "counters", "counters": {"compiles": 2, "fetches": 1}},
+        {"type": "step", "step": 0, "alive": 4, **step_common},
+        {
+            "type": "step",
+            "step": 1 if not bad_counter else 0,  # non-increasing -> invalid
+            "alive": 4,
+            **step_common,
+        },
+    ]
+    rows += [
+        {"type": "dispatch", "phases": {"dispatch": ms}} for ms in phase_ms
+    ]
+    rows.append({"type": "counters", "counters": {"compiles": 5, "fetches": 3}})
+    return "".join(json.dumps(r) + "\n" for r in rows)
+
+
+def test_summarize_folds_telemetry_jsonl(tmp_path):
+    (tmp_path / "telemetry.jsonl").write_text(
+        _telemetry_lines([1.0, 2.0, 3.0, 4.0])
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    tel = summary["telemetry"]
+    assert "error" not in tel
+    assert tel["steps"] == 2
+    assert tel["dispatches"] == 4
+    ph = tel["phases"]["dispatch"]
+    assert ph["n"] == 4
+    assert ph["p50_ms"] == 2.5
+    assert ph["max_ms"] == 4.0
+    # counter deltas: first vs last counters row
+    assert tel["counters"]["compiles"]["delta"] == 3
+    # absent file -> key absent, not an empty stub
+    empty = tmp_path / "no-telemetry"
+    empty.mkdir()
+    assert "telemetry" not in summarize_capture.summarize(empty)
+
+
+def test_publish_telemetry_refuses_invalid_stream(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(text: str, name: str) -> dict:
+        cap = tmp_path / name
+        cap.mkdir(exist_ok=True)
+        (cap / "telemetry.jsonl").write_text(text)
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]
+
+    published = pub(_telemetry_lines([1.0, 2.0]), "cap-clean")
+    assert published["telemetry"]["phases"]["dispatch"]["n"] == 2
+    assert published["telemetry"]["capture_dir"].endswith("cap-clean")
+    # an invalid stream (non-monotone step index) is an outcome, not a
+    # measurement: the previous clean record must survive untouched
+    published = pub(
+        _telemetry_lines([9.0], bad_counter=True), "cap-broken"
+    )
+    assert published["telemetry"]["phases"]["dispatch"]["n"] == 2
+    assert published["telemetry"]["capture_dir"].endswith("cap-clean")
+    # a later clean capture replaces wholesale (last-clean-wins)
+    published = pub(_telemetry_lines([5.0, 6.0, 7.0]), "cap-later")
+    assert published["telemetry"]["phases"]["dispatch"]["n"] == 3
+    assert published["telemetry"]["capture_dir"].endswith("cap-later")
+
+
 def test_transient_markers_cover_tunnel_failure_modes():
     for msg in (
         "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE",

@@ -51,6 +51,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl006_donation.py", "GL006"),
         ("gl006_cellparams.py", "GL006"),
         ("gl007_tolist_loop.py", "GL007"),
+        ("gl008_io_callback.py", "GL008"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):

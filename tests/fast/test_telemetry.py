@@ -1,0 +1,208 @@
+"""
+Tests for graftscope (:mod:`magicsoup_tpu.telemetry`): the recorder's
+span/JSONL mechanics, the unified runtime counter snapshot, and — the
+contracts the subsystem was built around — that attaching telemetry to a
+pipelined run (a) leaves the device program bit-identical in det mode,
+(b) emits exactly K step rows per megastep dispatch, and (c) keeps the
+warmed steady-state loop inside ``hot_path_guard(compile_budget=0)``
+(zero retraces, zero implicit transfers, zero extra D2H).
+"""
+import pickle
+import random
+
+import numpy as np
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.analysis import runtime as lint_rt
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.telemetry import (
+    TelemetryRecorder,
+    read_jsonl,
+    summarize_rows,
+    validate_rows,
+)
+
+_SNAPSHOT_KEYS = {
+    "compiles",
+    "persistent_cache_hits",
+    "persistent_cache_misses",
+    "phenotype_hits",
+    "phenotype_misses",
+    "phenotype_evictions",
+}
+
+
+def _chem(tag: str):
+    mols = [
+        ms.Molecule(f"{tag}-a", 10e3),
+        ms.Molecule(f"{tag}-atp", 8e3, half_life=100_000),
+    ]
+    return ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+
+def _stepper(world, tag: str, **kw) -> PipelinedStepper:
+    cfg = dict(
+        mol_name=f"{tag}-atp",
+        kill_below=-1.0,  # nothing dies
+        divide_above=1e30,  # nothing divides
+        divide_cost=0.0,
+        target_cells=None,  # nothing spawns
+        genome_size=250,
+        lag=2,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+    cfg.update(kw)
+    return PipelinedStepper(world, **cfg)
+
+
+# --------------------------------------------------------- recorder
+def test_detached_recorder_accumulates_but_never_emits(tmp_path):
+    rec = TelemetryRecorder()
+    assert not rec.attached
+    with rec.span("fetch"):
+        pass
+    rec.note("fetch", 0.002)
+    rec.emit({"type": "dispatch", "phases": {}})  # no-op while detached
+    stats = rec.phase_stats()
+    assert stats["fetch"]["n"] == 2
+    assert stats["fetch"]["p95_ms"] >= stats["fetch"]["p50_ms"] >= 0.0
+    assert rec.rows_emitted == 0
+
+
+def test_attached_recorder_jsonl_roundtrip(tmp_path):
+    path = tmp_path / "t.jsonl"
+    rec = TelemetryRecorder(path=path, flush_every=1)
+    rec.note("dispatch", 0.004)
+    rec.note("dispatch", 0.001)
+    rec.emit({"type": "dispatch", "phases": rec.take_dispatch()})
+    # the window drains: a second take has nothing to attribute
+    assert rec.take_dispatch() == {}
+    rec.emit_counters()
+    rec.detach()
+    rows = read_jsonl(path)
+    assert validate_rows(rows) == []
+    assert rows[0]["type"] == "meta" and rows[0]["version"] == 1
+    dispatch = [r for r in rows if r["type"] == "dispatch"]
+    assert len(dispatch) == 1
+    # both notes landed in the one window, in milliseconds
+    assert abs(dispatch[0]["phases"]["dispatch"] - 5.0) < 1e-6
+    counters = [r for r in rows if r["type"] == "counters"]
+    assert counters and _SNAPSHOT_KEYS <= set(counters[-1]["counters"])
+    summary = summarize_rows(rows)
+    assert summary["dispatches"] == 1
+    assert summary["phases"]["dispatch"]["n"] == 1
+
+
+def test_recorder_pickles_as_detached_twin(tmp_path):
+    rec = TelemetryRecorder(path=tmp_path / "t.jsonl", flush_every=7)
+    rec.note("push", 0.001)
+    twin = pickle.loads(pickle.dumps(rec))
+    assert not twin.attached
+    assert twin.flush_every == 7
+    twin.note("push", 0.001)  # still usable for timing
+    rec.detach()
+
+
+def test_runtime_snapshot_and_reset():
+    import jax.numpy as jnp
+
+    # force at least one compile so the snapshot has something to show
+    np.asarray(jnp.arange(3) * 2)
+    snap = lint_rt.snapshot()
+    assert set(snap) == _SNAPSHOT_KEYS
+    assert all(isinstance(v, int) for v in snap.values())
+    lint_rt.reset_counters()
+    assert all(v == 0 for v in lint_rt.snapshot().values())
+
+
+# ------------------------------------------------- pipeline contracts
+def test_megastep_dispatch_emits_k_step_rows(tmp_path):
+    path = tmp_path / "t.jsonl"
+    chem = _chem("tk")
+    rng = random.Random(5)
+    world = ms.World(chemistry=chem, map_size=16, seed=5, telemetry=path)
+    assert world.telemetry.attached
+    world.spawn_cells([ms.random_genome(s=250, rng=rng) for _ in range(12)])
+    st = _stepper(world, "tk", megastep=3, lag=1)
+    n_dispatch = 4
+    for _ in range(n_dispatch):
+        st.step()
+    st.drain()
+    st.flush()
+    rows = read_jsonl(path)
+    assert validate_rows(rows) == []
+    step_rows = [r for r in rows if r["type"] == "step"]
+    dispatch_rows = [r for r in rows if r["type"] == "dispatch"]
+    # K fused device steps -> K step rows per dispatch row
+    assert len(dispatch_rows) == n_dispatch
+    assert all(r["k"] == 3 for r in dispatch_rows)
+    assert len(step_rows) == n_dispatch * 3
+    # the on-device lanes: one cell per pixel, masses finite and positive
+    for r in step_rows:
+        assert r["occupied"] == r["alive"] == 12
+        assert np.isfinite(r["mm_mass"]) and r["mm_mass"] > 0
+        assert np.isfinite(r["cm_mass"])
+
+
+def test_det_mode_records_bit_identical_telemetry_on_vs_off(tmp_path):
+    # THE zero-perturbation contract: the metric lanes are computed
+    # unconditionally inside the packed record, so attaching telemetry
+    # changes NOTHING on device — every fetched record byte-identical
+    chem = _chem("ti")
+
+    def run(telemetry):
+        rng = random.Random(13)
+        world = ms.World(
+            chemistry=chem, map_size=16, seed=13, telemetry=telemetry
+        )
+        world.deterministic = True
+        world.spawn_cells(
+            [ms.random_genome(s=250, rng=rng) for _ in range(16)]
+        )
+        st = _stepper(world, "ti", kill_below=0.1, lag=1)
+        records: list[bytes] = []
+        unpack = st._unpack_outputs
+        st._unpack_outputs = lambda a: (
+            records.append(np.asarray(a).tobytes()),
+            unpack(a),
+        )[1]
+        for _ in range(5):
+            st.step()
+        st.drain()
+        st.flush()
+        return records, np.asarray(world.molecule_map).tobytes()
+
+    recs_off, mm_off = run(None)
+    recs_on, mm_on = run(tmp_path / "t.jsonl")
+    assert len(recs_on) == len(recs_off) == 5
+    assert recs_on == recs_off
+    assert mm_on == mm_off
+    rows = read_jsonl(tmp_path / "t.jsonl")
+    assert validate_rows(rows) == []
+    assert sum(r["type"] == "step" for r in rows) == 5
+
+
+def test_steady_state_with_telemetry_passes_hot_path_guard(tmp_path):
+    # the acceptance contract: telemetry-on steady state compiles
+    # nothing and makes no implicit transfers — emission rides the
+    # records the replay already fetched
+    path = tmp_path / "t.jsonl"
+    chem = _chem("tg")
+    rng = random.Random(11)
+    world = ms.World(chemistry=chem, map_size=32, seed=11, telemetry=path)
+    world.spawn_cells([ms.random_genome(s=250, rng=rng) for _ in range(40)])
+    st = _stepper(world, "tg")
+    for _ in range(8):  # warm every variant the window will use
+        st.step()
+    st.drain()
+
+    with lint_rt.hot_path_guard(compile_budget=0) as stats:
+        for _ in range(5):
+            st.step()
+        st.drain()
+    assert stats.compiles == 0
+    st.flush()
+    rows = read_jsonl(path)
+    assert validate_rows(rows) == []
+    assert sum(r["type"] == "step" for r in rows) == 13

@@ -58,11 +58,14 @@ st = PipelinedStepper(
 for _ in range(3):
     st.step()
 st.flush()
+# one atomic counter view (analysis.runtime.snapshot) instead of three
+# separate accessor reads
+snap = rt.snapshot()
 print(json.dumps({{
     "cache_dir": ensure_compile_cache(),
-    "hits": rt.persistent_cache_hits(),
-    "misses": rt.persistent_cache_misses(),
-    "compiles": rt.compile_count(),
+    "hits": snap["persistent_cache_hits"],
+    "misses": snap["persistent_cache_misses"],
+    "compiles": snap["compiles"],
 }}))
 """
 
