@@ -11,6 +11,8 @@ Findings; registration at the bottom.
 | GL005 | blocking-transfer    | the single audited D2H boundary            |
 | GL006 | missing-donation     | steady-state HBM (step buffers donated)    |
 | GL007 | tolist-in-hot-loop   | batch host conversion (no per-item tolist) |
+| GL008 | host-callback-in-jit | no host round trips inside jitted bodies   |
+| GL009 | missing-sharding     | explicit placement in mesh-aware modules   |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -111,6 +113,14 @@ RULE_INFO = {
         "io_callback/pure_callback/jax.debug host work inside a jitted "
         "body — a host round trip compiled into the device program; "
         "telemetry must ride the packed output record instead",
+    ),
+    "GL009": (
+        "missing-sharding",
+        "hot-path `jax.device_put` / jnp array construction without an "
+        "explicit device/sharding inside a mesh-aware module — the "
+        "array lands on the default device uncommitted, and a sharded "
+        "jit silently re-replicates it across the mesh on EVERY "
+        "dispatch (the silent-replication footgun)",
     ),
 }
 
@@ -798,6 +808,116 @@ def check_gl008(ctx: Context):
                     )
 
 
+# --------------------------------------------------------------- GL009
+# a module is mesh-aware when it imports sharding machinery at the TOP
+# level (jax.sharding / shard_map / magicsoup_tpu.parallel).  Lazy
+# in-function imports (world.py's tiled fallback) deliberately do not
+# count: those modules place buffers through the mesh-aware ones.
+_MESH_IMPORT_ROOTS = (
+    "jax.sharding",
+    "jax.experimental.shard_map",
+    "magicsoup_tpu.parallel",
+)
+# jnp constructors that materialize NEW buffers and accept `device=`
+# (zeros_like & co. inherit their prototype's sharding and are exempt)
+_PLACEMENT_CTORS = {
+    "asarray",
+    "array",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "arange",
+}
+
+
+def _is_mesh_aware(f) -> bool:
+    for node in f.tree.body:
+        if isinstance(node, ast.Import):
+            if any(
+                alias.name.startswith(_MESH_IMPORT_ROOTS)
+                for alias in node.names
+            ):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.startswith(_MESH_IMPORT_ROOTS):
+                return True
+    return False
+
+
+def check_gl009(ctx: Context):
+    """Placement must be explicit in mesh-aware modules: a bare
+    ``jax.device_put(x)`` or ``jnp.asarray/zeros/...`` WITHOUT a
+    device/sharding lands the buffer on the default device
+    uncommitted, so a sharded jit re-replicates it across the mesh on
+    every dispatch — silently, because GSPMD treats an unplaced input
+    as "replicate however you like".  Jitted bodies are exempt (inside
+    a trace, intermediates are placed by GSPMD / sharding constraints,
+    not ``device=``); so are non-mesh-aware modules, where there is
+    only one device to land on."""
+    fix = (
+        "pass the placement explicitly — `device=sharding` on the jnp "
+        "constructor or a second argument to `jax.device_put` (use "
+        "tiled.replicated_sharding/cell_sharding/map_sharding, or the "
+        "stepper's `_dev()` helper); waive a deliberate single-device "
+        "fallback branch with `# graftlint: disable=GL009`"
+    )
+    mesh_ids = {id(f) for f in ctx.files if _is_mesh_aware(f)}
+    jit_ids_by_file: dict[int, set[int]] = {}
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        if id(f) not in mesh_ids:
+            continue
+        if id(f) not in jit_ids_by_file:
+            jit_ids_by_file[id(f)] = {
+                id(n)
+                for fn_node, _w, _k in _jit_wrapped_defs(ctx, f)
+                for n in ast.walk(fn_node)
+            }
+        jit_ids = jit_ids_by_file[id(f)]
+        if id(rec.node) in jit_ids:
+            continue  # traced body: GSPMD places intermediates
+        for node in ast.walk(rec.node):
+            if (
+                not isinstance(node, ast.Call)
+                or id(node) in jit_ids
+            ):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            root = chain.split(".", 1)[0]
+            leaf = chain.rsplit(".", 1)[-1]
+            if root not in JAX_ROOTS:
+                continue
+            kwnames = {kw.arg for kw in node.keywords}
+            if "device" in kwnames:
+                continue
+            if leaf == "device_put" and len(node.args) < 2:
+                yield _finding(
+                    "GL009",
+                    f,
+                    node,
+                    f"`{chain}()` without a placement in hot function "
+                    f"`{rec.qualname}` of a mesh-aware module — the "
+                    "buffer is uncommitted and a sharded jit "
+                    "re-replicates it every dispatch",
+                    fix,
+                )
+            elif leaf in _PLACEMENT_CTORS:
+                yield _finding(
+                    "GL009",
+                    f,
+                    node,
+                    f"`{chain}()` without `device=` in hot function "
+                    f"`{rec.qualname}` of a mesh-aware module — the "
+                    "array lands on the default device instead of its "
+                    "mesh sharding",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -807,6 +927,7 @@ CHECKERS = {
     "GL006": check_gl006,
     "GL007": check_gl007,
     "GL008": check_gl008,
+    "GL009": check_gl009,
 }
 
 

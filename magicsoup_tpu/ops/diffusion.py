@@ -78,9 +78,12 @@ def stencil_3x3(map_: jax.Array, kernels: jax.Array) -> jax.Array:
     return out
 
 
-@partial(jax.jit, static_argnames=("det",))
+@partial(jax.jit, static_argnames=("det", "mesh"))
 def diffuse(
-    molecule_map: jax.Array, kernels: jax.Array, det: bool = False
+    molecule_map: jax.Array,
+    kernels: jax.Array,
+    det: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """
     One diffusion step: a depthwise 3x3 torus stencil for every molecule
@@ -94,7 +97,22 @@ def diffuse(
     multiply feeding the f32 accumulating add would be FMA-contracted on
     TPU but not CPU; f64 multiply-add is deterministic on both) and the
     map totals use the fixed f64 reduction tree.
+
+    ``mesh`` (static, hashable) routes a ROW-SHARDED map through the
+    halo-exchange stencil in parallel/tiled.py: each tile computes its
+    local rows plus 1-row ``ppermute`` halos instead of letting GSPMD
+    partition the roll-based stencil (which would all-gather the map
+    per tap).  Both routes share :func:`stencil_3x3`'s canonical tap
+    order, and the det-mode sharded fixup replicates the single-device
+    fixed reduction tree, so the result is bit-identical either way
+    (pinned by test_parallel.py's halo bit-identity tests).
     """
+    if mesh is not None and mesh.shape[mesh.axis_names[0]] > 1:
+        # deferred import: parallel/tiled.py imports this module at top
+        # level, so the mesh route resolves its helper lazily
+        from magicsoup_tpu.parallel.tiled import halo_diffuse
+
+        return halo_diffuse(molecule_map, kernels, mesh, det=det)
     m = molecule_map.shape[1]
 
     # totals use the f64 tree in BOTH modes: the fixup is a small

@@ -385,5 +385,28 @@ def compact_rows(arr: jax.Array, perm: jax.Array, n_keep: jax.Array) -> jax.Arra
 # graftlint: disable=GL006 compaction gather cannot alias in place (arbitrary row permutation); fires on kill events only
 @jax.jit
 def permute_params(state: CellParams, perm: jax.Array, n_keep: jax.Array) -> CellParams:
-    """:func:`compact_rows` over all nine parameter tensors."""
+    """:func:`compact_rows` over all nine parameter tensors.
+
+    Under a device mesh the permutation gather crosses tile boundaries
+    (a compacted row's new slot may live on another tile), so GSPMD
+    inserts a cell-axis redistribution here; callers that need the
+    OUTPUT pinned back to the cell sharding (the stepper's in-step and
+    flush compaction) wrap the result in :func:`constrain_rows` —
+    without the constraint XLA may leave the compacted tensors
+    replicated, silently de-sharding every later step."""
     return CellParams(*(compact_rows(s, perm, n_keep) for s in state))
+
+
+def constrain_rows(tree, sharding):
+    """Pin every array leaf of ``tree`` (a :class:`CellParams` or any
+    pytree of per-cell row tensors) to ``sharding`` via
+    ``with_sharding_constraint`` — the shard-awareness hook the mesh
+    step programs apply after row gathers/scatters whose output
+    sharding GSPMD would otherwise infer (and sometimes infer as
+    replicated).  ``sharding=None`` is the identity, so unsharded
+    callers share the same code path."""
+    if sharding is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.with_sharding_constraint(t, sharding), tree
+    )

@@ -69,6 +69,15 @@ def cell_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names[0]))
 
 
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement on the mesh — for scalars, small
+    control tensors (spawn/push batches, PRNG keys, occupancy) and the
+    packed step record: everything the host touches per step must be
+    replicated so the fetch reads ONE addressable shard (a single
+    transfer, same as the single-device record contract)."""
+    return NamedSharding(mesh, P())
+
+
 def shard_params(params: CellParams, mesh: Mesh) -> CellParams:
     """Place the 9 kinetic parameter tensors sharded along the cell axis"""
     sh = cell_sharding(mesh)
@@ -183,7 +192,7 @@ def make_sharded_step(
     """
     map_sh = map_sharding(mesh)
     cell_sh = cell_sharding(mesh)
-    replicated = NamedSharding(mesh, P())
+    replicated = replicated_sharding(mesh)
     param_shardings = CellParams(*(cell_sh for _ in CellParams._fields))
 
     # graftlint: disable=GL006 params is read-only; only (molecule_map, cell_molecules) successors are returned

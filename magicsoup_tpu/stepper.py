@@ -53,6 +53,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import detmath as _detmath
@@ -61,6 +62,7 @@ from magicsoup_tpu.ops.integrate import CellParams, _integrate_signals_jit
 from magicsoup_tpu.ops.params import (
     compact_rows,
     compute_cell_params,
+    constrain_rows,
     copy_params,
     next_rung,
     permute_params,
@@ -107,6 +109,10 @@ class StepOutputs(NamedTuple):
     n_occupied: int  # occupied map pixels after the step
     mm_mass: float  # total molecule mass on the map (pre-compaction sum)
     cm_mass: float  # total intracellular molecule mass
+    # mesh-placed runs only: occupied pixels per map-row tile (n_tiles,)
+    # i32 — the load-balance lane riding the same packed record; None on
+    # single-device runs (the record layout is unchanged there)
+    tile_occupancy: Any = None
 
 
 _BITS = 16  # bits packed per i32 word (16 keeps every value positive)
@@ -263,6 +269,7 @@ def _step_body(
     compact: bool,
     q: int | None = None,
     use_pallas: bool = False,
+    mesh=None,
 ) -> tuple[DeviceState, CellParams, jax.Array]:
     """One fused workload step (spawn -> activity -> select -> kill ->
     divide -> degrade/diffuse/permeate [-> compact]) — a single dispatch,
@@ -280,11 +287,39 @@ def _step_body(
     on a remote-compile platform every variant is seconds of stall the
     first time it appears (ops/params.py IDX_BLOCK has the same
     rationale).  The compiled-variant axes are exactly ``q`` (bounded
-    ladder, prewarmed one rung ahead) and ``compact``."""
+    ladder, prewarmed one rung ahead) and ``compact``.
+
+    ``mesh`` (static, hashable) runs the whole program SPMD over a 1D
+    device mesh: the molecule map stays row-sharded (diffusion routes
+    through tiled.py's ppermute halo exchange), cell state and all nine
+    CellParams tensors stay cell-sharded, and the packed output record
+    is constrained REPLICATED so the host replay still costs exactly one
+    fetch.  The body's math is sharding-agnostic — GSPMD inserts the
+    cell<->map exchange collectives — and the trailing constraints pin
+    the state shardings so the scan carry / dispatch loop never drifts
+    placements between steps.  Mesh runs add ``n_tiles`` per-tile
+    occupancy lanes to the record tail (single-device layout unchanged).
+    In det mode every cross-row reduction is either integer-exact, a
+    detmath fixed tree, or the halo stencil's replicated-tree fixup, so
+    the sharded trajectory is bit-identical to the single-device one
+    (pinned by test_parallel.py)."""
     mm, cm, pos, occ, alive, n_rows, key = state
     cap, n_mols = cm.shape
     if q is None or q > cap:
         q = cap
+    # sharding pins for the mesh route (None mesh = all no-ops): state
+    # leaves keep the placement the world chose (map by rows, cells by
+    # slots), everything host-visible is replicated
+    if mesh is not None:
+        _axis = mesh.axis_names[0]
+        _map_sh = NamedSharding(mesh, _P(None, _axis, None))
+        _cell_sh = NamedSharding(mesh, _P(_axis))
+        _rep_sh = NamedSharding(mesh, _P())
+    else:
+        _map_sh = _cell_sh = _rep_sh = None
+
+    def _pin(x, sh):
+        return x if sh is None else jax.lax.with_sharding_constraint(x, sh)
     m = occ.shape[0]
     rows = jnp.arange(cap, dtype=jnp.int32)
     key, k_spawn, k_div = jax.random.split(key, 3)
@@ -399,7 +434,7 @@ def _step_body(
     with jax.named_scope("ms:physics"):
         mm = mm * degrad_factors[:, None, None]
         cm = cm * degrad_factors[None, :]
-        mm = _diff.diffuse(mm, kernels, det=det)
+        mm = _diff.diffuse(mm, kernels, det=det, mesh=mesh)
         xs, ys = pos[:, 0], pos[:, 1]
         ext = mm[:, xs, ys].T
         new_cm, new_ext = _diff.permeate(cm, ext, perm_factors, det=det)
@@ -422,6 +457,17 @@ def _step_body(
             mm_mass = jnp.sum(mm)
             cm_mass = jnp.sum(cm)
         n_occupied = occ.sum(dtype=jnp.int32)
+        if mesh is not None:
+            # per-tile occupancy: one i32 lane per map-row tile (the
+            # row-block split matches tiled.map_sharding), riding the
+            # packed record so load-balance telemetry costs zero extra
+            # transfers.  Integer sum — exact under any partitioning.
+            n_tiles = mesh.shape[mesh.axis_names[0]]
+            tile_occ = (
+                occ.reshape(n_tiles, -1).sum(axis=1).astype(jnp.int32)
+            )
+        else:
+            tile_occ = None
 
     # ---- 5. optional compaction ---------------------------------------
     child_pos_out = cpos[jnp.clip(p_idx, 0, cap - 1)]
@@ -444,35 +490,52 @@ def _step_body(
     # the two f32 mass totals bitcast into i32 (the host re-views the
     # bits as float32 — exact, no rounding through a cast)
     with jax.named_scope("ms:pack_record"):
-        out = jnp.concatenate(
-            [
-                jnp.stack(
-                    [
-                        n_placed,
-                        n_candidates,
-                        n_attempted,
-                        n_rows,
-                        alive.sum(dtype=jnp.int32),
-                        n_occupied,
-                        jax.lax.bitcast_convert_type(
-                            mm_mass.astype(jnp.float32), jnp.int32
-                        ),
-                        jax.lax.bitcast_convert_type(
-                            cm_mass.astype(jnp.float32), jnp.int32
-                        ),
-                    ]
-                ).astype(jnp.int32),
-                _pack_bits(kill),
-                p_idx,
-                child_pos_out.reshape(-1).astype(jnp.int32),
-                _pack_bits(spawn_ok),
-                spawn_pos.reshape(-1).astype(jnp.int32),
-            ]
-        )
+        lanes = [
+            jnp.stack(
+                [
+                    n_placed,
+                    n_candidates,
+                    n_attempted,
+                    n_rows,
+                    alive.sum(dtype=jnp.int32),
+                    n_occupied,
+                    jax.lax.bitcast_convert_type(
+                        mm_mass.astype(jnp.float32), jnp.int32
+                    ),
+                    jax.lax.bitcast_convert_type(
+                        cm_mass.astype(jnp.float32), jnp.int32
+                    ),
+                ]
+            ).astype(jnp.int32),
+            _pack_bits(kill),
+            p_idx,
+            child_pos_out.reshape(-1).astype(jnp.int32),
+            _pack_bits(spawn_ok),
+            spawn_pos.reshape(-1).astype(jnp.int32),
+        ]
+        if tile_occ is not None:
+            # mesh lanes ride the TAIL so every single-device offset in
+            # _unpack_outputs stays byte-for-byte unchanged
+            lanes.append(tile_occ)
+        out = jnp.concatenate(lanes)
+    # mesh: pin the outgoing shardings.  The header scalars fold via
+    # psum-style partial reductions, the kill/parent/spawn lanes are
+    # assembled from cell-sharded pieces, and the replicated constraint
+    # on `out` makes XLA all-gather them ONCE here — one small record
+    # all-gather per step instead of a host-side multi-shard fetch.  The
+    # state constraints keep the scan carry / dispatch loop on the same
+    # placements every step (no inferred-sharding drift, no implicit
+    # resharding at the next dispatch).
     new_state = DeviceState(
-        mm=mm, cm=cm, pos=pos, occ=occ, alive=alive, n_rows=n_rows, key=key
+        mm=_pin(mm, _map_sh),
+        cm=_pin(cm, _cell_sh),
+        pos=_pin(pos, _cell_sh),
+        occ=_pin(occ, _rep_sh),
+        alive=_pin(alive, _cell_sh),
+        n_rows=_pin(n_rows, _rep_sh),
+        key=_pin(key, _rep_sh),
     )
-    return new_state, params, out
+    return new_state, constrain_rows(params, _cell_sh), _pin(out, _rep_sh)
 
 
 # donate_argnums=(0, 1): the step consumes (state, params) and returns
@@ -483,6 +546,7 @@ _pipeline_step = functools.partial(
     jax.jit,
     static_argnames=(
         "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+        "mesh",
     ),
     donate_argnums=(0, 1),
 )(_step_body)
@@ -499,6 +563,7 @@ _pipeline_step_retained = functools.partial(  # graftlint: disable=GL006 CPU twi
     jax.jit,
     static_argnames=(
         "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+        "mesh",
     ),
 )(_step_body)
 
@@ -514,6 +579,7 @@ def _donate_step_buffers() -> bool:
     jax.jit,
     static_argnames=(
         "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+        "mesh",
     ),
     donate_argnums=(0, 1),
 )
@@ -542,6 +608,7 @@ def _megastep(
     q: int | None = None,
     use_pallas: bool = False,
     k: int = 1,
+    mesh=None,
 ) -> tuple[DeviceState, CellParams, jax.Array]:
     """``k`` fused pipeline steps in ONE dispatch: a ``lax.scan`` over
     :func:`_step_body`, per-step packed output records stacked into one
@@ -583,6 +650,7 @@ def _megastep(
             compact=False,
             q=q,
             use_pallas=use_pallas,
+            mesh=mesh,
         )
         return (state, params), out
 
@@ -620,6 +688,7 @@ def _megastep(
         compact=compact,
         q=q,
         use_pallas=use_pallas,
+        mesh=mesh,
     )
     if outs is None:
         outs = out_last[None]
@@ -633,33 +702,52 @@ _megastep_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of 
     jax.jit,
     static_argnames=(
         "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+        "mesh",
     ),
 )(_megastep.__wrapped__)
 
 
 def _compact_body(
-    state: DeviceState, params: CellParams, perm: jax.Array, n_keep: jax.Array
+    state: DeviceState,
+    params: CellParams,
+    perm: jax.Array,
+    n_keep: jax.Array,
+    *,
+    mesh=None,
 ) -> tuple[DeviceState, CellParams]:
-    """Standalone compaction (used by :meth:`PipelinedStepper.flush`)."""
+    """Standalone compaction (used by :meth:`PipelinedStepper.flush`).
+    Under a mesh the row gathers cross tile boundaries, so the outputs
+    are constrained back to the cell sharding (see permute_params)."""
+    cell_sh = (
+        NamedSharding(mesh, _P(mesh.axis_names[0]))
+        if mesh is not None
+        else None
+    )
     return (
         DeviceState(
             mm=state.mm,
-            cm=compact_rows(state.cm, perm, n_keep),
-            pos=compact_rows(state.pos, perm, n_keep),
+            cm=constrain_rows(compact_rows(state.cm, perm, n_keep), cell_sh),
+            pos=constrain_rows(
+                compact_rows(state.pos, perm, n_keep), cell_sh
+            ),
             occ=state.occ,
-            alive=jnp.arange(state.alive.shape[0]) < n_keep,
+            alive=constrain_rows(
+                jnp.arange(state.alive.shape[0]) < n_keep, cell_sh
+            ),
             n_rows=n_keep,
             key=state.key,
         ),
-        permute_params(params, perm, n_keep),
+        constrain_rows(permute_params(params, perm, n_keep), cell_sh),
     )
 
 
-_compact_program = functools.partial(jax.jit, donate_argnums=(0, 1))(
-    _compact_body
-)
+_compact_program = functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("mesh",)
+)(_compact_body)
 # CPU twin — same rationale as _pipeline_step_retained
-_compact_program_retained = jax.jit(_compact_body)  # graftlint: disable=GL006 CPU twin of _compact_program; donation races XLA:CPU async execution
+_compact_program_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of _compact_program; donation races XLA:CPU async execution
+    jax.jit, static_argnames=("mesh",)
+)(_compact_body)
 
 
 class _Worker:
@@ -783,9 +871,15 @@ class PipelinedStepper:
     documented deltas vs the serial loop).
 
     Parameters:
-        world: The world to drive.  Must not be mesh-placed (the sharded
-            step keeps the classic loop); its current population becomes
-            the starting state.
+        world: The world to drive; its current population becomes the
+            starting state.  Mesh-placed worlds are fully supported: the
+            fused step (and the megastep scan) runs SPMD over the 1D
+            mesh with the map row-sharded, cell state and parameters
+            cell-sharded, halo-exchange diffusion, and a replicated
+            packed record — the host replay and one-fetch-per-step
+            contract are identical to the single-device driver, and in
+            det mode the sharded trajectory is bit-identical to the
+            single-device one (README "Scaling across a mesh").
         mol_name: Molecule whose intracellular amount drives selection
             (``"ATP"`` in the canonical workload).
         kill_below: Kill cells below this amount.
@@ -873,11 +967,25 @@ class PipelinedStepper:
         auto_grow: bool = True,
         overlap_evolution: bool = True,
     ):
-        if world._mesh is not None:
-            raise ValueError(
-                "PipelinedStepper drives single-device worlds; mesh-placed"
-                " worlds keep the classic loop"
+        # mesh-placed worlds run the fused step SPMD (see _step_body's
+        # mesh note); all host->device placements below go through
+        # _dev()/device= so every dispatch input is explicitly placed —
+        # an uncommitted input would be implicitly replicated at EVERY
+        # dispatch (a transfer per step, and a transfer-guard violation
+        # under hot_path_guard)
+        self._mesh = world._mesh
+        if self._mesh is not None:
+            from magicsoup_tpu.parallel import tiled as _tiled
+
+            self._n_tiles = int(
+                self._mesh.shape[self._mesh.axis_names[0]]
             )
+            self._rep_sh = _tiled.replicated_sharding(self._mesh)
+            self._map_sh = world._map_sharding
+            self._cell_sh = world._cell_sharding
+        else:
+            self._n_tiles = 1
+            self._rep_sh = self._map_sh = self._cell_sh = None
         self.world = world
         self.kin = world.kinetics
         self.mol_idx = world.chemistry.molname_2_idx[mol_name]
@@ -938,15 +1046,30 @@ class PipelinedStepper:
         # constant device scalars, built once — jnp.asarray per dispatch
         # would put five tiny host->device transfers on the very critical
         # path this driver exists to clear
-        self._mol_idx_dev = jnp.asarray(self.mol_idx, dtype=jnp.int32)
-        self._kill_below_dev = jnp.asarray(self.kill_below, dtype=jnp.float32)
-        self._divide_above_dev = jnp.asarray(
-            self.divide_above, dtype=jnp.float32
-        )
-        self._divide_cost_dev = jnp.asarray(
-            self.divide_cost, dtype=jnp.float32
-        )
-        self._abs_temp_dev = jnp.asarray(world.abs_temp, dtype=jnp.float32)
+        self._mol_idx_dev = self._dev(self.mol_idx, jnp.int32)
+        self._kill_below_dev = self._dev(self.kill_below, jnp.float32)
+        self._divide_above_dev = self._dev(self.divide_above, jnp.float32)
+        self._divide_cost_dev = self._dev(self.divide_cost, jnp.float32)
+        self._abs_temp_dev = self._dev(world.abs_temp, jnp.float32)
+        # world-owned program constants: under a mesh keep stepper-local
+        # REPLICATED placements — the world's uncommitted copies would be
+        # implicitly re-replicated at every dispatch
+        if self._mesh is not None:
+            self._kernels_dev = jax.device_put(
+                world._diff_kernels, self._rep_sh
+            )
+            self._perm_dev = jax.device_put(
+                world._perm_factors, self._rep_sh
+            )
+            self._degrad_dev = jax.device_put(
+                world._degrad_factors, self._rep_sh
+            )
+        else:
+            self._kernels_dev = world._diff_kernels
+            self._perm_dev = world._perm_factors
+            self._degrad_dev = world._degrad_factors
+        # (tables object, replicated placement) — see _tables()
+        self._tables_cache: tuple = (None, None)
 
         self._rng = np.random.default_rng(world._rng.randrange(2**63))
         self.trace: list[dict] = []  # per-step timing/diagnostic records
@@ -1008,6 +1131,29 @@ class PipelinedStepper:
         self._attach(jax.random.PRNGKey(world._rng.randrange(2**31)))
         self._needs_attach = False
 
+    def _dev(self, value, dtype=None) -> jax.Array:
+        """Host value -> device, EXPLICITLY placed: replicated over the
+        mesh when one is set (``device=None`` keeps the default
+        single-device placement, so unsharded behavior is unchanged).
+        Every per-dispatch host input funnels through here — an
+        uncommitted input to a sharded jit is an implicit replication
+        transfer on every dispatch (the GL009 footgun)."""
+        return jnp.asarray(value, dtype=dtype, device=self._rep_sh)
+
+    def _tables(self):
+        """``kin.tables`` for dispatch: replicated on the mesh, cached
+        per rebuild (ensure_token_limits replaces the tables object when
+        token capacities grow, invalidating the placement)."""
+        tabs = self.kin.tables
+        if self._mesh is None:
+            return tabs
+        if self._tables_cache[0] is not tabs:
+            self._tables_cache = (
+                tabs,
+                jax.device_put(tabs, self._rep_sh),
+            )
+        return self._tables_cache[1]
+
     def _attach(self, key: jax.Array) -> None:
         """(Re)build device + replay state from the attached world —
         used at construction and after a capacity growth."""
@@ -1020,15 +1166,27 @@ class PipelinedStepper:
         # COPIES, not the world's own arrays: the step program donates its
         # DeviceState inputs, and donating `w._molecule_map` itself would
         # delete the buffer the classic API (world.molecule_map & friends)
-        # still reads between pipelined phases
+        # still reads between pipelined phases.  Mesh worlds: mm/cm/pos
+        # arrive already sharded (jnp.copy preserves placement, pinned by
+        # the device_put below), and the host-built leaves are placed
+        # explicitly — occ/n_rows/key replicated, alive cell-sharded —
+        # matching _step_body's output constraints so the steady-state
+        # dispatch never reshards its own carry.
+        mesh = self._mesh
         self._state = DeviceState(
             mm=jnp.copy(w._molecule_map),
             cm=jnp.copy(w._cell_molecules),
             pos=jnp.copy(w._positions_dev),
-            occ=jnp.asarray(w._np_cell_map),
-            alive=jnp.arange(self._cap) < w.n_cells,
-            n_rows=jnp.asarray(w.n_cells, dtype=jnp.int32),
-            key=key,
+            occ=self._dev(w._np_cell_map),
+            alive=(
+                jax.device_put(
+                    np.arange(self._cap) < w.n_cells, self._cell_sh
+                )
+                if mesh is not None
+                else jnp.arange(self._cap) < w.n_cells  # graftlint: disable=GL009 single-device branch; placement would commit the array and change jit-cache identity
+            ),
+            n_rows=self._dev(w.n_cells, jnp.int32),
+            key=key if mesh is None else jax.device_put(key, self._rep_sh),
         )
         # host replay state (row-indexed, append-only between compactions)
         self._genomes: list = list(w.cell_genomes) + [""] * (
@@ -1163,10 +1321,10 @@ class PipelinedStepper:
                 (self.spawn_block,) + dense.shape[1:], dtype=dense.dtype
             )
             pad[: len(spawn)] = dense
-            spawn_dense = jnp.asarray(pad)
+            spawn_dense = self._dev(pad)
             valid = np.zeros(self.spawn_block, dtype=bool)
             valid[: len(spawn)] = True
-            spawn_valid = jnp.asarray(valid)
+            spawn_valid = self._dev(valid)
             self.telemetry.note("spawn", _time.perf_counter() - t_spawn0)
         else:
             # cached all-zero device buffers: the spawn path always runs
@@ -1193,13 +1351,24 @@ class PipelinedStepper:
         div_budget = int(min(self.max_divisions, -(-(2 * g_est + 64) // 64) * 64))
         dev_budget = self._budget_cache.get(div_budget)
         if dev_budget is None:
-            dev_budget = jnp.asarray(div_budget, dtype=jnp.int32)
+            dev_budget = self._dev(div_budget, jnp.int32)
             self._budget_cache[div_budget] = dev_budget
         k = self.megastep
-        upper = self._n_rows + k * div_budget + len(spawn)
-        for p in self._pending:
-            upper += p.div_budget + len(p.spawn_genomes)
-        q = quantize_rows(upper, self._cap)
+        if self._mesh is not None:
+            # the live-row prefix is a PREFIX slice of the cell-sharded
+            # axis: any q < cap puts the whole prefix on the first tiles
+            # (a redistribution collective per phase, and an unbalanced
+            # one).  The mesh already divides the row work n_tiles ways,
+            # so run full-capacity — dead rows are exact no-ops (zeroed
+            # cm, OOB-dropped scatters), which also keeps the det-mode
+            # trajectory independent of the single-device driver's q
+            # ladder (the bit-identity tests rely on this).
+            q = self._cap
+        else:
+            upper = self._n_rows + k * div_budget + len(spawn)
+            for p in self._pending:
+                upper += p.div_budget + len(p.spawn_genomes)
+            q = quantize_rows(upper, self._cap)
 
         cold = not self._warm_sched.is_warm(self._variant_key(q, compact))
         t_dispatch0 = _time.perf_counter()
@@ -1207,9 +1376,9 @@ class PipelinedStepper:
         self._state, self.kin.params, out = step_fn(
             self._state,
             self.kin.params,
-            self.world._diff_kernels,
-            self.world._perm_factors,
-            self.world._degrad_factors,
+            self._kernels_dev,
+            self._perm_dev,
+            self._degrad_dev,
             self._mol_idx_dev,
             self._kill_below_dev,
             self._divide_above_dev,
@@ -1219,7 +1388,7 @@ class PipelinedStepper:
             spawn_valid,
             push_dense,
             push_rows,
-            self.kin.tables,
+            self._tables(),
             self._abs_temp_dev,
             det=self.world.deterministic,
             max_div=self.max_divisions,
@@ -1285,18 +1454,22 @@ class PipelinedStepper:
         rec = self.telemetry
         rec.note("dispatch", t_dispatched - t_dispatch0)
         if rec.attached:
-            rec.emit(
-                {
-                    "type": "dispatch",
-                    "phases": rec.take_dispatch(),
-                    "k": k,
-                    "q": q,
-                    "rows": self._n_rows,
-                    "pending": len(self._pending),
-                    "cold": bool(cold),
-                    "compact": bool(compact),
-                }
-            )
+            row = {
+                "type": "dispatch",
+                "phases": rec.take_dispatch(),
+                "k": k,
+                "q": q,
+                "rows": self._n_rows,
+                "pending": len(self._pending),
+                "cold": bool(cold),
+                "compact": bool(compact),
+            }
+            if self._mesh is not None:
+                # mesh metadata: tile count + axis name, so a capture's
+                # JSONL is self-describing about the sharded topology
+                row["tiles"] = self._n_tiles
+                row["mesh_axis"] = str(self._mesh.axis_names[0])
+            rec.emit(row)
 
     # -------------------------------------------------------------- #
     # replay side                                                    #
@@ -1333,6 +1506,14 @@ class PipelinedStepper:
         spawn_ok = _unpack_bits(arr[off : off + nw_s], sb)
         off += nw_s
         spawn_pos = arr[off : off + 2 * sb].reshape(sb, 2)
+        off += 2 * sb
+        # mesh runs append n_tiles per-tile occupancy lanes at the TAIL
+        # (single-device record layout is byte-identical to before)
+        tile_occ = (
+            arr[off : off + self._n_tiles].copy()
+            if self._mesh is not None
+            else None
+        )
         # header words 6-7 are f32 mass totals bitcast into the i32
         # record on device; re-view the bits, don't value-cast them
         masses = np.ascontiguousarray(arr[6:8]).view(np.float32)
@@ -1350,6 +1531,7 @@ class PipelinedStepper:
             n_occupied=int(arr[5]),
             mm_mass=float(masses[0]),
             cm_mass=float(masses[1]),
+            tile_occupancy=tile_occ,
         )
 
     def _drain(self, block: bool) -> None:
@@ -1538,9 +1720,18 @@ class PipelinedStepper:
             len(self._genomes[i]) for i in np.nonzero(self._alive)[0]
         ]
         n = len(lens)
+        extra = {}
+        if out.tile_occupancy is not None:
+            # per-map-row-tile occupancy from the device lanes: the
+            # load-balance signal for mesh runs (summary.py validates it
+            # sums to `occupied`)
+            extra["tile_occupancy"] = [
+                int(v) for v in out.tile_occupancy
+            ]
         return {
             "type": "step",
             "step": self.stats["replayed"],
+            **extra,
             "alive": out.n_alive,
             "rows": out.n_rows,
             "occupied": out.n_occupied,
@@ -1739,7 +1930,7 @@ class PipelinedStepper:
         dense_pad[: len(rows)] = dense
         rows_pad = np.full(self.push_block, self._cap, dtype=np.int32)
         rows_pad[: len(rows)] = rows
-        return jnp.asarray(dense_pad), jnp.asarray(rows_pad)
+        return self._dev(dense_pad), self._dev(rows_pad)
 
     # -------------------------------------------------------------- #
     # compiled-variant management                                    #
@@ -1755,8 +1946,11 @@ class PipelinedStepper:
                     (self.spawn_block, self.kin.max_proteins,
                      self.kin.max_doms, 5),
                     dtype=jnp.int16,
+                    device=self._rep_sh,
                 ),
-                jnp.zeros(self.spawn_block, dtype=bool),
+                jnp.zeros(
+                    self.spawn_block, dtype=bool, device=self._rep_sh
+                ),
             )
         return self._empty_cache[key]
 
@@ -1773,9 +1967,13 @@ class PipelinedStepper:
                     (self.push_block, self.kin.max_proteins,
                      self.kin.max_doms, 5),
                     dtype=jnp.int16,
+                    device=self._rep_sh,
                 ),
                 jnp.full(
-                    self.push_block, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+                    self.push_block,
+                    jnp.iinfo(jnp.int32).max,
+                    dtype=jnp.int32,
+                    device=self._rep_sh,
                 ),
             )
         return self._empty_cache[key]
@@ -1787,6 +1985,12 @@ class PipelinedStepper:
         background thread; call it explicitly (plus :meth:`wait_warm`)
         before a timing window so no remote compile can land inside it."""
         if q is None:
+            if self._mesh is not None:
+                # mesh dispatch always runs the full capacity (see step():
+                # prefix-slicing a sharded axis would redistribute) — one
+                # variant covers every population size
+                self.prewarm(q=self._cap, compact=compact)
+                return
             # warm the rung the current population uses AND the one above
             # it: before the first dispatch nothing is compiled yet, so
             # 'current' is only a no-op when a step already ran
@@ -1803,26 +2007,36 @@ class PipelinedStepper:
         # from shape/dtype metadata (which survives donation) also make
         # this safe to run from the background warm thread while the
         # main thread's dispatch consumes the real arrays
-        zeros = functools.partial(
-            jax.tree_util.tree_map, lambda t: jnp.zeros(t.shape, t.dtype)
-        )
+        if self._mesh is not None:
+            # shardings are part of the compiled-program key: warm
+            # stand-ins must match the live arrays' placements exactly
+            # or this compiles a variant the real dispatch never hits
+            zeros = functools.partial(
+                jax.tree_util.tree_map,
+                lambda t: jnp.zeros(t.shape, t.dtype, device=t.sharding),
+            )
+        else:
+            zeros = functools.partial(
+                jax.tree_util.tree_map,
+                lambda t: jnp.zeros(t.shape, t.dtype),  # graftlint: disable=GL009 single-device branch; committing the stand-ins would warm a variant the live dispatch never hits
+            )
         step_fn = self._step_fn()
         step_fn(
             zeros(self._state),
             zeros(self.kin.params),
-            self.world._diff_kernels,
-            self.world._perm_factors,
-            self.world._degrad_factors,
+            self._kernels_dev,
+            self._perm_dev,
+            self._degrad_dev,
             self._mol_idx_dev,
             self._kill_below_dev,
             self._divide_above_dev,
             self._divide_cost_dev,
-            jnp.asarray(0, dtype=jnp.int32),
+            self._dev(0, jnp.int32),
             spawn_dense,
             spawn_valid,
             push_dense,
             push_rows,
-            self.kin.tables,
+            self._tables(),
             self._abs_temp_dev,
             det=self.world.deterministic,
             max_div=self.max_divisions,
@@ -1839,9 +2053,16 @@ class PipelinedStepper:
         would trace an identical body, but this preserves the exact
         program/jit-cache identity previous releases dispatched."""
         if self.megastep == 1:
-            return _pipeline_step if self._donate else _pipeline_step_retained
+            base = _pipeline_step if self._donate else _pipeline_step_retained
+            if self._mesh is None:
+                # bare function, not a partial: preserves the exact
+                # callable identity previous releases dispatched
+                return base
+            return functools.partial(base, mesh=self._mesh)
         base = _megastep if self._donate else _megastep_retained
-        return functools.partial(base, k=self.megastep)
+        if self._mesh is None:
+            return functools.partial(base, k=self.megastep)
+        return functools.partial(base, k=self.megastep, mesh=self._mesh)
 
     def _variant_key(self, q: int, compact: bool) -> tuple:
         # token capacities are in the key: growing them reshapes the
@@ -1850,7 +2071,7 @@ class PipelinedStepper:
         # is in the key so steppers with different K (fixed per instance)
         # never mistake each other's variants for warm
         return (
-            q, compact, self.megastep,
+            q, compact, self.megastep, self._n_tiles,
             self.kin.max_proteins, self.kin.max_doms,
         )
 
@@ -1904,12 +2125,14 @@ class PipelinedStepper:
             compact_fn = (
                 _compact_program if self._donate else _compact_program_retained
             )
+            if self._mesh is not None:
+                compact_fn = functools.partial(compact_fn, mesh=self._mesh)
             with self.telemetry.span("compact"):
                 self._state, self.kin.params = compact_fn(
                     self._state,
                     self.kin.params,
-                    jnp.asarray(perm.astype(np.int32)),
-                    jnp.asarray(n_keep, dtype=jnp.int32),
+                    self._dev(perm.astype(np.int32)),
+                    self._dev(n_keep, jnp.int32),
                 )
             self._apply_perm(perm, n_keep)
 

@@ -20,6 +20,12 @@ Row schema (one JSON object per line; ``type`` discriminates):
 - ``dispatch`` — one per host dispatch: ``k`` (megastep), queue depth,
   cold/compact flags, and ``phases`` mapping phase name -> milliseconds
   spent since the previous dispatch row.
+
+Mesh-placed runs add optional keys: step rows carry ``tile_occupancy``
+(per-map-row-tile occupied pixel counts, one int per mesh tile, summing
+to ``occupied`` — computed on device from the sharded occupancy map) and
+dispatch rows carry ``tiles``/``mesh_axis``.  Single-device rows omit
+them, so the schema is backward compatible.
 """
 from __future__ import annotations
 
@@ -163,6 +169,20 @@ def validate_rows(rows: list[dict]) -> list[str]:
                         f"({prev_step[key]} -> {row[key]})"
                     )
                 prev_step[key] = row[key]
+            tiles = row.get("tile_occupancy")
+            if tiles is not None:
+                if not isinstance(tiles, list) or any(
+                    not isinstance(v, int) or v < 0 for v in tiles
+                ):
+                    problems.append(
+                        f"{where}: tile_occupancy must be a list of"
+                        f" non-negative ints, got {tiles!r}"
+                    )
+                elif sum(tiles) != row["occupied"]:
+                    problems.append(
+                        f"{where}: tile_occupancy sums to {sum(tiles)}"
+                        f" but occupied={row['occupied']}"
+                    )
         elif kind == "dispatch":
             phases = row.get("phases")
             if not isinstance(phases, dict):
@@ -192,7 +212,9 @@ def summarize_rows(rows: list[dict]) -> dict:
         final["total_divisions"] = steps[-1].get("total_divisions")
         final["total_spawned"] = steps[-1].get("total_spawned")
         final["total_mutations"] = steps[-1].get("total_mutations")
-    return {
+        if steps[-1].get("tile_occupancy") is not None:
+            final["tile_occupancy"] = steps[-1]["tile_occupancy"]
+    out = {
         "rows": len(rows),
         "steps": len(steps),
         "dispatches": len(dispatches),
@@ -200,6 +222,10 @@ def summarize_rows(rows: list[dict]) -> dict:
         "counters": counter_deltas(rows),
         "final": final,
     }
+    tiles = [r["tiles"] for r in dispatches if "tiles" in r]
+    if tiles:
+        out["tiles"] = max(tiles)
+    return out
 
 
 def format_summary(summary: dict) -> str:

@@ -746,6 +746,12 @@ class World:
         if n <= self._capacity:
             return
         cap = pad_pow2(n, minimum=_MIN_CAPACITY)
+        if self._mesh is not None:
+            # the cell axis is sharded: capacity must split evenly across
+            # tiles (pow2 caps with a pow2 tile count already do; this
+            # covers meshes of e.g. 3 or 6 devices)
+            n_tiles = int(self._mesh.shape[self._mesh.axis_names[0]])
+            cap = -(-cap // n_tiles) * n_tiles
         grow = cap - self._capacity
         self._np_positions = np.concatenate(
             [self._np_positions, np.zeros((grow, 2), dtype=np.int32)]

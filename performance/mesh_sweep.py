@@ -1,0 +1,286 @@
+"""
+Mesh device-count sweep: the pipelined fused step timed at several mesh
+sizes, one JSON line per device count — the MULTICHIP capture's
+throughput harness (scripts/capture_tpu_numbers.sh `run multichip`).
+
+Each device count runs in a fresh SUBPROCESS: the device inventory is
+fixed when the jax backend initializes, so a CPU-forced sweep must set
+``--xla_force_host_platform_device_count`` per child before any jax
+import (on TPU hardware the devices already exist and the child simply
+takes the first N).  ``n_devices=1`` measures the plain single-device
+stepper — the scaling curve's honest baseline, not a 1-tile mesh program.
+
+    python performance/mesh_sweep.py [--devices 1,2,4,8] [--steps 32]
+    python performance/mesh_sweep.py --check --devices 2   # CI gate
+
+``--check`` replaces the timing run with the det-mode bit-identity gate:
+the child runs a mesh trajectory AND the single-device trajectory in one
+process (persistent-cache-loaded executables can differ from fresh ones,
+so a cross-process comparison would test the cache, not the sharding)
+and exits nonzero on any byte difference.  scripts/test.sh runs this at
+2 forced host devices as a gating smoke.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _child_env(n_devices: int, platform: str) -> dict:
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if platform == "cpu" or not platform:
+        # idempotent when repeated: a duplicated device-count flag
+        # resolves to the LAST occurrence
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    return env
+
+
+def _run_child(args, n_devices: int) -> int:
+    cmd = [
+        sys.executable,
+        __file__,
+        "--_single",
+        str(n_devices),
+        "--n-cells", str(args.n_cells),
+        "--map-size", str(args.map_size),
+        "--genome-size", str(args.genome_size),
+        "--warmup", str(args.warmup),
+        "--steps", str(args.steps),
+        "--megastep", str(args.megastep),
+        "--seed", str(args.seed),
+        "--platform", args.platform,
+    ]
+    if args.check:
+        cmd.append("--check")
+    proc = subprocess.run(
+        cmd, env=_child_env(n_devices, args.platform), cwd=Path(__file__).parent
+    )
+    return proc.returncode
+
+
+def _measure(args, n_devices: int) -> None:
+    """Child: time the pipelined stepper on an n-device mesh (or the
+    single-device driver for n=1) and print ONE JSON result line."""
+    import time
+
+    import jax
+
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+    if len(jax.devices()) < n_devices:
+        print(
+            json.dumps(
+                {
+                    "metric": f"mesh sweep steps/sec (n_devices={n_devices})",
+                    "error": (
+                        f"need {n_devices} devices, have {len(jax.devices())}"
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(1)
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+    from magicsoup_tpu.parallel import tiled
+
+    mesh = tiled.make_mesh(n_devices) if n_devices > 1 else None
+    rng = random.Random(args.seed)
+    world = ms.World(
+        chemistry=CHEMISTRY, map_size=args.map_size, seed=args.seed, mesh=mesh
+    )
+    world.spawn_cells(
+        [
+            ms.random_genome(s=args.genome_size, rng=rng)
+            for _ in range(args.n_cells)
+        ]
+    )
+    st = ms.PipelinedStepper(
+        world,
+        mol_name="ATP",
+        kill_below=1.0,
+        divide_above=5.0,
+        divide_cost=4.0,
+        target_cells=args.n_cells,
+        genome_size=args.genome_size,
+        lag=2,
+        megastep=args.megastep,
+    )
+    for _ in range(max(args.warmup, 2)):
+        st.step()
+    st.drain()
+    st.wait_warm()
+    n_disp = max(1, -(-args.steps // args.megastep))
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        st.step()
+    st.drain()
+    dt = (time.perf_counter() - t0) / (n_disp * args.megastep)
+    st.flush()
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"mesh sweep steps/sec (n_devices={n_devices}, "
+                    f"{args.n_cells} cells, "
+                    f"{args.map_size}x{args.map_size} map, "
+                    f"{jax.default_backend()})"
+                ),
+                "value": round(1.0 / dt, 4),
+                "unit": "steps/s",
+                "n_devices": n_devices,
+                "megastep": args.megastep,
+                "ms_per_step": round(dt * 1e3, 2),
+                "final_n_cells": world.n_cells,
+                "driver": "mesh" if mesh is not None else "single",
+                "backend": jax.default_backend(),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _bit_identity_check(args, n_devices: int) -> None:
+    """Child: det-mode mesh trajectory must be BIT-identical to the
+    single-device det trajectory — both run in THIS process."""
+    import numpy as np
+
+    import jax
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+    from magicsoup_tpu.parallel import tiled
+
+    if len(jax.devices()) < n_devices:
+        raise SystemExit(
+            f"need {n_devices} devices, have {len(jax.devices())}"
+        )
+
+    def run(mesh):
+        import random
+
+        rng = random.Random(args.seed)
+        world = ms.World(
+            chemistry=CHEMISTRY,
+            map_size=args.map_size,
+            seed=args.seed,
+            mesh=mesh,
+        )
+        world.deterministic = True
+        world.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        st = ms.PipelinedStepper(
+            world,
+            mol_name="ATP",
+            kill_below=1.0,
+            divide_above=5.0,
+            divide_cost=4.0,
+            target_cells=args.n_cells,
+            genome_size=args.genome_size,
+            lag=2,
+            megastep=args.megastep,
+        )
+        for _ in range(args.steps):
+            st.step()
+        st.flush()
+        st.check_consistency()
+        return world
+
+    w1 = run(None)
+    wn = run(tiled.make_mesh(n_devices))
+    ok = (
+        w1.n_cells == wn.n_cells
+        and w1.cell_genomes == wn.cell_genomes
+        and np.array_equal(w1.cell_positions, wn.cell_positions)
+        and np.asarray(jax.device_get(w1.molecule_map)).tobytes()
+        == np.asarray(jax.device_get(wn.molecule_map)).tobytes()
+        and np.asarray(w1.cell_molecules)[: w1.n_cells].tobytes()
+        == np.asarray(wn.cell_molecules)[: w1.n_cells].tobytes()
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"mesh det bit-identity check (n_devices={n_devices})"
+                ),
+                "ok": ok,
+                "n_devices": n_devices,
+                "steps": args.steps,
+                "final_n_cells": w1.n_cells,
+            }
+        ),
+        flush=True,
+    )
+    if not ok:
+        raise SystemExit("mesh det bit-identity check FAILED")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--devices",
+        default="1,2,4,8",
+        help="comma-separated device counts to sweep",
+    )
+    ap.add_argument("--n-cells", type=int, default=2048)
+    ap.add_argument("--map-size", type=int, default=64)
+    ap.add_argument("--genome-size", type=int, default=500)
+    ap.add_argument("--warmup", type=int, default=4, help="warmup dispatches")
+    ap.add_argument("--steps", type=int, default=32, help="measured SIM steps")
+    ap.add_argument("--megastep", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform pin ('' = whatever jax finds, e.g. tpu)",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="det-mode bit-identity gate instead of a timing run",
+    )
+    ap.add_argument(
+        "--_single",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # internal: run ONE device count in-process
+    )
+    args = ap.parse_args()
+
+    if args._single is not None:
+        import jax
+
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        if args.check:
+            _bit_identity_check(args, args._single)
+        else:
+            _measure(args, args._single)
+        return
+
+    rc = 0
+    for n in sorted({int(d) for d in args.devices.split(",")}):
+        child_rc = _run_child(args, n)
+        rc = rc or child_rc
+    raise SystemExit(rc)
+
+
+if __name__ == "__main__":
+    main()

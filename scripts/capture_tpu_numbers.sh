@@ -96,6 +96,12 @@ run bench_1k        1200 python bench.py --n-cells 1000 --warmup 4 --steps 10
 run pallas_bisect   1500 python performance/pallas_bisect.py
 run profile_step     900 python performance/profile_step.py --n-cells 10000 --warmup 6 --steps 12
 run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8
+# real per-device-count throughput rows (steps/s at n_devices 1/2/4/8),
+# not an rc/ok smoke: each count runs in its own child process (the
+# device inventory is fixed at backend init) and prints one JSON line
+# that summarize_capture publishes under published["multichip"].
+# --platform '' lets the child take real TPU chips when present.
+run multichip       1800 python performance/mesh_sweep.py --devices 1,2,4,8 --platform ''
 run check           1200 python performance/check.py
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"

@@ -147,6 +147,24 @@ def summarize(outdir: Path) -> dict:
         for r in check_rows:
             ops[str(r["op"])] = r
         summary["check_ops"] = ops
+    # performance/mesh_sweep.py rows: one steps/s measurement per device
+    # count (the MULTICHIP capture).  Last clean row per count wins;
+    # error rows ({"error": "need 8 devices, have 1"}) are capture
+    # outcomes, not measurements, and are dropped whenever any clean row
+    # for that count exists
+    multi_rows = [
+        r
+        for r in _json_lines(outdir / "multichip.log")
+        if "n_devices" in r and "value" in r
+    ]
+    if multi_rows:
+        counts: dict = {}
+        for r in multi_rows:
+            key = str(r["n_devices"])
+            if "error" in r and "error" not in counts.get(key, {"error": 1}):
+                continue  # keep an existing clean row over a later error
+            counts[key] = r
+        summary["multichip"] = counts
     reps = [r for r in _json_lines(outdir / "bitrepro.log") if "result" in r]
     if reps:
         summary["bitrepro"] = reps[-1]
@@ -210,6 +228,25 @@ def publish(summary: dict) -> None:
                 if (prev_v <= new_v) if lower_better else (prev_v >= new_v):
                     continue
             pub_ops[op] = {**entry, "capture_dir": summary["capture_dir"]}
+            merged = True
+    multi = summary.get("multichip")
+    if multi:
+        pub_multi = published.setdefault("multichip", {})
+        for count, entry in multi.items():
+            if "error" in entry:
+                continue
+            # per-device-count best-value-wins (steps/s, higher is
+            # better) with the same metric-match rule as the bench
+            # entries: a changed sweep workload renames the metric and
+            # must overwrite rather than chase a stale record
+            prev = pub_multi.get(count)
+            if (
+                isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
+                and prev.get("value", 0) >= entry.get("value", 0)
+            ):
+                continue
+            pub_multi[count] = {**entry, "capture_dir": summary["capture_dir"]}
             merged = True
     tel = summary.get("telemetry")
     # per-phase dispatch timings (p50/p95) live next to check_ops: both

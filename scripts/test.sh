@@ -18,3 +18,9 @@ python -m pytest "$TARGET" -q
 # (schema + monotone counters) and `python -m magicsoup_tpu.telemetry
 # summarize` must accept it (exits nonzero otherwise)
 python performance/smoke.py
+# sharded stepper smoke (GATING): a 2-forced-host-device det-mode mesh
+# trajectory must be BIT-identical to the single-device det trajectory
+# (both run in one child process — see performance/mesh_sweep.py --check);
+# exits nonzero on any byte difference
+python performance/mesh_sweep.py --check --devices 2 \
+    --n-cells 24 --map-size 16 --genome-size 200 --steps 4

@@ -1,0 +1,28 @@
+"""GL009 fixture: unplaced array construction in a hot function of a
+mesh-aware module (top-level ``jax.sharding`` import).  The bare
+constructor lands its buffer on the default device uncommitted, so a
+sharded jit re-replicates it across the mesh on every dispatch."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding  # noqa: F401  (marks the module mesh-aware)
+
+
+# graftlint: hot
+def hot_attach(rows, sharding):
+    staged = jax.device_put(rows, sharding)  # placed: clean
+    mask = jnp.zeros(rows.shape, jnp.int32)  # GL009: lands on default device
+    return staged, mask
+
+
+# explicit placement is clean
+# graftlint: hot
+def hot_attach_placed(rows, sharding):
+    staged = jax.device_put(rows, sharding)
+    mask = jnp.zeros(rows.shape, jnp.int32, device=sharding)
+    return staged, mask
+
+
+# cold functions are out of scope: setup-time placement is a one-off,
+# not a per-dispatch replication
+def cold_setup(rows):
+    return jnp.asarray(rows)

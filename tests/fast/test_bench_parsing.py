@@ -192,6 +192,80 @@ def test_summarize_skips_error_rows(tmp_path):
     assert "update_cells" not in summary["check_ops"]
 
 
+def _multichip_row(n: int, value: float, *, error: str | None = None) -> str:
+    row = {
+        "metric": (
+            f"mesh sweep steps/sec (n_devices={n}, 2048 cells, "
+            f"64x64 map, tpu)"
+        ),
+        "value": value,
+        "unit": "steps/s",
+        "n_devices": n,
+        "megastep": 1,
+        "driver": "mesh" if n > 1 else "single",
+    }
+    if error is not None:
+        row["error"] = error
+    return json.dumps(row)
+
+
+def test_summarize_multichip_per_device_rows(tmp_path):
+    # performance/mesh_sweep.py prints one steps/s row per device count;
+    # the summary keys them by count, last clean row per count wins and
+    # error rows never shadow a clean one
+    (tmp_path / "multichip.log").write_text(
+        _multichip_row(1, 10.0)
+        + "\n"
+        + _multichip_row(2, 0.0, error="need 2 devices, have 1")
+        + "\n"
+        + _multichip_row(2, 18.0)
+        + "\n"
+        + _multichip_row(4, 30.0)
+        + "\n"
+        + _multichip_row(8, 0.0, error="tunnel dropped")
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    multi = summary["multichip"]
+    assert multi["1"]["value"] == 10.0
+    assert multi["2"]["value"] == 18.0 and "error" not in multi["2"]
+    assert multi["4"]["value"] == 30.0
+    # error-only count: the error survives into the summary (visibility)
+    assert multi["8"]["error"] == "tunnel dropped"
+
+
+def test_publish_multichip_best_value_per_count(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(rows: list[str], tag: str) -> dict:
+        cap = tmp_path / f"cap-{tag}"
+        cap.mkdir(exist_ok=True)
+        (cap / "multichip.log").write_text("\n".join(rows) + "\n")
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]["multichip"]
+
+    out = pub([_multichip_row(1, 10.0), _multichip_row(2, 18.0)], "a")
+    assert out["1"]["value"] == 10.0 and out["2"]["value"] == 18.0
+    # steps/s are higher-is-better: a faster later window upgrades one
+    # count without degrading the other, and errored counts are refused
+    out = pub(
+        [
+            _multichip_row(1, 8.0),
+            _multichip_row(2, 25.0),
+            _multichip_row(8, 0.0, error="tunnel dropped"),
+        ],
+        "b",
+    )
+    assert out["1"]["value"] == 10.0  # best record kept
+    assert out["2"]["value"] == 25.0  # upgraded
+    assert "8" not in out  # error never published
+    # provenance: each count carries the capture dir it was measured in
+    assert out["2"]["capture_dir"].endswith("cap-b")
+    assert out["1"]["capture_dir"].endswith("cap-a")
+
+
 def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(json.dumps({"published": {}}) + "\n")

@@ -52,6 +52,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl006_cellparams.py", "GL006"),
         ("gl007_tolist_loop.py", "GL007"),
         ("gl008_io_callback.py", "GL008"),
+        ("gl009_unplaced.py", "GL009"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -84,6 +85,36 @@ def test_gl007_waivable_like_the_other_rules(tmp_path):
     )
     assert waived != src
     p = tmp_path / "gl007_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl009_scoped_to_mesh_aware_modules(tmp_path):
+    # the SAME hot-path constructor is silent once the module stops
+    # importing sharding machinery: on a single device there is nowhere
+    # else for the buffer to land, so forcing `device=` would be noise
+    src = (FIXTURES / "gl009_unplaced.py").read_text()
+    stripped = src.replace(
+        "from jax.sharding import NamedSharding"
+        "  # noqa: F401  (marks the module mesh-aware)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl009_not_mesh_aware.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL009"]) == []
+
+
+def test_gl009_waivable_like_the_other_rules(tmp_path):
+    # the stepper's deliberate single-device fallback branches waive
+    # with the standard inline annotation; pin that it covers GL009
+    src = (FIXTURES / "gl009_unplaced.py").read_text()
+    waived = src.replace(
+        "# GL009: lands on default device",
+        "# graftlint: disable=GL009 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl009_waived.py"
     p.write_text(waived)
     assert analyze([p]) == []
 

@@ -1,0 +1,308 @@
+"""
+Sharded :class:`PipelinedStepper` tests on the virtual 8-device CPU mesh
+(tests/conftest.py forces ``--xla_force_host_platform_device_count=8``).
+
+The load-bearing contracts of the mesh-lowered fused step:
+
+- a det-mode sharded trajectory is BIT-IDENTICAL to the single-device
+  det-mode trajectory for the same seed/lag/megastep — both runs in ONE
+  process (persistent-cache-loaded XLA:CPU executables can differ
+  numerically from freshly built ones, so cross-process comparison would
+  test the cache, not the sharding);
+- steady state dispatches with ZERO new compiles and ZERO implicit
+  transfers (``hot_path_guard``) — every per-dispatch input is
+  explicitly placed on the mesh, nothing silently replicates;
+- the collective census of the compiled step/megastep programs is
+  pinned: diffusion row halos + small replicated-lane reductions only,
+  nothing map- or parameter-sized crosses the interconnect;
+- the packed step record stays ONE replicated vector (one fetch per
+  step), growing only the per-tile occupancy tail lanes.
+"""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import stepper as stepper_mod
+from magicsoup_tpu.analysis import runtime as lint_rt
+from magicsoup_tpu.parallel import tiled
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.telemetry import TelemetryRecorder
+from magicsoup_tpu.telemetry import summary as tsum
+
+from test_parallel import collective_census
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+_MOLS = [
+    ms.Molecule("shs-a", 10e3),
+    ms.Molecule("shs-atp", 8e3, half_life=100_000),
+    ms.Molecule("shs-c", 4e3, permeability=0.3),
+]
+_REACTIONS = [([_MOLS[0]], [_MOLS[1]]), ([_MOLS[1]], [_MOLS[2]])]
+
+
+def _world(mesh, *, seed=7, map_size=32, n_cells=50, det=False):
+    world = ms.World(
+        chemistry=ms.Chemistry(molecules=_MOLS, reactions=_REACTIONS),
+        map_size=map_size,
+        seed=seed,
+        mesh=mesh,
+    )
+    world.deterministic = det
+    rng = random.Random(seed)
+    world.spawn_cells([ms.random_genome(s=300, rng=rng) for _ in range(n_cells)])
+    return world
+
+
+def _stepper(world, **kwargs):
+    defaults = dict(
+        mol_name="shs-atp",
+        kill_below=0.2,
+        divide_above=2.5,
+        divide_cost=1.0,
+        target_cells=60,
+        genome_size=300,
+        lag=2,
+        p_mutation=1e-4,
+        p_recombination=1e-5,
+    )
+    defaults.update(kwargs)
+    return PipelinedStepper(world, **defaults)
+
+
+@pytest.mark.parametrize("megastep", [1, 2])
+def test_det_trajectory_bit_identical_to_single_device(megastep):
+    # THE acceptance contract: same seed, same lag, same megastep — the
+    # 8-way sharded trajectory and the single-device trajectory land on
+    # byte-identical world state (map, cell molecules, genomes,
+    # positions).  Holds because every cross-tile float reduction in det
+    # mode is an explicit fixed tree (GSPMD partitions dataflow without
+    # reordering it) and the mesh dispatch's q=capacity delta only adds
+    # dead rows, which are exact no-ops.
+    def run(mesh):
+        world = _world(mesh, det=True)
+        st = _stepper(world, megastep=megastep)
+        for _ in range(8 // megastep):
+            st.step()
+        st.flush()
+        st.check_consistency()
+        return world
+
+    w1 = run(None)
+    w8 = run(tiled.make_mesh(8))
+    assert w1.n_cells == w8.n_cells
+    assert w1.cell_genomes == w8.cell_genomes
+    np.testing.assert_array_equal(w1.cell_positions, w8.cell_positions)
+    n = w1.n_cells
+    assert (
+        np.asarray(jax.device_get(w1.molecule_map)).tobytes()
+        == np.asarray(jax.device_get(w8.molecule_map)).tobytes()
+    )
+    assert (
+        np.asarray(w1.cell_molecules)[:n].tobytes()
+        == np.asarray(w8.cell_molecules)[:n].tobytes()
+    )
+
+
+@pytest.mark.parametrize("megastep", [1, 4])
+def test_steady_state_under_hot_path_guard(megastep):
+    # zero implicit transfers + zero compiles once warm: every dispatch
+    # input is explicitly mesh-placed (an uncommitted input would be
+    # implicitly replicated at EVERY dispatch — a transfer-guard
+    # violation and a per-step host round-trip)
+    world = _world(tiled.make_mesh(8), map_size=32, n_cells=40)
+    st = _stepper(
+        world,
+        kill_below=-1.0,  # nothing dies
+        divide_above=1e30,  # nothing divides
+        divide_cost=0.0,
+        target_cells=None,  # nothing spawns
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=megastep,
+    )
+    for _ in range(8):
+        st.step()
+    st.drain()
+
+    with lint_rt.hot_path_guard(compile_budget=0) as stats:
+        for _ in range(5):
+            st.step()
+        st.drain()
+    assert stats.compiles == 0
+    st.flush()
+
+
+def _census_args(st):
+    spawn_dense, spawn_valid = st._empty_spawn()
+    push_dense, push_rows = st._empty_push()
+    return (
+        st._state,
+        st.kin.params,
+        st._kernels_dev,
+        st._perm_dev,
+        st._degrad_dev,
+        st._mol_idx_dev,
+        st._kill_below_dev,
+        st._divide_above_dev,
+        st._divide_cost_dev,
+        st._dev(64, jnp.int32),
+        spawn_dense,
+        spawn_valid,
+        push_dense,
+        push_rows,
+        st._tables(),
+        st._abs_temp_dev,
+    )
+
+
+def test_sharded_pipelined_step_collective_budget():
+    """Satellite of test_parallel.py::test_sharded_step_collective_budget:
+    the same census pin for the FUSED PIPELINED step and megastep
+    programs.  Measured composition (8-way mesh): 2 collective-permutes
+    for the diffusion row halos plus 4 tiny u32 PRNG-lane permutes, and
+    bounded small all-reduce/all-gather from the cell<->map exchange,
+    the replicated header lanes, and the record assembly.  The megastep
+    traces the step body twice (spawn step + scan body), so its census
+    is exactly 2x the single step's — still k-independent.  Nothing
+    map- or parameter-sized ever crosses the interconnect."""
+    mesh = tiled.make_mesh(8)
+    world = _world(mesh, map_size=64)
+    st = _stepper(world)
+    st.step()
+    st.drain()
+    args = _census_args(st)
+    statics = dict(
+        det=False,
+        max_div=st.max_divisions,
+        n_rounds=st.n_rounds,
+        compact=False,
+        q=st._cap,
+        use_pallas=False,
+        mesh=mesh,
+    )
+
+    hlo = (
+        stepper_mod._pipeline_step_retained.lower(*args, **statics)
+        .compile()
+        .as_text()
+    )
+    ops, big_ops = collective_census(hlo)
+    assert ops.get("all-to-all", 0) == 0, ops
+    assert ops["collective-permute"] <= 6, ops
+    assert ops["all-reduce"] <= 48, ops
+    assert ops["all-gather"] <= 24, ops
+    assert big_ops == [], big_ops
+
+    hlo_k = (
+        stepper_mod._megastep_retained.lower(*args, k=4, **statics)
+        .compile()
+        .as_text()
+    )
+    ops_k, big_k = collective_census(hlo_k)
+    assert ops_k.get("all-to-all", 0) == 0, ops_k
+    # two step-body traces, not k traces: the scan body compiles once
+    assert ops_k["collective-permute"] <= 2 * 6, ops_k
+    assert ops_k["all-reduce"] <= 2 * 48, ops_k
+    assert ops_k["all-gather"] <= 2 * 24, ops_k
+    assert big_k == [], big_k
+
+    # the compact program redistributes rows across tiles by design
+    # (a global stable-sort permutation), but its collectives must stay
+    # cap-sized, never map- or (c,p,s)-parameter-sized per lane
+    hlo_c = (
+        stepper_mod._compact_program_retained.lower(
+            st._state,
+            st.kin.params,
+            st._dev(np.arange(st._cap, dtype=np.int32)),
+            st._dev(10, jnp.int32),
+            mesh=mesh,
+        )
+        .compile()
+        .as_text()
+    )
+    ops_c, big_c = collective_census(hlo_c)
+    assert ops_c.get("all-to-all", 0) == 0, ops_c
+    assert big_c == [], big_c
+
+
+def test_mesh_telemetry_tile_occupancy(tmp_path):
+    # mesh runs add per-tile occupancy lanes to the step record TAIL
+    # (single-device record layout is byte-identical) and tiles/mesh_axis
+    # to dispatch rows; the summarizer validates sum(tiles) == occupied
+    path = tmp_path / "telemetry.jsonl"
+    world = _world(tiled.make_mesh(8), map_size=32, n_cells=30)
+    world.telemetry = TelemetryRecorder(path=path)
+    st = _stepper(world)
+    for _ in range(5):
+        st.step()
+    st.flush()
+
+    rows = tsum.read_jsonl(path)
+    assert tsum.validate_rows(rows) == []
+    srows = [r for r in rows if r.get("type") == "step"]
+    assert srows
+    for r in srows:
+        occ = r["tile_occupancy"]
+        assert len(occ) == 8
+        assert sum(occ) == r["occupied"]
+    drows = [r for r in rows if r.get("type") == "dispatch"]
+    assert drows
+    assert all(r["tiles"] == 8 and r["mesh_axis"] == "tile" for r in drows)
+    summary = tsum.summarize_rows(rows)
+    assert summary["tiles"] == 8
+    assert len(summary["final"]["tile_occupancy"]) == 8
+
+
+def test_non_pow2_mesh_capacity_rounds_to_tile_multiple():
+    # cell capacity must split evenly across tiles; with 3 tiles the
+    # pow2 ladder (64, 128, ...) is not divisible, so _ensure_capacity
+    # rounds up to the next multiple and the stepper runs unchanged
+    world = _world(tiled.make_mesh(3), map_size=33, n_cells=70)
+    assert world._capacity % 3 == 0
+    st = _stepper(world, target_cells=None)
+    for _ in range(3):
+        st.step()
+    st.flush()
+    st.check_consistency()
+    assert world.n_cells > 0
+
+
+def test_record_layout_single_device_unchanged_mesh_appends_tail():
+    # the per-tile occupancy lanes live at the record TAIL and only on
+    # mesh runs: the single-device record keeps its exact pre-mesh
+    # length (byte-identical layout for every existing lane), the mesh
+    # record is longer by exactly n_tiles words, and single-device
+    # StepOutputs carry tile_occupancy=None
+    def record_len(mesh):
+        world = _world(mesh, map_size=32, n_cells=20)
+        st = _stepper(world, target_cells=None)
+        seen = []
+        orig = st._unpack_outputs
+
+        def spy(arr):
+            seen.append(len(arr))
+            return orig(arr)
+
+        st._unpack_outputs = spy
+        st.step()
+        st.drain()
+        st.flush()
+        assert seen
+        return st, seen[0]
+
+    st1, len1 = record_len(None)
+    md, sb, cap = st1.max_divisions, st1.spawn_block, st1._cap
+    nw_k, nw_s = -(-cap // 16), -(-sb // 16)
+    assert len1 == 8 + nw_k + md + 2 * md + nw_s + 2 * sb
+    assert st1._n_tiles == 1
+
+    st8, len8 = record_len(tiled.make_mesh(8))
+    assert st8._cap == cap  # same config -> same slot capacity
+    assert len8 == len1 + 8

@@ -292,7 +292,10 @@ def test_pipelined_and_classic_phases_compose():
     assert np.isfinite(mm).all() and (mm >= 0).all()
 
 
-def test_pipelined_rejects_mesh_world():
+def test_pipelined_accepts_mesh_world():
+    """A mesh-placed world drives the SHARDED fused step (previous
+    releases raised here; deep coverage — det bit-identity, collective
+    census, guards — lives in test_sharded_stepper.py)."""
     import jax
 
     from magicsoup_tpu.parallel import tiled
@@ -301,8 +304,18 @@ def test_pipelined_rejects_mesh_world():
         pytest.skip("needs multiple devices")
     mesh = tiled.make_mesh(2)
     world = ms.World(chemistry=_chem(), map_size=32, seed=1, mesh=mesh)
-    with pytest.raises(ValueError, match="mesh"):
-        PipelinedStepper(world, mol_name="stp-atp")
+    rng = random.Random(1)
+    world.spawn_cells([ms.random_genome(s=300, rng=rng) for _ in range(20)])
+    st = PipelinedStepper(world, mol_name="stp-atp", lag=1)
+    assert st._mesh is mesh
+    for _ in range(3):
+        st.step()
+    st.flush()
+    st.check_consistency()
+    axis = mesh.axis_names[0]
+    assert st._state.cm.sharding.spec[0] == axis
+    mm = world._host_molecule_map()
+    assert np.isfinite(mm).all() and (mm >= 0).all()
 
 
 def test_empty_push_buffer_is_inert_and_capacity_proof():
