@@ -56,6 +56,11 @@ MODULES = {
         " runtime counter snapshots, profiler tracing, and the"
         " `python -m magicsoup_tpu.telemetry summarize` CLI."
     ),
+    "magicsoup_tpu.guard": (
+        "graftguard fault tolerance: crash-safe checkpoints,"
+        " deterministic resume, health sentinels, watchdogs, and the"
+        " fault injectors behind the chaos smoke."
+    ),
     "magicsoup_tpu.parallel.tiled": (
         "Tile-sharded world stepping across a TPU device mesh"
         " (halo-exchange diffusion, sharded cell axis)."

@@ -13,6 +13,7 @@ Findings; registration at the bottom.
 | GL007 | tolist-in-hot-loop   | batch host conversion (no per-item tolist) |
 | GL008 | host-callback-in-jit | no host round trips inside jitted bodies   |
 | GL009 | missing-sharding     | explicit placement in mesh-aware modules   |
+| GL010 | non-atomic-save      | crash-safe state persistence (guard.io)    |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -121,6 +122,13 @@ RULE_INFO = {
         "array lands on the default device uncommitted, and a sharded "
         "jit silently re-replicates it across the mesh on EVERY "
         "dispatch (the silent-replication footgun)",
+    ),
+    "GL010": (
+        "non-atomic-save",
+        "state pickled straight into its destination file — a crash "
+        "mid-write destroys BOTH the old snapshot and the new one; "
+        "persistence must go through guard.io's "
+        "write-temp->fsync->os.replace protocol",
     ),
 }
 
@@ -918,6 +926,63 @@ def check_gl009(ctx: Context):
                 )
 
 
+_PICKLE_DUMP = {"pickle.dump", "cloudpickle.dump", "dill.dump"}
+_PICKLE_DUMPS = {"pickle.dumps", "cloudpickle.dumps", "dill.dumps"}
+
+
+def check_gl010(ctx: Context):
+    """State persistence must be crash-safe: ``pickle.dump(obj, fh)``
+    (or ``fh.write(pickle.dumps(obj))``) straight into the destination
+    file truncates the previous snapshot the moment the file opens, so
+    a crash mid-write destroys both the old bytes and the new — the
+    exact failure guard.io's write-temp -> fsync -> ``os.replace``
+    protocol exists to close.  Passing ``pickle.dumps`` bytes to
+    ``guard.io.atomic_write_bytes`` (or any non-``.write`` consumer) is
+    the sanctioned form and is not flagged; the guard package itself —
+    the one place allowed to own raw file protocol — is exempt."""
+    fix = (
+        "serialize to bytes and hand them to "
+        "guard.io.atomic_write_bytes(path, pickle.dumps(obj)) — or use "
+        "guard.write_checkpoint for a verified, versioned snapshot; "
+        "waive a deliberate raw write (e.g. a fault injector) with "
+        "`# graftlint: disable=GL010`"
+    )
+    for f in ctx.files:
+        if "guard" in f.path.parts:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain in _PICKLE_DUMP and len(node.args) >= 2:
+                yield _finding(
+                    "GL010",
+                    f,
+                    node,
+                    f"`{chain}()` writes state directly into its "
+                    "destination file — a crash mid-write destroys the "
+                    "previous snapshot along with the new one",
+                    fix,
+                )
+            elif (
+                chain.endswith(".write")
+                and chain not in _PICKLE_DUMP
+                and node.args
+                and isinstance(node.args[0], ast.Call)
+                and _attr_chain(node.args[0].func) in _PICKLE_DUMPS
+            ):
+                yield _finding(
+                    "GL010",
+                    f,
+                    node,
+                    f"`{chain}({_attr_chain(node.args[0].func)}(...))` "
+                    "writes pickled state non-atomically — a partial "
+                    "write leaves a truncated pickle where the previous "
+                    "snapshot was",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -928,6 +993,7 @@ CHECKERS = {
     "GL007": check_gl007,
     "GL008": check_gl008,
     "GL009": check_gl009,
+    "GL010": check_gl010,
 }
 
 

@@ -1,0 +1,108 @@
+"""
+graftguard: the fault-tolerance layer.
+
+The paper's workload is millions of evolution steps; the ROADMAP north
+star is a long-lived multi-tenant simulation service.  Both die on the
+same four failure modes, and this package owns one answer to each:
+
+- **Crash-safe persistence** (:mod:`.io`, :mod:`.checkpoint`): every
+  state write goes temp-file -> fsync -> ``os.replace``, so a crash
+  mid-write never destroys the previous snapshot; checkpoint files
+  carry a schema version and a content digest that are verified BEFORE
+  any byte is unpickled, and corruption raises a typed
+  :class:`CheckpointError` naming the failed check.
+- **Deterministic resume** (:mod:`.resume`): a checkpoint captures the
+  full trajectory state — world tensors, host bookkeeping, every PRNG
+  stream, and the stepper's schedule state — so in det mode a run
+  killed at a checkpoint boundary and restored in a fresh process
+  continues BIT-identically (the same byte-equality contract the
+  megastep and mesh layers pin, extended across process death).
+- **Health sentinels** (:mod:`.sentinel`, :mod:`.watchdog`): the fused
+  step packs non-finite/negative-concentration flags into the record it
+  already fetches (zero extra D2H, device program identical guard-on vs
+  guard-off); policies escalate from a telemetry note to quarantining
+  poisoned cells to rolling back to the last good checkpoint.  The
+  watchdog turns a wedged dispatch/fetch into diagnostics + a typed
+  error instead of a silent hang.
+- **Fault injection** (:mod:`.faults`): the chaos hooks the gated smoke
+  in ``performance/smoke.py`` drives — SIGKILL mid-flight, SIGTERM
+  graceful drain, checkpoint byte flips, NaN injection, transient
+  dispatch failures against :mod:`.retry`.
+
+Quickstart::
+
+    from magicsoup_tpu import guard
+
+    mgr = guard.CheckpointManager("run/checkpoints", keep=3)
+    ...
+    guard.save_run(mgr, world, stepper)        # at any flush boundary
+    # -- process dies --
+    world, aux, meta = guard.restore_run(mgr)
+    stepper = PipelinedStepper(world, **same_kwargs)
+    guard.restore_stepper(stepper, aux)        # bit-identical continue
+"""
+from magicsoup_tpu.guard.errors import (
+    CheckpointError,
+    GuardError,
+    SentinelTripped,
+    TransientDispatchError,
+    WatchdogTimeout,
+)
+from magicsoup_tpu.guard.faults import (
+    flip_byte,
+    inject_dispatch_failures,
+    inject_nan,
+)
+from magicsoup_tpu.guard.io import atomic_write_bytes
+from magicsoup_tpu.guard.checkpoint import (
+    SCHEMA_VERSION,
+    CheckpointManager,
+    inspect_checkpoint,
+    read_checkpoint,
+    write_checkpoint,
+)
+from magicsoup_tpu.guard.resume import (
+    restore_run,
+    restore_stepper,
+    save_run,
+    snapshot_run,
+    stepper_config,
+)
+from magicsoup_tpu.guard.retry import is_transient_error, retry_call
+from magicsoup_tpu.guard.sentinel import (
+    SENTINEL_POLICIES,
+    decode_health,
+    quarantine_world,
+)
+from magicsoup_tpu.guard.signals import GracefulShutdown
+from magicsoup_tpu.guard.watchdog import Watchdog, dump_diagnostics
+
+__all__ = [
+    "GuardError",
+    "CheckpointError",
+    "SentinelTripped",
+    "TransientDispatchError",
+    "WatchdogTimeout",
+    "atomic_write_bytes",
+    "SCHEMA_VERSION",
+    "CheckpointManager",
+    "write_checkpoint",
+    "read_checkpoint",
+    "inspect_checkpoint",
+    "snapshot_run",
+    "save_run",
+    "restore_run",
+    "restore_stepper",
+    "stepper_config",
+    "retry_call",
+    "is_transient_error",
+    "SENTINEL_POLICIES",
+    "decode_health",
+    "quarantine_world",
+    "GracefulShutdown",
+    "Watchdog",
+    "dump_diagnostics",
+    "flip_byte",
+    "inject_nan",
+    "inject_dispatch_failures",
+]

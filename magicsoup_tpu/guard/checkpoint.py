@@ -1,0 +1,252 @@
+"""Verified checkpoint files + rolling retention.
+
+File format (``*.msck``)::
+
+    b"MSCK\\n"                                   magic, 5 bytes
+    {"schema": 1, "payload_len": N,
+     "sha256": "...", "meta": {...}}\\n           one JSON header line
+    <N payload bytes>                            pickle of the object
+
+Every field exists to make loading REFUSE bad bytes instead of
+unpickling garbage into a live world:
+
+- the magic line rejects arbitrary files handed to the loader,
+- ``schema`` rejects checkpoints from an incompatible writer,
+- ``payload_len`` catches truncation (a crash mid-copy, a partial
+  download) before hashing,
+- ``sha256`` over the payload catches bit flips (the fault-injection
+  smoke literally flips one byte and asserts the typed rejection),
+- only after ALL checks pass does ``pickle.loads`` run.
+
+Failures raise :class:`~magicsoup_tpu.guard.errors.CheckpointError`
+whose ``check`` attribute names the first verification that failed.
+
+:class:`CheckpointManager` adds step-indexed filenames, rolling
+retention of the last ``keep`` snapshots, and a ``load_latest`` that
+walks BACKWARD over corrupt/unreadable snapshots — a half-written or
+flipped newest file costs one checkpoint interval, not the run.
+
+Writes go through :func:`magicsoup_tpu.guard.io.atomic_write_bytes`, so
+a crash mid-save never destroys an existing snapshot.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+import re
+from pathlib import Path
+
+from magicsoup_tpu.guard.errors import CheckpointError
+from magicsoup_tpu.guard.io import atomic_write_bytes
+
+_MAGIC = b"MSCK\n"
+SCHEMA_VERSION = 1
+
+
+def _pack(obj, meta: dict | None) -> bytes:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    header = {
+        "schema": SCHEMA_VERSION,
+        "payload_len": len(payload),
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "meta": dict(meta or {}),
+    }
+    head = json.dumps(header, separators=(",", ":"), sort_keys=True)
+    return _MAGIC + head.encode("utf-8") + b"\n" + payload
+
+
+def write_checkpoint(path, obj, *, meta: dict | None = None) -> Path:
+    """Atomically write ``obj`` as a verified checkpoint file."""
+    path = Path(path)
+    atomic_write_bytes(path, _pack(obj, meta))
+    return path
+
+
+def _read_header(path: Path) -> tuple[dict, bytes]:
+    try:
+        raw = path.read_bytes()
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"checkpoint {path} does not exist", check="truncated", path=path
+        ) from None
+    if not raw.startswith(_MAGIC):
+        raise CheckpointError(
+            f"checkpoint {path} failed the magic check: not an MSCK file",
+            check="magic",
+            path=path,
+        )
+    body = raw[len(_MAGIC) :]
+    nl = body.find(b"\n")
+    if nl < 0:
+        raise CheckpointError(
+            f"checkpoint {path} failed the header check: truncated before "
+            "the header line ended",
+            check="header",
+            path=path,
+        )
+    try:
+        header = json.loads(body[:nl].decode("utf-8"))
+        schema = int(header["schema"])
+        payload_len = int(header["payload_len"])
+        digest = str(header["sha256"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as exc:
+        raise CheckpointError(
+            f"checkpoint {path} failed the header check: {exc}",
+            check="header",
+            path=path,
+        ) from exc
+    header["schema"] = schema
+    header["payload_len"] = payload_len
+    header["sha256"] = digest
+    return header, body[nl + 1 :]
+
+
+def inspect_checkpoint(path) -> dict:
+    """Verified header (schema/meta/digest) WITHOUT unpickling the
+    payload — safe on untrusted files; listing tools use this."""
+    header, _payload = _read_header(Path(path))
+    return header
+
+
+def read_checkpoint(path) -> tuple[object, dict]:
+    """Load a checkpoint, verifying magic -> schema -> length -> digest
+    BEFORE unpickling.  Returns ``(obj, meta)``."""
+    path = Path(path)
+    header, payload = _read_header(path)
+    if header["schema"] != SCHEMA_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path} failed the version check: schema "
+            f"{header['schema']} != supported {SCHEMA_VERSION}",
+            check="version",
+            path=path,
+        )
+    if len(payload) != header["payload_len"]:
+        raise CheckpointError(
+            f"checkpoint {path} failed the truncation check: payload is "
+            f"{len(payload)} bytes, header promises {header['payload_len']}",
+            check="truncated",
+            path=path,
+        )
+    actual = hashlib.sha256(payload).hexdigest()
+    if actual != header["sha256"]:
+        raise CheckpointError(
+            f"checkpoint {path} failed the digest check: payload sha256 "
+            f"{actual[:16]}... != header {header['sha256'][:16]}...",
+            check="digest",
+            path=path,
+        )
+    try:
+        obj = pickle.loads(payload)
+    except Exception as exc:  # noqa: BLE001 - surfaced as the typed error
+        raise CheckpointError(
+            f"checkpoint {path} failed to unpickle after all byte checks "
+            f"passed: {exc}",
+            check="unpickle",
+            path=path,
+        ) from exc
+    return obj, header.get("meta", {})
+
+
+class CheckpointManager:
+    """Step-indexed checkpoint directory with rolling retention.
+
+    Parameters:
+        directory: Where the ``<prefix>-<step>.msck`` files live
+            (created on first save).
+        keep: How many newest snapshots to retain; older ones are
+            pruned after each successful save.  ``keep >= 2`` is the
+            sane minimum — it is what makes ``load_latest``'s
+            walk-backward fallback useful when the newest file is
+            corrupt.
+        prefix: Filename prefix (several managers can share a dir).
+    """
+
+    def __init__(self, directory, *, keep: int = 3, prefix: str = "ckpt"):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        if not re.fullmatch(r"[A-Za-z0-9_.-]+", prefix):
+            raise ValueError(f"prefix {prefix!r} must be filename-safe")
+        self.directory = Path(directory)
+        self.keep = int(keep)
+        self.prefix = prefix
+        self._pat = re.compile(rf"^{re.escape(prefix)}-(\d+)\.msck$")
+
+    def path_for(self, step: int) -> Path:
+        return self.directory / f"{self.prefix}-{int(step):010d}.msck"
+
+    def checkpoints(self) -> list[tuple[int, Path]]:
+        """``(step, path)`` pairs, ascending by step."""
+        if not self.directory.is_dir():
+            return []
+        out = []
+        for p in self.directory.iterdir():
+            m = self._pat.match(p.name)
+            if m:
+                out.append((int(m.group(1)), p))
+        out.sort()
+        return out
+
+    def latest(self) -> Path | None:
+        cks = self.checkpoints()
+        return cks[-1][1] if cks else None
+
+    def save(self, obj, *, step: int, meta: dict | None = None) -> Path:
+        """Write ``obj`` at ``step`` and prune beyond ``keep``."""
+        meta = dict(meta or {})
+        meta.setdefault("step", int(step))
+        path = write_checkpoint(self.path_for(step), obj, meta=meta)
+        self.prune()
+        return path
+
+    def prune(self) -> list[Path]:
+        """Delete all but the newest ``keep`` snapshots; returns the
+        removed paths."""
+        removed = []
+        for _step, p in self.checkpoints()[: -self.keep or None]:
+            try:
+                p.unlink()
+            except OSError:
+                continue
+            removed.append(p)
+        return removed
+
+    def load(self, path) -> tuple[object, dict]:
+        return read_checkpoint(path)
+
+    def load_latest(
+        self, *, fallback: bool = True
+    ) -> tuple[object, dict, Path]:
+        """Load the newest verifiable checkpoint.
+
+        With ``fallback`` (default) a corrupt/truncated/mismatched
+        newest file is SKIPPED with a warning and the walk continues
+        backward — the retention window is exactly the budget for this.
+        Raises :class:`CheckpointError` (``check="none"``) when nothing
+        in the directory loads.
+        """
+        cks = self.checkpoints()
+        errors: list[CheckpointError] = []
+        for _step, path in reversed(cks):
+            try:
+                obj, meta = read_checkpoint(path)
+            except CheckpointError as exc:
+                if not fallback:
+                    raise
+                errors.append(exc)
+                import warnings
+
+                warnings.warn(
+                    f"skipping unloadable checkpoint {path.name} "
+                    f"(failed check: {exc.check}); falling back to the "
+                    "previous snapshot"
+                )
+                continue
+            return obj, meta, path
+        detail = "; ".join(f"{e.path}: {e.check}" for e in errors)
+        raise CheckpointError(
+            f"no loadable checkpoint under {self.directory}"
+            + (f" (rejected: {detail})" if detail else " (directory empty)"),
+            check="none",
+            path=self.directory,
+        )

@@ -1,0 +1,76 @@
+"""Typed errors for the graftguard fault-tolerance layer.
+
+Every failure a driver might want to CATCH AND HANDLE differently gets
+its own class with structured fields — a restore loop that falls back to
+the previous snapshot needs to distinguish "file is corrupt" from "file
+is from a future schema" without parsing message strings.
+"""
+from __future__ import annotations
+
+
+class GuardError(RuntimeError):
+    """Base class for all graftguard errors."""
+
+
+class CheckpointError(GuardError):
+    """A checkpoint could not be written, verified, or loaded.
+
+    Attributes:
+        check: Which verification failed — one of ``"magic"``,
+            ``"header"``, ``"version"``, ``"truncated"``, ``"digest"``,
+            ``"unpickle"``, ``"config"``, ``"format"``, or ``"none"``
+            (no loadable checkpoint found).
+        path: The offending file, when there is one.
+    """
+
+    def __init__(self, message: str, *, check: str, path=None):
+        super().__init__(message)
+        self.check = check
+        self.path = None if path is None else str(path)
+
+
+class SentinelTripped(GuardError):
+    """A health sentinel fired under the ``rollback`` policy.
+
+    Attributes:
+        flags: The raw health flag word from the step record (see
+            :func:`magicsoup_tpu.guard.sentinel.decode_health`).
+        step: The replayed step index at which the flags were observed.
+        n_bad_cells: How many live cells carried a bad concentration.
+    """
+
+    def __init__(self, message: str, *, flags: int, step: int, n_bad_cells: int):
+        super().__init__(message)
+        self.flags = int(flags)
+        self.step = int(step)
+        self.n_bad_cells = int(n_bad_cells)
+
+
+class WatchdogTimeout(GuardError):
+    """A dispatch/fetch exceeded its wall-clock budget.
+
+    Raised (fetch) or reported via diagnostics dump (dispatch — a stuck
+    C call cannot be interrupted from Python) instead of hanging the
+    process, the capture-probe failure mode.
+
+    Attributes:
+        phase: ``"fetch"`` or ``"dispatch"``.
+        seconds: The budget that was exceeded.
+    """
+
+    def __init__(self, message: str, *, phase: str, seconds: float):
+        super().__init__(message)
+        self.phase = phase
+        self.seconds = float(seconds)
+
+
+class TransientDispatchError(GuardError):
+    """Fault-injection stand-in for a transient backend error.
+
+    The message deliberately carries a transient marker
+    (``UNAVAILABLE``) so :func:`magicsoup_tpu.guard.retry.is_transient_error`
+    classifies it exactly like a real tunnel drop.
+    """
+
+    def __init__(self, message: str = "injected fault: UNAVAILABLE: backend lost"):
+        super().__init__(message)

@@ -1,0 +1,98 @@
+"""Fault injection: the chaos hooks the gated smoke drives.
+
+Every guard mechanism needs a way to make its failure happen on demand:
+
+- :func:`flip_byte` corrupts a checkpoint file in place (exercises the
+  digest check + ``load_latest`` walk-back),
+- :func:`inject_nan` poisons live device state (exercises the health
+  sentinel lanes and the quarantine/rollback policies),
+- :func:`inject_dispatch_failures` makes the next N step dispatches
+  raise a transient error (exercises bounded retry-with-backoff),
+- process-level chaos (SIGKILL mid-megastep, SIGTERM graceful drain)
+  lives in ``performance/smoke.py --chaos``, which orchestrates child
+  processes around these hooks.
+
+Import cost is deliberately tiny; nothing here runs unless called.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from magicsoup_tpu.guard.errors import TransientDispatchError
+
+
+def flip_byte(path, offset: int | None = None, *, rng=None) -> int:
+    """Flip one byte of ``path`` in place; returns the offset flipped.
+
+    Default offset targets the payload region (past the magic + header
+    line) so the corruption exercises the DIGEST check rather than the
+    cheaper header parse.  Pass ``rng`` (``random.Random``) to pick a
+    random payload offset reproducibly.
+    """
+    path = Path(path)
+    data = bytearray(path.read_bytes())
+    if len(data) == 0:
+        raise ValueError(f"cannot flip a byte of empty file {path}")
+    if offset is None:
+        start = data.find(b"\n", data.find(b"\n") + 1) + 1
+        if start <= 0 or start >= len(data):
+            start = 0
+        if rng is not None:
+            offset = rng.randrange(start, len(data))
+        else:
+            offset = start
+    offset = int(offset) % len(data)
+    data[offset] ^= 0xFF
+    # deliberately NON-atomic: this simulates on-disk corruption of an
+    # already-complete file, not a torn write
+    with open(path, "wb") as fh:  # graftlint: disable=GL010 fault injector corrupts files on purpose
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return offset
+
+
+def inject_nan(target, *, row: int = 0, mol: int = 0) -> None:
+    """Poison one concentration with NaN.
+
+    ``target`` is a ``PipelinedStepper`` (poisons the live device carry,
+    so the NEXT fused step's sentinel lanes see it) or a ``World``
+    (poisons the cell-molecule buffer the classic driver integrates).
+    """
+    import jax.numpy as jnp
+
+    if hasattr(target, "_state"):  # stepper: poison the device carry
+        st = target
+        st._state = st._state._replace(
+            cm=st._state.cm.at[row, mol].set(jnp.nan)
+        )
+    else:  # world
+        w = target
+        w._cell_molecules = w._cell_molecules.at[row, mol].set(jnp.nan)
+        w._cm_cache = None
+
+
+def inject_dispatch_failures(stepper, n: int = 1) -> None:
+    """Arm the stepper so its next ``n`` step dispatches raise
+    :class:`TransientDispatchError` BEFORE touching device buffers.
+
+    The error carries a transient marker, so a stepper constructed with
+    ``dispatch_retries >= n`` absorbs the faults through its bounded
+    backoff and the trajectory is unchanged (retries fire before any
+    donated input is consumed).
+    """
+    if not hasattr(stepper, "_fault_dispatch"):
+        raise TypeError(
+            f"{type(stepper).__name__} has no dispatch fault hook"
+        )
+    stepper._fault_dispatch = int(n)
+
+
+def consume_dispatch_fault(stepper) -> None:
+    """Stepper-side check (called at the top of the dispatch wrapper):
+    raise one armed fault, decrementing the countdown."""
+    count = getattr(stepper, "_fault_dispatch", 0)
+    if count > 0:
+        stepper._fault_dispatch = count - 1
+        raise TransientDispatchError()

@@ -1,0 +1,67 @@
+"""Crash-safe file writes: temp file -> fsync -> ``os.replace``.
+
+The naive ``open(path, "wb"); write`` truncates the previous snapshot
+the moment the file opens — a crash (SIGKILL, OOM, power) between the
+truncate and the final flush destroys BOTH the old state and the new.
+The atomic protocol here guarantees a reader sees either the complete
+old bytes or the complete new bytes, never a prefix:
+
+1. write the full payload to a uniquely-named temp file IN THE SAME
+   DIRECTORY (``os.replace`` is only atomic within a filesystem),
+2. flush + ``os.fsync`` the temp file (data durable before the rename
+   makes it visible),
+3. ``os.replace`` onto the target (atomic on POSIX and Windows),
+4. fsync the directory so the rename itself survives a power cut.
+
+This module is stdlib-only on purpose: ``scripts/summarize_capture.py``
+and other no-jax consumers must be able to import it.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def _fsync_dir(dirpath: Path) -> None:
+    # directory fsync is POSIX-only; on platforms that refuse to open a
+    # directory the rename is still atomic, just not power-cut durable
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data: bytes) -> None:
+    """Atomically replace ``path`` with ``data`` (see module docstring).
+
+    The temp file carries the target's name plus a pid/random suffix so
+    concurrent writers never collide; on any failure the temp file is
+    removed and the previous ``path`` contents are untouched.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.parent / (
+        f".{path.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"  # graftlint: disable=GL004 temp-file name uniqueness, not simulation state
+    )
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(path.parent)
+
+
+def atomic_write_text(path, text: str, encoding: str = "utf-8") -> None:
+    """:func:`atomic_write_bytes` for text payloads."""
+    atomic_write_bytes(path, text.encode(encoding))

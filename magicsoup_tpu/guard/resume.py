@@ -1,0 +1,264 @@
+"""Deterministic resume: snapshot/restore the full trajectory state.
+
+The contract (pinned by ``tests/fast/test_guard.py`` and the chaos
+smoke): in det mode, ``[run K, checkpoint, run K]`` is BIT-identical to
+``[run K, checkpoint, SIGKILL, restore in a fresh process, run K]`` for
+both the classic driver and :class:`~magicsoup_tpu.stepper.PipelinedStepper`
+at any megastep — the byte-equality contract PRs 2/5 established for
+fusion and sharding, extended across process death.  The surviving
+reference checkpoints at the same boundary because a pipelined
+checkpoint IS a flush, and draining the pipeline is itself part of the
+deterministic schedule (it re-packs the row space and applies in-flight
+phenotype pushes, bracketing float work differently than an unflushed
+run).  The classic driver has no pipeline, so there the checkpoint is
+trajectory-invisible and ``[run 2K]`` equals the killed/restored run
+outright.
+
+What a run snapshot must carry beyond ``pickle(world)``:
+
+- **Every PRNG stream.** The world pickle carries ``world._rng`` /
+  ``world._nprng``, but a fresh stepper's constructor DRAWS from
+  ``world._rng`` twice (its own rng seed + the device PRNG key), so
+  :func:`restore_stepper` re-seats all three streams AFTER construction
+  — otherwise the restored trajectory forks at the first random draw.
+- **The device PRNG key.** ``DeviceState.key`` is device state the
+  world pickle never sees.
+- **Stepper schedule state.** Spawn queue, growth history (feeds the
+  division-budget estimate, which changes compiled upper bounds and
+  hence trajectories), change/dispatch sequence counters, and stats.
+
+Snapshots are taken at the stepper's FLUSH boundary — the one point
+where the pipeline is drained, the evolution worker joined, all
+phenotype pushes applied, and the World is the source of truth — so
+pending dispatches never need serializing and no extra device sync is
+introduced.  Mesh runs snapshot via the world's normal host fetch
+(already-replicated record + sharded-state device_get) and re-shard on
+restore via ``restore_run(..., mesh=...)``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from magicsoup_tpu.guard.checkpoint import CheckpointManager, read_checkpoint
+from magicsoup_tpu.guard.errors import CheckpointError
+
+RUN_FORMAT = "magicsoup_tpu.guard.run/1"
+
+# constructor-fixed knobs that must match between the checkpointing
+# stepper and the restoring one — a mismatch silently changes the
+# trajectory, so restore_stepper refuses it instead
+_CONFIG_FIELDS = (
+    "mol_idx",
+    "kill_below",
+    "divide_above",
+    "divide_cost",
+    "target_cells",
+    "genome_size",
+    "lag",
+    "max_lag",
+    "megastep",
+    "max_divisions",
+    "spawn_block",
+    "push_block",
+    "n_rounds",
+    "p_mutation",
+    "p_indel",
+    "p_del",
+    "p_recombination",
+    "compact_headroom",
+    "compact_dead_slack",
+    "auto_grow",
+)
+
+
+def stepper_config(stepper) -> dict:
+    """The trajectory-determining constructor knobs of a stepper."""
+    cfg = {name: getattr(stepper, name) for name in _CONFIG_FIELDS}
+    cfg["overlap_evolution"] = stepper._evo_worker is not None
+    cfg["n_tiles"] = stepper._n_tiles
+    cfg["deterministic"] = bool(stepper.world.deterministic)
+    return cfg
+
+
+def snapshot_run(world, stepper=None) -> dict:
+    """Build the checkpoint payload for a run.
+
+    With a stepper, flushes it first (drain + evolution join + push
+    apply + world sync) so the World alone is the full simulation state
+    and the stepper contributes only its host schedule state.  The
+    classic driver passes ``stepper=None`` — the world pickle already
+    carries its PRNG streams.
+    """
+    from magicsoup_tpu.util import fetch_host
+
+    aux = None
+    if stepper is not None:
+        stepper.flush()
+        aux = {
+            "config": stepper_config(stepper),
+            "key": np.asarray(fetch_host(stepper._state.key)),
+            "rng_state": stepper._rng.bit_generator.state,
+            "spawn_queue": [tuple(item) for item in stepper._spawn_queue],
+            "growth_hist": list(stepper._growth_hist),
+            "change_seq": int(stepper._change_seq),
+            "dispatched_seq": int(stepper._dispatched_seq),
+            "stats": dict(stepper.stats),
+        }
+    return {
+        "format": RUN_FORMAT,
+        "world": world,
+        "stepper": aux,
+        # captured AFTER any stepper flush; restore_stepper re-seats
+        # these post-construction (the ctor draws from world._rng)
+        "world_rng_state": world._rng.getstate(),
+        "world_nprng_state": world._nprng.bit_generator.state,
+    }
+
+
+def save_run(
+    manager: CheckpointManager,
+    world,
+    stepper=None,
+    *,
+    step: int | None = None,
+    meta: dict | None = None,
+):
+    """Snapshot + write one retained checkpoint; returns its path.
+
+    ``step`` defaults to the stepper's replayed-step counter (or the
+    number of existing checkpoints for stepper-less classic runs).
+    """
+    payload = snapshot_run(world, stepper)
+    if step is None:
+        if stepper is not None:
+            step = int(stepper.stats["replayed"])
+        else:
+            step = len(manager.checkpoints())
+    return manager.save(payload, step=step, meta=meta)
+
+
+def _remesh_world(world, mesh) -> None:
+    """Re-shard a freshly unpickled world over ``mesh`` (pickles drop
+    meshes/shardings — they are bound to live runtimes)."""
+    from magicsoup_tpu.parallel import tiled
+    from magicsoup_tpu.util import fetch_host
+
+    import jax
+
+    n_tiles = int(mesh.shape[mesh.axis_names[0]])
+    if world.map_size % n_tiles != 0:
+        raise ValueError(
+            f"map_size={world.map_size} must be divisible by the first"
+            f" mesh axis size {n_tiles} for row sharding"
+        )
+    if world._capacity % n_tiles != 0:
+        raise ValueError(
+            f"restored capacity {world._capacity} does not split across"
+            f" {n_tiles} tiles; checkpoint was taken under a different"
+            " mesh size"
+        )
+    world._mesh = mesh
+    world._map_sharding = tiled.map_sharding(mesh)
+    world._cell_sharding = tiled.cell_sharding(mesh)
+    world._molecule_map = world._place_map(fetch_host(world._molecule_map))
+    world._cell_molecules = world._place_cells(
+        fetch_host(world._cell_molecules)
+    )
+    world._sync_positions()
+    world._mm_cache = None
+    world._cm_cache = None
+    kin = world.kinetics
+    kin.cell_sharding = world._cell_sharding
+    kin.params = type(kin.params)(
+        *(
+            jax.device_put(fetch_host(t), world._cell_sharding)
+            for t in kin.params
+        )
+    )
+
+
+def restore_run(source, *, mesh=None) -> tuple:
+    """Load a run checkpoint; returns ``(world, stepper_aux, meta)``.
+
+    ``source`` is a :class:`CheckpointManager` (loads the newest
+    verifiable snapshot, walking back over corrupt ones) or a path to a
+    single ``.msck`` file.  Pass ``mesh`` to re-shard the restored world
+    (pickles are mesh-free by design).  ``stepper_aux`` is ``None`` for
+    classic-driver checkpoints; otherwise construct a stepper with the
+    SAME kwargs and hand both to :func:`restore_stepper`.
+    """
+    if isinstance(source, CheckpointManager):
+        payload, meta, _path = source.load_latest()
+    else:
+        payload, meta = read_checkpoint(source)
+    if not isinstance(payload, dict) or payload.get("format") != RUN_FORMAT:
+        raise CheckpointError(
+            f"checkpoint payload is not a {RUN_FORMAT} run snapshot "
+            f"(got {type(payload).__name__}"
+            + (
+                f" with format={payload.get('format')!r})"
+                if isinstance(payload, dict)
+                else ")"
+            ),
+            check="format",
+        )
+    world = payload["world"]
+    if mesh is not None:
+        _remesh_world(world, mesh)
+    # classic resume: re-seat the world streams here (no stepper ctor
+    # will draw from them); stepper resume re-seats in restore_stepper
+    aux = payload["stepper"]
+    if aux is None:
+        world._rng.setstate(payload["world_rng_state"])
+        world._nprng.bit_generator.state = payload["world_nprng_state"]
+    else:
+        aux = dict(aux)
+        aux["world_rng_state"] = payload["world_rng_state"]
+        aux["world_nprng_state"] = payload["world_nprng_state"]
+    return world, aux, meta
+
+
+def restore_stepper(stepper, aux: dict) -> None:
+    """Re-seat a freshly constructed stepper to the checkpointed
+    schedule state (call with the world returned by
+    :func:`restore_run` and a stepper built with the SAME kwargs).
+
+    Refuses (``CheckpointError``, ``check="config"``) when a
+    trajectory-determining knob differs — a silently different config
+    would break bit-identity invisibly.
+    """
+    want = aux["config"]
+    have = stepper_config(stepper)
+    diff = sorted(
+        k for k in set(want) | set(have) if want.get(k) != have.get(k)
+    )
+    if diff:
+        detail = ", ".join(
+            f"{k}: checkpoint={want.get(k)!r} != stepper={have.get(k)!r}"
+            for k in diff
+        )
+        raise CheckpointError(
+            f"stepper config does not match the checkpoint ({detail})",
+            check="config",
+        )
+    # the ctor drew from world._rng (twice) — rewind all streams to the
+    # snapshot point so the next draw matches the uninterrupted run
+    stepper.world._rng.setstate(aux["world_rng_state"])
+    stepper.world._nprng.bit_generator.state = aux["world_nprng_state"]
+    stepper._rng.bit_generator.state = aux["rng_state"]
+    stepper._spawn_queue = [tuple(item) for item in aux["spawn_queue"]]
+    stepper._growth_hist = list(aux["growth_hist"])
+    stepper._change_seq = int(aux["change_seq"])
+    stepper._dispatched_seq = int(aux["dispatched_seq"])
+    stepper.stats.update(aux["stats"])
+    # re-enter through the post-flush path: the next step() re-attaches
+    # from the (restored) World with the checkpointed device key —
+    # exactly what the uninterrupted run does after its flush
+    import jax.numpy as jnp
+
+    stepper._state = stepper._state._replace(
+        key=jnp.asarray(aux["key"])
+        if stepper._mesh is None
+        else stepper._dev(aux["key"])
+    )
+    stepper._needs_attach = True

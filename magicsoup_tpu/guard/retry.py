@@ -1,0 +1,68 @@
+"""Bounded retry-with-backoff for transient backend failures.
+
+A long run over a preemptible TPU pod sees occasional transient RPC
+errors (tunnel drop, brief UNAVAILABLE) that a blind crash turns into a
+lost trajectory.  The policy here is deliberately narrow:
+
+- only errors whose text carries a known transient marker are retried
+  (a shape error or OOM retried forever is a hang, not resilience),
+- the retry budget is bounded and the delay exponential with a cap,
+- every retry is observable via the ``on_retry`` callback (the stepper
+  wires it to a stats counter + telemetry note).
+
+Determinism note: retries happen at the DISPATCH boundary, before any
+result is consumed — a successfully retried dispatch produces the same
+bytes as a first-try success, so the bit-identity contract survives.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+# substrings that mark an error as plausibly transient; mirrors the
+# classification performance/bench.py uses for probe failures
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED: Attempting to reserve",
+    "Socket closed",
+    "Connection reset",
+    "transport is closing",
+)
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a transient backend/RPC failure
+    worth retrying (vs. a deterministic bug that never will succeed)."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    retries: int,
+    base_delay: float = 0.5,
+    max_delay: float = 8.0,
+    retry_if: Callable[[BaseException], bool] = is_transient_error,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` with up to ``retries`` retries on transient errors.
+
+    Delay doubles each attempt from ``base_delay`` up to ``max_delay``.
+    Non-transient errors (per ``retry_if``) and the final transient
+    failure propagate unchanged.  ``on_retry(attempt, exc)`` fires
+    before each sleep; ``sleep`` is injectable so tests stay instant.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 - reraised unless retried
+            if attempt >= retries or not retry_if(exc):
+                raise
+            attempt += 1
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            sleep(min(max_delay, base_delay * (2.0 ** (attempt - 1))))

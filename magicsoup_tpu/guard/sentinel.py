@@ -1,0 +1,75 @@
+"""Health sentinels: decode the packed flag word, quarantine bad cells.
+
+The fused step folds a 4-bit health word into the step record it
+already fetches (stepper record word 8), so detection is unconditional,
+det-safe, and costs ZERO extra device-to-host transfers — the same
+packed-lane pattern the metric lanes use.  This module is the HOST side:
+interpreting the flags and acting on them under the configured policy.
+
+Policies (``PipelinedStepper(sentinel_policy=...)``):
+
+- ``"warn"`` (default): count the trip in stats + emit a telemetry note.
+- ``"quarantine"``: additionally kill the poisoned cells and sanitize
+  the molecule map at the next safe host boundary (the stepper flushes
+  its pipeline first — quarantine mutates world state, which would
+  otherwise race in-flight megasteps).
+- ``"rollback"``: raise :class:`~magicsoup_tpu.guard.errors.SentinelTripped`
+  so the driver restores the last good checkpoint.
+
+Bit layout of the flag word (must match ``ms:sentinel`` in stepper.py)::
+
+    bit 0  molecule map has a non-finite value
+    bit 1  molecule map has a value below -NEG_EPS
+    bit 2  a live cell's molecules have a non-finite value
+    bit 3  a live cell's molecules have a value below -NEG_EPS
+"""
+from __future__ import annotations
+
+import numpy as np
+
+SENTINEL_POLICIES = ("warn", "quarantine", "rollback")
+
+# tolerance below zero before a concentration counts as "negative":
+# the integrator clips at 0 but fp arithmetic on clipped values can
+# transiently dip an epsilon below — only a materially negative value
+# indicates divergence
+NEG_EPS = 1e-4
+
+FLAG_MM_NONFINITE = 1 << 0
+FLAG_MM_NEGATIVE = 1 << 1
+FLAG_CM_NONFINITE = 1 << 2
+FLAG_CM_NEGATIVE = 1 << 3
+
+
+def decode_health(flags: int) -> dict:
+    """Expand the packed flag word into named booleans."""
+    flags = int(flags)
+    return {
+        "mm_nonfinite": bool(flags & FLAG_MM_NONFINITE),
+        "mm_negative": bool(flags & FLAG_MM_NEGATIVE),
+        "cm_nonfinite": bool(flags & FLAG_CM_NONFINITE),
+        "cm_negative": bool(flags & FLAG_CM_NEGATIVE),
+    }
+
+
+def quarantine_world(world) -> int:
+    """Kill cells carrying non-finite/negative concentrations and
+    sanitize the molecule map.  Returns how many cells were killed.
+
+    Host-boundary operation: callers (the stepper's quarantine hook)
+    must have drained in-flight device work first.
+    """
+    n_killed = 0
+    if world.n_cells > 0:
+        cm = np.asarray(world.cell_molecules)
+        bad = ~np.isfinite(cm) | (cm < -NEG_EPS)
+        rows = np.nonzero(bad.any(axis=1))[0]
+        if len(rows) > 0:
+            world.kill_cells([int(r) for r in rows])
+            n_killed = len(rows)
+    mm = np.asarray(world.molecule_map)
+    if not np.isfinite(mm).all() or (mm < -NEG_EPS).any():
+        world.molecule_map = np.clip(
+            np.nan_to_num(mm, nan=0.0, posinf=0.0, neginf=0.0), 0.0, None
+        )
+    return n_killed

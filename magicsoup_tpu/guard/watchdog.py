@@ -1,0 +1,109 @@
+"""Wall-clock watchdog: turn a wedged dispatch/fetch into diagnostics.
+
+The standing failure mode (see the capture-probe notes in
+``scripts/capture_tpu_numbers.sh``) is a backend call that never
+returns — the process just hangs, with no stack trace and no record of
+what it was doing.  A Python-side timeout cannot INTERRUPT a stuck C
+call, but it can make the hang observable: dump every thread's stack to
+stderr, write a structured JSON diagnostic line, and (for fetches,
+which accept a timeout) raise a typed
+:class:`~magicsoup_tpu.guard.errors.WatchdogTimeout`.
+"""
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+_DEFAULT_FETCH_TIMEOUT = 300.0
+
+
+def fetch_timeout() -> float:
+    """Wall-clock budget (seconds) for a single result fetch.
+
+    Overridable via ``MAGICSOUP_GUARD_FETCH_TIMEOUT`` so chaos tests can
+    force a fast trip and huge sharded fetches can raise the ceiling.
+    """
+    raw = os.environ.get("MAGICSOUP_GUARD_FETCH_TIMEOUT", "")
+    try:
+        value = float(raw)
+    except ValueError:
+        return _DEFAULT_FETCH_TIMEOUT
+    return value if value > 0 else _DEFAULT_FETCH_TIMEOUT
+
+
+def dump_diagnostics(tag: str, extra: dict | None = None) -> dict:
+    """Dump all thread stacks to stderr plus one JSON diagnostic line.
+
+    Returns the diagnostic record so callers can attach it to an error
+    or telemetry row.  Never raises — this runs on the failure path.
+    """
+    record = {
+        "diagnostic": tag,
+        "pid": os.getpid(),
+        "time": time.time(),  # graftlint: disable=GL004 diagnostic timestamp, not simulation state
+    }
+    if extra:
+        record.update(extra)
+    try:
+        sys.stderr.write(f"[graftguard] diagnostics: {tag}\n")
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.write(json.dumps(record, default=str) + "\n")
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001 - diagnostics must not mask the hang
+        pass
+    return record
+
+
+class Watchdog:
+    """Monitor thread that fires when a phase overstays its budget.
+
+    Usage::
+
+        wd = Watchdog(120.0, tag="dispatch")
+        with wd.phase("megastep dispatch"):
+            step_fn(...)
+
+    If the body is still running when the budget elapses, the monitor
+    calls ``on_timeout`` (default: :func:`dump_diagnostics`) exactly
+    once per phase — it cannot abort the stuck call, but the hang
+    becomes a stack dump + JSON record instead of silence.
+    """
+
+    def __init__(self, timeout: float, *, tag: str = "watchdog", on_timeout=None):
+        self.timeout = float(timeout)
+        self.tag = tag
+        self.on_timeout = on_timeout
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def phase(self, name: str):
+        done = threading.Event()
+
+        def _monitor():
+            if not done.wait(self.timeout):
+                with self._lock:
+                    self.fired += 1
+                handler = self.on_timeout
+                if handler is None:
+                    dump_diagnostics(
+                        f"{self.tag}:{name} exceeded {self.timeout:.1f}s",
+                        {"phase": name, "timeout_s": self.timeout},
+                    )
+                else:
+                    handler(name, self.timeout)
+
+        t = threading.Thread(
+            target=_monitor, name=f"graftguard-{self.tag}", daemon=True
+        )
+        t.start()
+        try:
+            yield
+        finally:
+            done.set()
+            t.join(timeout=1.0)

@@ -78,6 +78,11 @@ from magicsoup_tpu.util import (
     register_exit_join as _register_exit_join,
 )
 
+# graftguard sentinel tolerance (host policy + device lanes must agree
+# on what counts as "negative"); the guard package is numpy/stdlib-only
+# at import time, so this does not pull jax machinery in twice
+from magicsoup_tpu.guard.sentinel import NEG_EPS as _SENTINEL_NEG_EPS
+
 # numpy on purpose: a module-level jnp array would initialise the XLA
 # backend at import time, which breaks jax.distributed.initialize() in
 # multi-host programs importing this package
@@ -113,13 +118,20 @@ class StepOutputs(NamedTuple):
     # i32 — the load-balance lane riding the same packed record; None on
     # single-device runs (the record layout is unchanged there)
     tile_occupancy: Any = None
+    # graftguard health lanes: computed UNCONDITIONALLY like the metric
+    # lanes (device program identical guard-on vs guard-off, zero extra
+    # D2H) — the flag word per guard.sentinel's bit layout, and the
+    # per-row bad-concentration bitmask behind it
+    health: int = 0
+    bad_cells: Any = None
 
 
 _BITS = 16  # bits packed per i32 word (16 keeps every value positive)
 # leading scalar words of the packed record: [n_placed, n_candidates,
 # n_attempted, n_rows, n_alive, n_occupied, mm_mass(f32 bits),
-# cm_mass(f32 bits)] — _step_body's pack and _unpack_outputs must agree
-_HEADER_WORDS = 8
+# cm_mass(f32 bits), health_flags] — _step_body's pack and
+# _unpack_outputs must agree
+_HEADER_WORDS = 9
 
 
 def _pack_bits(b: jax.Array) -> jax.Array:
@@ -469,6 +481,27 @@ def _step_body(
         else:
             tile_occ = None
 
+    # ---- 4.6 graftguard health sentinel lanes -------------------------
+    # same contract as the metric lanes: unconditional (the compiled
+    # program is byte-identical whatever the host-side sentinel policy
+    # is), det-safe (boolean AND/OR reductions are exact in any order),
+    # and BEFORE compaction so the bad-cell bitmask uses the same row
+    # space as the kill lane.  The negative check tolerates the fp
+    # epsilon the clipped integrator can transiently dip below zero.
+    with jax.named_scope("ms:sentinel"):
+        mm_nonfin = ~jnp.isfinite(mm).all()
+        mm_neg = (mm < -_SENTINEL_NEG_EPS).any()
+        alive_rows = alive[:, None]
+        cm_nonfin_rows = (~jnp.isfinite(cm) & alive_rows).any(axis=1)
+        cm_neg_rows = ((cm < -_SENTINEL_NEG_EPS) & alive_rows).any(axis=1)
+        bad_cells = cm_nonfin_rows | cm_neg_rows
+        health = (
+            mm_nonfin.astype(jnp.int32)
+            | (mm_neg.astype(jnp.int32) << 1)
+            | (cm_nonfin_rows.any().astype(jnp.int32) << 2)
+            | (cm_neg_rows.any().astype(jnp.int32) << 3)
+        )
+
     # ---- 5. optional compaction ---------------------------------------
     child_pos_out = cpos[jnp.clip(p_idx, 0, cap - 1)]
     if compact:
@@ -488,7 +521,9 @@ def _step_body(
     # one packed i32 output vector = one device->host transfer per replay.
     # header words 5-7 are the telemetry lanes: occupied-pixel count and
     # the two f32 mass totals bitcast into i32 (the host re-views the
-    # bits as float32 — exact, no rounding through a cast)
+    # bits as float32 — exact, no rounding through a cast); word 8 is
+    # the graftguard health flag word, with the per-row bad-cell bitmask
+    # as the last pre-tail lane
     with jax.named_scope("ms:pack_record"):
         lanes = [
             jnp.stack(
@@ -505,6 +540,7 @@ def _step_body(
                     jax.lax.bitcast_convert_type(
                         cm_mass.astype(jnp.float32), jnp.int32
                     ),
+                    health,
                 ]
             ).astype(jnp.int32),
             _pack_bits(kill),
@@ -512,6 +548,7 @@ def _step_body(
             child_pos_out.reshape(-1).astype(jnp.int32),
             _pack_bits(spawn_ok),
             spawn_pos.reshape(-1).astype(jnp.int32),
+            _pack_bits(bad_cells),
         ]
         if tile_occ is not None:
             # mesh lanes ride the TAIL so every single-device offset in
@@ -939,6 +976,24 @@ class PipelinedStepper:
             dispatch), so toggling this flag — like upgrading past the
             release that introduced it — changes the trajectory a given
             seed produces.
+        sentinel_policy: Host reaction to the graftguard health lanes
+            (non-finite / materially negative concentrations, computed
+            on device every step regardless of this setting): ``"warn"``
+            counts + notes the trip, ``"quarantine"`` kills the poisoned
+            cells and sanitizes the map at the next flush boundary,
+            ``"rollback"`` raises
+            :class:`~magicsoup_tpu.guard.errors.SentinelTripped` so the
+            driver restores the last good checkpoint.  The compiled
+            device program is identical for all three.
+        dispatch_retries: Retry a FAILED step dispatch up to this many
+            times with bounded exponential backoff when the error looks
+            transient (``guard.retry``); 0 (default) propagates the
+            first failure.  Never retries after a donated input was
+            consumed.
+        fetch_timeout: Wall-clock budget (seconds) for one step-record
+            fetch before the watchdog dumps diagnostics and raises
+            :class:`~magicsoup_tpu.guard.errors.WatchdogTimeout`
+            (default: ``MAGICSOUP_GUARD_FETCH_TIMEOUT`` or 300).
     """
 
     def __init__(
@@ -966,6 +1021,9 @@ class PipelinedStepper:
         compact_dead_slack: int = 768,
         auto_grow: bool = True,
         overlap_evolution: bool = True,
+        sentinel_policy: str = "warn",
+        dispatch_retries: int = 0,
+        fetch_timeout: float | None = None,
     ):
         # mesh-placed worlds run the fused step SPMD (see _step_body's
         # mesh note); all host->device placements below go through
@@ -1014,6 +1072,24 @@ class PipelinedStepper:
         )
         self.compact_dead_slack = compact_dead_slack
         self.auto_grow = auto_grow
+        # graftguard: host-side policy over the unconditional sentinel
+        # lanes, bounded dispatch retry, and the fetch watchdog budget.
+        # None of these change the compiled device program.
+        from magicsoup_tpu.guard.sentinel import SENTINEL_POLICIES
+        from magicsoup_tpu.guard.watchdog import fetch_timeout as _ft
+
+        if sentinel_policy not in SENTINEL_POLICIES:
+            raise ValueError(
+                f"sentinel_policy must be one of {SENTINEL_POLICIES}"
+            )
+        self.sentinel_policy = sentinel_policy
+        self.dispatch_retries = int(dispatch_retries)
+        self._fetch_timeout = (
+            float(fetch_timeout) if fetch_timeout is not None else _ft()
+        )
+        self._quarantine_pending = False
+        self._sentinel_warned = False
+        self._fault_dispatch = 0  # armed by guard.faults
         self.stats = {
             "steps": 0,
             "replayed": 0,
@@ -1033,6 +1109,10 @@ class PipelinedStepper:
             "fetch_ms": 0,
             "dispatch_ms": 0,
             "step_ms": 0,
+            # graftguard counters
+            "sentinel_trips": 0,
+            "quarantined": 0,
+            "dispatch_retries": 0,
         }
         # graftscope: share the world's recorder so one JSONL stream
         # carries both; detached recorders cost one dict update per
@@ -1225,6 +1305,41 @@ class PipelinedStepper:
     # dispatch side                                                  #
     # -------------------------------------------------------------- #
 
+    def _dispatch_with_retry(self, fn):
+        """Run one dispatch, absorbing up to ``dispatch_retries``
+        transient failures with bounded exponential backoff.
+
+        Only transient errors (guard.retry's marker classification)
+        retry, and never after a failed dispatch has consumed a donated
+        input — re-sending a deleted buffer would crash differently, so
+        that case propagates the original error instead."""
+        if self.dispatch_retries <= 0:
+            return fn()
+        from magicsoup_tpu.guard.retry import is_transient_error, retry_call
+
+        def _retryable(exc: BaseException) -> bool:
+            if not is_transient_error(exc):
+                return False
+            if self._donate:
+                leaves = (*self._state, *self.kin.params)
+                if any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in leaves
+                ):
+                    return False
+            return True
+
+        def _note(attempt: int, exc: BaseException) -> None:
+            self.stats["dispatch_retries"] += 1
+            self.telemetry.note("dispatch_retry", 1.0)
+
+        return retry_call(
+            fn,
+            retries=self.dispatch_retries,
+            retry_if=_retryable,
+            on_retry=_note,
+        )
+
     def step(self) -> None:
         """Dispatch one workload step (``megastep`` fused device steps)
         and replay any arrived outputs."""
@@ -1232,6 +1347,17 @@ class PipelinedStepper:
 
         t_start = _time.perf_counter()
         fetch0 = self._fetch_acc
+        if self._quarantine_pending:
+            # sentinel quarantine runs at the next safe host boundary:
+            # drain + sync first — killing cells under in-flight
+            # megasteps would race the replay's row bookkeeping.  The
+            # flush leaves _needs_attach set, so the block below re-pulls
+            # the sanitized world.
+            self._quarantine_pending = False
+            self.flush()
+            from magicsoup_tpu.guard.sentinel import quarantine_world
+
+            self.stats["quarantined"] += quarantine_world(self.world)
         if self._needs_attach:
             # after a flush the World may have been advanced/mutated with
             # the classic API; re-pulling its state here (cheap: the
@@ -1373,29 +1499,41 @@ class PipelinedStepper:
         cold = not self._warm_sched.is_warm(self._variant_key(q, compact))
         t_dispatch0 = _time.perf_counter()
         step_fn = self._step_fn()
-        self._state, self.kin.params, out = step_fn(
-            self._state,
-            self.kin.params,
-            self._kernels_dev,
-            self._perm_dev,
-            self._degrad_dev,
-            self._mol_idx_dev,
-            self._kill_below_dev,
-            self._divide_above_dev,
-            self._divide_cost_dev,
-            dev_budget,
-            spawn_dense,
-            spawn_valid,
-            push_dense,
-            push_rows,
-            self._tables(),
-            self._abs_temp_dev,
-            det=self.world.deterministic,
-            max_div=self.max_divisions,
-            n_rounds=self.n_rounds,
-            compact=compact,
-            q=q,
-            use_pallas=self.world.use_pallas,
+
+        def _dispatch():
+            # armed chaos faults fire BEFORE any buffer is touched, so a
+            # retried dispatch re-sends bit-identical inputs
+            if self._fault_dispatch > 0:
+                from magicsoup_tpu.guard.faults import consume_dispatch_fault
+
+                consume_dispatch_fault(self)
+            return step_fn(
+                self._state,
+                self.kin.params,
+                self._kernels_dev,
+                self._perm_dev,
+                self._degrad_dev,
+                self._mol_idx_dev,
+                self._kill_below_dev,
+                self._divide_above_dev,
+                self._divide_cost_dev,
+                dev_budget,
+                spawn_dense,
+                spawn_valid,
+                push_dense,
+                push_rows,
+                self._tables(),
+                self._abs_temp_dev,
+                det=self.world.deterministic,
+                max_div=self.max_divisions,
+                n_rounds=self.n_rounds,
+                compact=compact,
+                q=q,
+                use_pallas=self.world.use_pallas,
+            )
+
+        self._state, self.kin.params, out = self._dispatch_with_retry(
+            _dispatch
         )
         t_dispatched = _time.perf_counter()
         self._note_warm(q, compact)
@@ -1507,6 +1645,10 @@ class PipelinedStepper:
         off += nw_s
         spawn_pos = arr[off : off + 2 * sb].reshape(sb, 2)
         off += 2 * sb
+        # graftguard: per-row bad-concentration bitmask (same width and
+        # row space as the kill lane)
+        bad_cells = _unpack_bits(arr[off : off + nw_k], self._cap)
+        off += nw_k
         # mesh runs append n_tiles per-tile occupancy lanes at the TAIL
         # (single-device record layout is byte-identical to before)
         tile_occ = (
@@ -1532,6 +1674,8 @@ class PipelinedStepper:
             mm_mass=float(masses[0]),
             cm_mass=float(masses[1]),
             tile_occupancy=tile_occ,
+            health=int(arr[8]),
+            bad_cells=bad_cells,
         )
 
     def _drain(self, block: bool) -> None:
@@ -1557,10 +1701,33 @@ class PipelinedStepper:
         t0 = _time.perf_counter()
         # the ONE fetch per dispatch — usually already pulled by the
         # background worker; a megastep's k per-step records arrive
-        # stacked in this single (k, record) buffer.  The (generous)
-        # timeout makes a dead worker or wedged tunnel surface as an
-        # exception here instead of a silent hang
-        arr = np.atleast_2d(np.asarray(pend.out.result(timeout=300.0)))
+        # stacked in this single (k, record) buffer.  The watchdog
+        # budget makes a dead worker or wedged tunnel surface as stack
+        # dumps + a typed error instead of a silent hang
+        try:
+            arr = np.atleast_2d(
+                np.asarray(pend.out.result(timeout=self._fetch_timeout))
+            )
+        except TimeoutError as exc:
+            from magicsoup_tpu.guard.errors import WatchdogTimeout
+            from magicsoup_tpu.guard.watchdog import dump_diagnostics
+
+            dump_diagnostics(
+                "stepper fetch timed out",
+                {
+                    "phase": "fetch",
+                    "timeout_s": self._fetch_timeout,
+                    "pending": len(self._pending),
+                    "replayed": self.stats["replayed"],
+                },
+            )
+            raise WatchdogTimeout(
+                f"step-record fetch exceeded {self._fetch_timeout:.0f}s "
+                "(wedged transfer or dead fetch worker); diagnostics "
+                "dumped to stderr",
+                phase="fetch",
+                seconds=self._fetch_timeout,
+            ) from exc
         dt_fetch = _time.perf_counter() - t0
         self._fetch_acc += dt_fetch
         self.telemetry.note("fetch", dt_fetch)
@@ -1578,6 +1745,52 @@ class PipelinedStepper:
             )
         self.telemetry.note("replay", _time.perf_counter() - t1)
 
+    def _handle_sentinel(self, out: StepOutputs) -> None:
+        """Host-side policy over a tripped health flag word (the device
+        lanes are unconditional; ONLY this reaction differs by policy)."""
+        from magicsoup_tpu.guard.errors import SentinelTripped
+        from magicsoup_tpu.guard.sentinel import decode_health
+
+        flags = decode_health(out.health)
+        n_bad = (
+            int(out.bad_cells.sum()) if out.bad_cells is not None else 0
+        )
+        step = self.stats["replayed"]
+        self.stats["sentinel_trips"] += 1
+        names = ", ".join(k for k, v in flags.items() if v)
+        if self.telemetry.attached:
+            self.telemetry.emit(
+                {
+                    "type": "sentinel",
+                    "step": step,
+                    "flags": int(out.health),
+                    "n_bad_cells": n_bad,
+                    "policy": self.sentinel_policy,
+                    **flags,
+                }
+            )
+        if self.sentinel_policy == "rollback":
+            raise SentinelTripped(
+                f"health sentinel tripped at replayed step {step}: "
+                f"{names} ({n_bad} bad cells) — restore the last good "
+                "checkpoint",
+                flags=out.health,
+                step=step,
+                n_bad_cells=n_bad,
+            )
+        if self.sentinel_policy == "quarantine":
+            self._quarantine_pending = True
+        elif not self._sentinel_warned:
+            self._sentinel_warned = True
+            import warnings
+
+            warnings.warn(
+                f"health sentinel tripped at replayed step {step}: "
+                f"{names} ({n_bad} bad cells); policy=warn — counting "
+                "trips in stats['sentinel_trips'] (further trips warn "
+                "only via telemetry)"
+            )
+
     def _replay_record(
         self,
         out: StepOutputs,
@@ -1592,6 +1805,8 @@ class PipelinedStepper:
         # the previous record's evolution must land before anything here
         # touches genomes, positions or the push queues
         self._join_evolution()
+        if out.health:
+            self._handle_sentinel(out)
         kill = out.kill
         parents = out.parents
         n_placed = out.n_placed

@@ -275,10 +275,26 @@ class TelemetryRecorder:
             return
         self.emit({"type": "counters", "counters": runtime_counters()})
 
-    def flush(self) -> None:
-        """Write buffered rows through to disk."""
+    def flush(self, sync: bool = False) -> None:
+        """Write buffered rows through to disk.  Idempotent and safe
+        whether attached or not — shutdown paths (graceful-preemption
+        handlers, the weakref finalizer) call it unconditionally.
+
+        ``sync=True`` additionally fsyncs the file so the rows survive
+        a power cut / SIGKILL that lands right after — the graceful
+        SIGTERM drain uses this for its final telemetry flush.
+        """
         with self._lock:
             self._flush_locked()
+            if sync and self._fh is not None:
+                import os
+
+                try:
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError):
+                    # not a real file (tests pass StringIO) or already
+                    # closed — durability is best-effort on teardown
+                    pass
 
     def _flush_locked(self) -> None:
         if self._fh is None or not self._buffer:

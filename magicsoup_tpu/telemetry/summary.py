@@ -196,6 +196,12 @@ def validate_rows(rows: list[dict]) -> list[str]:
         elif kind == "counters":
             if not isinstance(row.get("counters"), dict):
                 problems.append(f"{where}: counters row missing 'counters'")
+        elif kind == "sentinel":
+            # graftguard health-sentinel trip (stepper._handle_sentinel)
+            if not isinstance(row.get("flags"), int) or "step" not in row:
+                problems.append(
+                    f"{where}: sentinel row missing 'flags'/'step'"
+                )
         elif kind != "meta":
             problems.append(f"{where}: unknown row type {kind!r}")
     return problems

@@ -1645,11 +1645,16 @@ class World:
         Write the whole world object (chemistry, genetics, kinetics, state)
         to a pickle file; restore with :meth:`from_file`.  For small
         per-step snapshots use :meth:`save_state`.
+
+        The write is atomic (temp file + fsync + ``os.replace``, see
+        :mod:`magicsoup_tpu.guard.io`): a crash mid-save leaves the
+        previous ``world.pkl`` intact instead of a truncated ruin.  For
+        verified, retained, resume-complete checkpoints use
+        :func:`magicsoup_tpu.guard.save_run`.
         """
-        rundir = Path(rundir)
-        rundir.mkdir(parents=True, exist_ok=True)
-        with open(rundir / name, "wb") as fh:
-            pickle.dump(self, fh)
+        from magicsoup_tpu.guard.io import atomic_write_bytes
+
+        atomic_write_bytes(Path(rundir) / name, pickle.dumps(self))
 
     @classmethod
     def from_file(
@@ -1662,19 +1667,33 @@ class World:
         the restored state (same semantics as the constructor kwarg)."""
         import warnings
 
-        with open(Path(rundir) / name, "rb") as fh:
-            if device is None:
-                obj: "World" = pickle.load(fh)
-            else:
-                # the caller overrides the placement anyway — the saved
-                # device being unavailable here is expected, not warning-
-                # worthy (the duplicate placement below is one-time load
-                # cost)
-                with warnings.catch_warnings():
-                    warnings.filterwarnings(
-                        "ignore", message="restored world requested device"
-                    )
-                    obj = pickle.load(fh)
+        path = Path(rundir) / name
+        try:
+            with open(path, "rb") as fh:
+                if device is None:
+                    obj: "World" = pickle.load(fh)
+                else:
+                    # the caller overrides the placement anyway — the saved
+                    # device being unavailable here is expected, not warning-
+                    # worthy (the duplicate placement below is one-time load
+                    # cost)
+                    with warnings.catch_warnings():
+                        warnings.filterwarnings(
+                            "ignore", message="restored world requested device"
+                        )
+                        obj = pickle.load(fh)
+        except (EOFError, pickle.UnpicklingError) as exc:
+            # a truncated/garbled pickle (pre-atomic saves could leave one
+            # after a crash) surfaces as the typed guard error, not a bare
+            # EOFError deep inside pickle
+            from magicsoup_tpu.guard.errors import CheckpointError
+
+            raise CheckpointError(
+                f"world pickle {path} is truncated or corrupt ({exc}); "
+                "recover from an older snapshot or a guard checkpoint",
+                check="truncated",
+                path=path,
+            ) from exc
         if device is not None:
             obj.device = device
             obj._device = _resolve_device(device)
@@ -1694,15 +1713,28 @@ class World:
         Lightweight per-step checkpoint: the mutable tensors as ``.npy``
         files plus a FASTA of genomes/labels (reference world.py:795-822).
         """
+        import io as _io
+
+        from magicsoup_tpu.guard.io import atomic_write_bytes, atomic_write_text
+
+        def _atomic_np_save(path: Path, arr: np.ndarray) -> None:
+            buf = _io.BytesIO()
+            np.save(buf, arr)
+            atomic_write_bytes(path, buf.getvalue())
+
         statedir = Path(statedir)
         statedir.mkdir(parents=True, exist_ok=True)
         n = self.n_cells
-        np.save(statedir / "cell_molecules.npy", _fetch_host(self._cell_molecules)[:n])
-        np.save(statedir / "cell_map.npy", self._np_cell_map)
-        np.save(statedir / "molecule_map.npy", _fetch_host(self._molecule_map))
-        np.save(statedir / "cell_lifetimes.npy", self._np_lifetimes[:n])
-        np.save(statedir / "cell_positions.npy", self._np_positions[:n])
-        np.save(statedir / "cell_divisions.npy", self._np_divisions[:n])
+        _atomic_np_save(
+            statedir / "cell_molecules.npy", _fetch_host(self._cell_molecules)[:n]
+        )
+        _atomic_np_save(statedir / "cell_map.npy", self._np_cell_map)
+        _atomic_np_save(
+            statedir / "molecule_map.npy", _fetch_host(self._molecule_map)
+        )
+        _atomic_np_save(statedir / "cell_lifetimes.npy", self._np_lifetimes[:n])
+        _atomic_np_save(statedir / "cell_positions.npy", self._np_positions[:n])
+        _atomic_np_save(statedir / "cell_divisions.npy", self._np_divisions[:n])
 
         lines = [
             f">{idx} {label}\n{genome}"
@@ -1710,8 +1742,7 @@ class World:
                 zip(self.cell_genomes, self.cell_labels)
             )
         ]
-        with open(statedir / "cells.fasta", "w", encoding="utf-8") as fh:
-            fh.write("\n".join(lines))
+        atomic_write_text(statedir / "cells.fasta", "\n".join(lines))
 
     def load_state(self, statedir: Path, ignore_cell_params: bool = False):
         """

@@ -58,6 +58,7 @@ def main(args: Namespace) -> None:
     import numpy as np
 
     import magicsoup_tpu as ms
+    from magicsoup_tpu import guard
     from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
 
     sys.path.insert(0, str(_THIS_DIR))
@@ -98,44 +99,66 @@ def main(args: Namespace) -> None:
             genome_size=args.init_genome_size,
         )
 
-    for step_i in range(args.n_steps):
-        if step_i % 100 == 0:
-            if stepper is not None:
-                stepper.flush()
-            world.save_state(statedir=logdir / f"step={step_i}")
+    # graftguard: retained verified checkpoints at the same cadence as
+    # the state dumps, and a SIGTERM/SIGINT latch so a preemption notice
+    # drains the pipeline, flushes telemetry durably, and writes one
+    # final checkpoint instead of losing the interval
+    ckpt_mgr = guard.CheckpointManager(logdir / "checkpoints", keep=3)
 
-        with timeit("perStep", step_i):
-            if stepper is not None:
-                stepper.step()
-            else:
-                sim_step(
-                    world,
-                    rng,
-                    n_cells=args.n_cells,
-                    genome_size=args.init_genome_size,
-                    atp_idx=atp,
-                    timeit=lambda label: timeit(label, step_i),
+    with guard.GracefulShutdown() as stop:
+        for step_i in range(args.n_steps):
+            if stop:
+                print(
+                    f"graceful shutdown (signal {stop.signum}) at step"
+                    f" {step_i}: draining + final checkpoint"
                 )
+                break
+            if step_i % 100 == 0:
+                if stepper is not None:
+                    guard.save_run(ckpt_mgr, world, stepper, step=step_i)
+                world.save_state(statedir=logdir / f"step={step_i}")
 
-        # NOTE: the stepper's population trails the dispatched step by
-        # the pipeline depth; the scalar is tagged with the dispatch step
-        n_now = stepper.population if stepper is not None else world.n_cells
-        writer.add_scalar("Cells/total", n_now, step_i)
+            with timeit("perStep", step_i):
+                if stepper is not None:
+                    stepper.step()
+                else:
+                    sim_step(
+                        world,
+                        rng,
+                        n_cells=args.n_cells,
+                        genome_size=args.init_genome_size,
+                        atp_idx=atp,
+                        timeit=lambda label: timeit(label, step_i),
+                    )
 
-        if step_i % args.log_every == 0 and stepper is None:
-            molmap = np.asarray(world.molecule_map)
-            cellmols = world.cell_molecules
-            n_pxls = world.map_size**2
-            for mol_i, mol in enumerate(CHEMISTRY.molecules):
-                d = float(molmap[mol_i].sum())
-                n = n_pxls
-                if world.n_cells > 0:
-                    d += float(cellmols[:, mol_i].sum())
-                    n += world.n_cells
-                writer.add_scalar(f"Molecules/{mol.name}", d / n, step_i)
+            # NOTE: the stepper's population trails the dispatched step by
+            # the pipeline depth; the scalar is tagged with the dispatch step
+            n_now = (
+                stepper.population if stepper is not None else world.n_cells
+            )
+            writer.add_scalar("Cells/total", n_now, step_i)
 
+            if step_i % args.log_every == 0 and stepper is None:
+                molmap = np.asarray(world.molecule_map)
+                cellmols = world.cell_molecules
+                n_pxls = world.map_size**2
+                for mol_i, mol in enumerate(CHEMISTRY.molecules):
+                    d = float(molmap[mol_i].sum())
+                    n = n_pxls
+                    if world.n_cells > 0:
+                        d += float(cellmols[:, mol_i].sum())
+                        n += world.n_cells
+                    writer.add_scalar(
+                        f"Molecules/{mol.name}", d / n, step_i
+                    )
+
+    # epilogue runs on normal completion AND graceful shutdown: drain,
+    # final verified checkpoint, durable telemetry flush
     if stepper is not None:
-        stepper.flush()
+        guard.save_run(ckpt_mgr, world, stepper, meta={"final": True})
+    else:
+        guard.save_run(ckpt_mgr, world, meta={"final": True})
+    world.telemetry.flush(sync=True)
     writer.close()
     n = max(args.n_steps, 1)
     print(f"{args.n_steps} steps, final n_cells={world.n_cells}")

@@ -18,7 +18,16 @@ the file — this one also GATES (schema contract, not speed).
 
     python performance/smoke.py [--steps 6] [--megastep 2]
 
-scripts/test.sh runs this after the fast tier.
+``--chaos`` runs the graftguard fault-injection smoke instead (GATING):
+child processes in det mode are SIGKILLed mid-megastep and resumed from
+their crash-safe checkpoint (final state must be BIT-identical to an
+uninterrupted run), a checkpoint gets a byte flipped (typed rejection +
+retention fallback), a SIGTERM child must drain gracefully into a final
+checkpoint + flushed telemetry, and a NaN injection / failed dispatch
+must trip the health sentinel / bounded retry.  ``--chaos-child`` is the
+internal per-scenario entry point those subprocesses use.
+
+scripts/test.sh runs both after the fast tier.
 """
 import argparse
 import json
@@ -40,7 +49,22 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=6, help="measured dispatches")
     ap.add_argument("--megastep", type=int, default=2)
     ap.add_argument("--seed", type=int, default=7)
+    # graftguard chaos smoke (see chaos_main / chaos_child below)
+    ap.add_argument("--chaos", action="store_true")
+    ap.add_argument(
+        "--chaos-child",
+        choices=("run", "resume", "sigterm", "faults"),
+        default=None,
+    )
+    ap.add_argument("--chaos-dir", default="")
+    ap.add_argument("--total", type=int, default=6, help="chaos dispatches")
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--kill-after", type=int, default=0)
     args = ap.parse_args()
+    if args.chaos_child:
+        return chaos_child(args)
+    if args.chaos:
+        return chaos_main(args)
 
     import jax
 
@@ -201,6 +225,460 @@ def main() -> None:
         raise SystemExit(
             "telemetry smoke FAILED: " + "; ".join(problems)
         )
+
+
+# --------------------------------------------------------------- chaos
+def _chaos_setup(args):
+    """Deterministic tiny world for the chaos children (fixed seed)."""
+    import random
+
+    import magicsoup_tpu as ms
+
+    mols = [
+        ms.Molecule("chs-a", 10e3),
+        ms.Molecule("chs-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(args.seed)
+    world = ms.World(chemistry=chem, map_size=args.map_size, seed=args.seed)
+    world.spawn_cells(
+        [
+            ms.random_genome(s=args.genome_size, rng=rng)
+            for _ in range(args.n_cells)
+        ]
+    )
+    return world
+
+
+def _chaos_stepper(world, args, **overrides):
+    """Stepper with the smoke's default dynamics — every child (and the
+    resume path, whose config must MATCH the checkpoint) builds through
+    here so the kwargs cannot drift apart."""
+    import magicsoup_tpu as ms
+
+    kw = dict(
+        mol_name="chs-atp",
+        kill_below=0.1,
+        divide_above=3.0,
+        divide_cost=1.0,
+        target_cells=args.n_cells,
+        genome_size=args.genome_size,
+        lag=1,
+        megastep=args.megastep,
+    )
+    kw.update(overrides)
+    return ms.PipelinedStepper(world, **kw)
+
+
+def _chaos_digest(world, st) -> str:
+    """sha256 over the full resume-relevant state (flushes first).
+
+    Canonically ordered and built from public accessors on both sides —
+    an unpickled world's ``__dict__`` insertion order can differ from a
+    constructed one's, so hashing ``pickle(world)`` directly would flake.
+    Each field is hashed SEPARATELY and the digests combined in sorted
+    key order: pickling the fields together would let pickle's memo
+    turn cross-field object aliasing (a live run shares string objects
+    between e.g. genomes and the spawn queue; a restored run holds
+    equal-but-distinct copies) into back-references, changing the bytes
+    while every value is identical.  Wall-clock stats (``*_ms``) are
+    excluded; every trajectory-bearing piece (arrays, genomes, all PRNG
+    streams, device key, schedule state) is included.
+    """
+    import hashlib
+    import pickle
+
+    import numpy as np
+
+    from magicsoup_tpu import guard
+
+    snap = guard.snapshot_run(world, st)
+    aux = snap["stepper"]
+    state = dict(
+        n_cells=world.n_cells,
+        genomes=list(world.cell_genomes),
+        labels=list(world.cell_labels),
+        mm=np.asarray(world.molecule_map),
+        cm=np.asarray(world.cell_molecules),
+        positions=np.asarray(world.cell_positions),
+        lifetimes=np.asarray(world.cell_lifetimes),
+        divisions=np.asarray(world.cell_divisions),
+        world_rng=snap["world_rng_state"],
+        world_nprng=snap["world_nprng_state"],
+        key=np.asarray(aux["key"]),
+        stepper_rng=aux["rng_state"],
+        spawn_queue=aux["spawn_queue"],
+        growth_hist=aux["growth_hist"],
+        change_seq=aux["change_seq"],
+        dispatched_seq=aux["dispatched_seq"],
+    )
+    digest = hashlib.sha256()
+    for name in sorted(state):
+        digest.update(name.encode())
+        digest.update(hashlib.sha256(pickle.dumps(state[name])).digest())
+    return digest.hexdigest()
+
+
+def chaos_child(args) -> None:
+    """One fault-injection scenario, isolated in its own process.
+
+    Modes: ``run`` steps ``--total`` dispatches with checkpoints every
+    ``--ckpt-every`` and prints a state digest (with ``--kill-after N``
+    it instead announces its Nth checkpoint and keeps dispatching until
+    the parent SIGKILLs it mid-flight); ``resume`` restores the newest
+    checkpoint and finishes the same schedule; ``sigterm`` steps until
+    the parent's SIGTERM, then drains into a final checkpoint + synced
+    telemetry; ``faults`` trips the dispatch retry and the NaN health
+    sentinel in-process.
+    """
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+    from magicsoup_tpu import guard
+
+    out_dir = Path(args.chaos_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    mgr = guard.CheckpointManager(out_dir / "ckpt", keep=3)
+    mode = args.chaos_child
+
+    if mode == "run":
+        world = _chaos_setup(args)
+        st = _chaos_stepper(world, args)
+        written = 0
+        for i in range(args.total):
+            if i % args.ckpt_every == 0 and i > 0:
+                guard.save_run(mgr, world, st, step=i)
+                written += 1
+                if args.kill_after and written >= args.kill_after:
+                    # tell the parent the checkpoint landed, then keep
+                    # dispatching until the SIGKILL arrives mid-flight
+                    print(
+                        json.dumps({"marker": "checkpointed", "step": i}),
+                        flush=True,
+                    )
+                    for _ in range(1000):
+                        st.step()
+                    raise SystemExit(3)  # the parent failed to kill us
+            st.step()
+        print(
+            json.dumps(
+                {"digest": _chaos_digest(world, st), "steps": args.total}
+            ),
+            flush=True,
+        )
+
+    elif mode == "resume":
+        world, aux, meta = guard.restore_run(mgr)
+        st = _chaos_stepper(world, args)
+        guard.restore_stepper(st, aux)
+        start = int(meta["step"])
+        for i in range(start, args.total):
+            # i == start is the checkpoint itself — already saved (and
+            # flushed) by the killed run, so don't re-save it here
+            if i % args.ckpt_every == 0 and i > start:
+                guard.save_run(mgr, world, st, step=i)
+            st.step()
+        print(
+            json.dumps(
+                {"digest": _chaos_digest(world, st), "from_step": start}
+            ),
+            flush=True,
+        )
+
+    elif mode == "sigterm":
+        world = _chaos_setup(args)
+        world.telemetry.attach(out_dir / "telemetry.jsonl")
+        st = _chaos_stepper(world, args)
+        with guard.GracefulShutdown() as stop:
+            print(json.dumps({"marker": "ready"}), flush=True)
+            for _ in range(5000):
+                if stop:
+                    break
+                st.step()
+                time.sleep(0.02)  # window for the signal between dispatches
+        path = guard.save_run(
+            mgr, world, st, meta={"final": True, "signal": stop.signum}
+        )
+        world.telemetry.flush(sync=True)
+        print(
+            json.dumps({"graceful": bool(stop), "checkpoint": str(path)}),
+            flush=True,
+        )
+
+    elif mode == "faults":
+        world = _chaos_setup(args)
+        st = _chaos_stepper(
+            world,
+            args,
+            kill_below=-1.0,
+            divide_above=1e30,
+            divide_cost=0.0,
+            target_cells=None,
+            p_mutation=0.0,
+            p_recombination=0.0,
+            sentinel_policy="warn",
+            dispatch_retries=2,
+        )
+        for _ in range(2):
+            st.step()
+        st.drain()
+        guard.inject_dispatch_failures(st, 1)
+        st.step()  # transient failure absorbed by the bounded retry
+        st.drain()
+        retries = int(st.stats["dispatch_retries"])
+        guard.inject_nan(st)  # NaN in a live cell's concentrations
+        st.step()
+        st.drain()
+        st.flush()
+        trips = int(st.stats["sentinel_trips"])
+        print(
+            json.dumps(
+                {"dispatch_retries": retries, "sentinel_trips": trips}
+            ),
+            flush=True,
+        )
+        if retries < 1 or trips < 1:
+            raise SystemExit(
+                f"chaos faults child FAILED: retries={retries} trips={trips}"
+            )
+
+
+def chaos_main(args) -> None:
+    """Orchestrate the chaos children and GATE on their invariants."""
+    import os
+    import signal
+
+    base = Path(tempfile.mkdtemp(prefix="msoup-chaos-"))
+    env = dict(os.environ)
+    env["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # one SHARED persistent compile cache, warmed by a throwaway child
+    # first: a cache-loaded XLA:CPU executable can differ numerically
+    # from a freshly-compiled one (see tests/conftest.py), so the
+    # digest-bearing children must all LOAD the same warm entries
+    env["MAGICSOUP_COMPILE_CACHE_DIR"] = str(base / "xla-cache")
+    script = str(Path(__file__).resolve())
+    problems: list[str] = []
+
+    def _cmd(mode, subdir, *extra):
+        return [
+            sys.executable,
+            script,
+            "--chaos-child",
+            mode,
+            "--chaos-dir",
+            str(base / subdir),
+            "--total",
+            str(args.total),
+            "--ckpt-every",
+            str(args.ckpt_every),
+            "--megastep",
+            str(args.megastep),
+            "--seed",
+            str(args.seed),
+            "--n-cells",
+            str(args.n_cells),
+            "--map-size",
+            str(args.map_size),
+            "--genome-size",
+            str(args.genome_size),
+            *extra,
+        ]
+
+    def _json_lines(text):
+        rows = []
+        for line in (text or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return rows
+
+    # -- warm the shared compile cache (digest discarded on purpose)
+    warm = subprocess.run(
+        _cmd("run", "warmup"), env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    if warm.returncode != 0:
+        raise SystemExit(
+            f"chaos smoke FAILED: warmup child rc={warm.returncode}\n"
+            + warm.stderr[-2000:]
+        )
+
+    # -- baseline: uninterrupted det run, digest of the final state
+    ref = subprocess.run(
+        _cmd("run", "a"), env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    ref_rows = [r for r in _json_lines(ref.stdout) if "digest" in r]
+    if ref.returncode != 0 or not ref_rows:
+        raise SystemExit(
+            f"chaos smoke FAILED: baseline child rc={ref.returncode}\n"
+            + ref.stderr[-2000:]
+        )
+    digest_a = ref_rows[-1]["digest"]
+
+    # -- victim: SIGKILL mid-megastep right after its 2nd checkpoint
+    victim = subprocess.Popen(
+        _cmd("run", "b", "--kill-after", "2"),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    marker = None
+    for line in victim.stdout:
+        line = line.strip()
+        if line.startswith("{") and "checkpointed" in line:
+            marker = json.loads(line)
+            break
+    if marker is None:
+        victim.kill()
+        victim.wait(timeout=60)
+        problems.append("victim child exited before its checkpoint marker")
+    else:
+        victim.send_signal(signal.SIGKILL)
+        rc = victim.wait(timeout=60)
+        if rc != -signal.SIGKILL:
+            problems.append(f"victim child rc={rc}, expected -SIGKILL")
+    victim.stdout.close()
+
+    # -- resume: restore the victim's checkpoint, finish the schedule
+    digest_b = None
+    if marker is not None:
+        res = subprocess.run(
+            _cmd("resume", "b"), env=env, capture_output=True, text=True,
+            timeout=900,
+        )
+        rows = [r for r in _json_lines(res.stdout) if "digest" in r]
+        if res.returncode != 0 or not rows:
+            problems.append(
+                f"resume child rc={res.returncode}: {res.stderr[-500:]}"
+            )
+        else:
+            digest_b = rows[-1]["digest"]
+            if rows[-1].get("from_step") != marker["step"]:
+                problems.append(
+                    f"resumed from step {rows[-1].get('from_step')}, "
+                    f"victim checkpointed at {marker['step']}"
+                )
+            if digest_b != digest_a:
+                problems.append(
+                    "kill/resume digest mismatch: "
+                    f"{digest_a[:16]} != {digest_b[:16]}"
+                )
+
+    # -- corruption: flip a byte in the newest checkpoint -> typed
+    # rejection, and the manager falls back to the previous snapshot
+    import warnings
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu import guard
+    from magicsoup_tpu.guard import CheckpointError
+
+    mgr = guard.CheckpointManager(base / "b" / "ckpt", keep=3)
+    ckpts = [path for _step, path in mgr.checkpoints()]
+    if len(ckpts) < 2:
+        problems.append(f"expected >=2 retained checkpoints, got {len(ckpts)}")
+    else:
+        guard.flip_byte(ckpts[-1])
+        try:
+            guard.read_checkpoint(ckpts[-1])
+            problems.append("corrupted checkpoint was accepted")
+        except CheckpointError as e:
+            if e.check not in ("magic", "header", "truncated", "digest"):
+                problems.append(
+                    f"corruption rejected with unexpected check={e.check!r}"
+                )
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                payload, _meta, used = mgr.load_latest()
+            if Path(used) == Path(ckpts[-1]):
+                problems.append("load_latest returned the corrupted file")
+            if not (isinstance(payload, dict) and "world" in payload):
+                problems.append("fallback checkpoint payload malformed")
+        except CheckpointError as e:
+            problems.append(f"load_latest fallback failed: {e}")
+
+    # -- SIGTERM: graceful drain -> final checkpoint + synced telemetry
+    sig = subprocess.Popen(
+        _cmd("sigterm", "s"),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    for line in sig.stdout:
+        if "ready" in line:
+            break
+    time.sleep(0.5)  # let it enter the stepping loop proper
+    sig.send_signal(signal.SIGTERM)
+    try:
+        rest, _ = sig.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        sig.kill()
+        rest, _ = sig.communicate()
+    sig_rows = [r for r in _json_lines(rest) if "graceful" in r]
+    if sig.returncode != 0 or not sig_rows or not sig_rows[-1]["graceful"]:
+        problems.append(
+            f"sigterm child rc={sig.returncode}, "
+            f"graceful={sig_rows[-1]['graceful'] if sig_rows else None}"
+        )
+    else:
+        _payload, meta_s = guard.read_checkpoint(
+            Path(sig_rows[-1]["checkpoint"])
+        )
+        if not meta_s.get("final"):
+            problems.append("sigterm final checkpoint lacks final=True meta")
+    tel_path = base / "s" / "telemetry.jsonl"
+    if tel_path.exists():
+        from magicsoup_tpu.telemetry import read_jsonl, validate_rows
+
+        problems += [
+            f"sigterm telemetry: {p}"
+            for p in validate_rows(read_jsonl(tel_path))
+        ]
+    else:
+        problems.append("sigterm child left no telemetry.jsonl")
+
+    # -- faults: NaN sentinel trip + transient-dispatch bounded retry
+    flt = subprocess.run(
+        _cmd("faults", "f"), env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    flt_rows = [r for r in _json_lines(flt.stdout) if "sentinel_trips" in r]
+    if flt.returncode != 0 or not flt_rows:
+        problems.append(
+            f"faults child rc={flt.returncode}: {flt.stderr[-500:]}"
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": "chaos smoke (graftguard kill/resume, cpu)",
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "digest": digest_a,
+                "resumed_from": marker["step"] if marker else None,
+                "faults": flt_rows[-1] if flt_rows else None,
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("chaos smoke FAILED: " + "; ".join(problems))
 
 
 if __name__ == "__main__":

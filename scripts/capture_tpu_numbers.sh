@@ -37,9 +37,26 @@ export MAGICSOUP_BENCH_ATTEMPT_TIMEOUT="${MAGICSOUP_BENCH_ATTEMPT_TIMEOUT:-600}"
 # timeout-kill must not erase numbers a harness already printed
 export PYTHONUNBUFFERED=1
 
+# Hard wall-clock watchdog around the probe: the documented hang mode is
+# a jax.devices() that wedges inside the C++ client, which a plain
+# `timeout` SIGTERM cannot always kill — `-k 10` escalates to SIGKILL.
+# A failed/hung probe leaves a structured JSON record in the capture dir
+# (the {"value": 0.0, "error": ...} shape summarize_capture.py already
+# skips) so the published summary names WHY the window died instead of
+# silently missing rows.
 probe() {
-    timeout 120 python -c "import jax; print(jax.devices())" \
+    timeout -k 10 120 python -c "import jax; print(jax.devices())" \
         >>"$OUT/capture.log" 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        reason="probe exited rc=$rc"
+        if [ "$rc" -ge 124 ]; then
+            reason="probe hung past 120s watchdog (rc=$rc)"
+        fi
+        printf '{"metric": "backend probe", "value": 0.0, "error": "%s"}\n' \
+            "$reason" >>"$OUT/probe.log"
+    fi
+    return $rc
 }
 
 echo "== backend probe" | tee "$OUT/capture.log"

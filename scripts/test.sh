@@ -24,3 +24,10 @@ python performance/smoke.py
 # exits nonzero on any byte difference
 python performance/mesh_sweep.py --check --devices 2 \
     --n-cells 24 --map-size 16 --genome-size 200 --steps 4
+# graftguard chaos smoke (GATING): SIGKILL a det-mode child mid-megastep
+# and resume it from its crash-safe checkpoint — the final state must be
+# BIT-identical to the uninterrupted run; also flips checkpoint bytes
+# (typed rejection + retention fallback), SIGTERMs a child (graceful
+# drain -> final checkpoint + flushed telemetry), and trips the NaN
+# sentinel / transient-dispatch retry.  Exits nonzero on any violation.
+python performance/smoke.py --chaos

@@ -53,6 +53,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl007_tolist_loop.py", "GL007"),
         ("gl008_io_callback.py", "GL008"),
         ("gl009_unplaced.py", "GL009"),
+        ("gl010_unsafe_save.py", "GL010"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -117,6 +118,36 @@ def test_gl009_waivable_like_the_other_rules(tmp_path):
     p = tmp_path / "gl009_waived.py"
     p.write_text(waived)
     assert analyze([p]) == []
+
+
+def test_gl010_waivable_like_the_other_rules(tmp_path):
+    # the guard package's fault injector corrupts files on purpose with
+    # a raw write; pin that the standard annotation covers GL010
+    src = (FIXTURES / "gl010_unsafe_save.py").read_text()
+    waived = src.replace(
+        "# GL010: non-atomic state persistence",
+        "# graftlint: disable=GL010 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl010_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl010_write_form_detected(tmp_path):
+    # fh.write(pickle.dumps(obj)) is the same torn-write hazard spelled
+    # differently; atomic_write_bytes(path, pickle.dumps(obj)) is not
+    p = tmp_path / "gl010_write_form.py"
+    p.write_text(
+        "import pickle\n"
+        "def save(obj, path, atomic_write_bytes):\n"
+        "    with open(path, 'wb') as fh:\n"
+        "        fh.write(pickle.dumps(obj))\n"
+        "    atomic_write_bytes(path, pickle.dumps(obj))\n"
+    )
+    findings = analyze([p], rules=["GL010"])
+    assert [f.rule for f in findings] == ["GL010"]
+    assert findings[0].line == 4
 
 
 def test_rules_filter_restricts_rule_set():

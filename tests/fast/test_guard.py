@@ -1,0 +1,450 @@
+"""
+graftguard tests (:mod:`magicsoup_tpu.guard`): crash-safe checkpoints,
+deterministic resume, health sentinels, and the fault injectors.
+
+THE acceptance contract (kill/resume bit-identity): in det mode,
+``[run K, checkpoint, run K]`` equals ``[run K, checkpoint, die,
+restore, run K]`` — byte-for-byte over the world arrays, genomes, every
+PRNG stream, and the device key — for the classic driver AND the
+pipelined stepper, single-device and mesh-placed.  The reference run
+checkpoints at the same boundary because a pipelined checkpoint IS a
+flush, and draining the pipeline mid-run is part of the deterministic
+schedule (it re-packs rows and applies in-flight phenotype pushes, so
+an unflushed run's float work is bracketed differently); the classic
+driver has no pipeline, so there ``[run 2K]`` vs ``[run K, checkpoint,
+die, restore, run K]`` holds outright.  "Die" is simulated in-process
+by discarding every live object and rebuilding from the checkpoint
+bytes alone (cross-process identity is exercised by the chaos smoke in
+``performance/smoke.py --chaos``; in-process keeps the comparison off
+the persistent-cache-vs-fresh-compile axis, see tests/conftest.py).
+"""
+import pickle
+import random
+import signal
+import threading
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import guard
+from magicsoup_tpu.guard import (
+    CheckpointError,
+    CheckpointManager,
+    SentinelTripped,
+    checkpoint as ckpt_mod,
+)
+from magicsoup_tpu.parallel import tiled
+from magicsoup_tpu.stepper import PipelinedStepper
+
+_MOLS = [
+    ms.Molecule("gg-a", 10e3),
+    ms.Molecule("gg-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+
+def _world(*, seed=5, map_size=16, n_cells=24, mesh=None):
+    world = ms.World(
+        chemistry=_CHEM, map_size=map_size, seed=seed, mesh=mesh
+    )
+    world.deterministic = True
+    rng = random.Random(seed)
+    world.spawn_cells(
+        [ms.random_genome(s=200, rng=rng) for _ in range(n_cells)]
+    )
+    return world
+
+
+def _stepper(world, **kwargs):
+    defaults = dict(
+        mol_name="gg-atp",
+        kill_below=0.1,
+        divide_above=3.0,
+        divide_cost=1.0,
+        target_cells=24,
+        genome_size=200,
+        lag=1,
+        p_mutation=1e-3,
+        p_recombination=1e-4,
+    )
+    defaults.update(kwargs)
+    return PipelinedStepper(world, **defaults)
+
+
+def _fingerprint(world, st=None) -> dict:
+    """Canonical resume-relevant state (flushes the stepper first)."""
+    snap = guard.snapshot_run(world, st)
+    n = world.n_cells
+    out = {
+        "n_cells": n,
+        "genomes": list(world.cell_genomes),
+        "mm": np.asarray(jax.device_get(world.molecule_map)),
+        "cm": np.asarray(world.cell_molecules)[:n],
+        "positions": np.asarray(world.cell_positions),
+        "lifetimes": np.asarray(world.cell_lifetimes),
+        "divisions": np.asarray(world.cell_divisions),
+        "world_rng": snap["world_rng_state"],
+        "world_nprng": repr(snap["world_nprng_state"]),
+    }
+    if st is not None:
+        aux = snap["stepper"]
+        out.update(
+            key=np.asarray(aux["key"]),
+            stepper_rng=repr(aux["rng_state"]),
+            spawn_queue=aux["spawn_queue"],
+            growth_hist=aux["growth_hist"],
+            change_seq=aux["change_seq"],
+        )
+    return out
+
+
+def _assert_identical(a: dict, b: dict):
+    assert a.keys() == b.keys()
+    for k in a:
+        if isinstance(a[k], np.ndarray):
+            assert a[k].tobytes() == b[k].tobytes(), f"{k} differs"
+        else:
+            assert a[k] == b[k], f"{k} differs"
+
+
+# ------------------------------------------------- checkpoint mechanics
+def test_checkpoint_roundtrip_and_inspect(tmp_path):
+    path = tmp_path / "x.msck"
+    guard.write_checkpoint(path, {"a": [1, 2]}, meta={"step": 3})
+    payload, meta = guard.read_checkpoint(path)
+    assert payload == {"a": [1, 2]}
+    assert meta["step"] == 3
+    info = guard.inspect_checkpoint(path)
+    assert info["schema"] == guard.SCHEMA_VERSION
+    assert info["meta"]["step"] == 3
+
+
+def test_corrupted_checkpoint_rejected_typed(tmp_path):
+    path = tmp_path / "x.msck"
+    guard.write_checkpoint(path, list(range(512)))
+    raw = path.read_bytes()
+
+    guard.flip_byte(path)  # payload byte -> digest mismatch
+    with pytest.raises(CheckpointError) as e:
+        guard.read_checkpoint(path)
+    assert e.value.check == "digest"
+
+    path.write_bytes(raw[: len(raw) // 2])  # torn write
+    with pytest.raises(CheckpointError) as e:
+        guard.read_checkpoint(path)
+    assert e.value.check == "truncated"
+
+    path.write_bytes(b"JUNK" + raw)  # not a checkpoint at all
+    with pytest.raises(CheckpointError) as e:
+        guard.read_checkpoint(path)
+    assert e.value.check == "magic"
+
+
+def test_schema_version_mismatch_rejected(tmp_path, monkeypatch):
+    path = tmp_path / "future.msck"
+    monkeypatch.setattr(ckpt_mod, "SCHEMA_VERSION", 999)
+    guard.write_checkpoint(path, {"from": "the future"})
+    monkeypatch.undo()
+    with pytest.raises(CheckpointError) as e:
+        guard.read_checkpoint(path)
+    assert e.value.check == "version"
+    assert "999" in str(e.value)
+
+
+def test_manager_retention_and_fallback(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=2)
+    for step in range(5):
+        mgr.save({"step": step}, step=step)
+    kept = mgr.checkpoints()
+    assert [s for s, _ in kept] == [3, 4]  # rolling retention pruned the rest
+    assert mgr.latest() == kept[-1][1]
+    payload, meta, used = mgr.load_latest()
+    assert payload == {"step": 4} and used == kept[-1][1]
+
+    guard.flip_byte(kept[-1][1])  # newest corrupt -> fall back, with warning
+    with pytest.warns(UserWarning, match="skipping"):
+        payload, meta, used = mgr.load_latest()
+    assert payload == {"step": 3} and used == kept[0][1]
+
+    guard.flip_byte(kept[0][1])  # nothing verifiable left -> typed error
+    with pytest.raises(CheckpointError) as e:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mgr.load_latest()
+    assert e.value.check == "none"
+
+
+def test_world_save_is_atomic_and_truncation_is_typed(tmp_path):
+    world = _world(n_cells=4)
+    world.save(tmp_path)
+    assert not list(tmp_path.glob(".*tmp*"))  # no temp litter
+    restored = ms.World.from_file(tmp_path)
+    assert restored.cell_genomes == world.cell_genomes
+
+    blob = (tmp_path / "world.pkl").read_bytes()
+    (tmp_path / "world.pkl").write_bytes(blob[: len(blob) // 3])
+    with pytest.raises(CheckpointError) as e:
+        ms.World.from_file(tmp_path)
+    assert e.value.check == "truncated"
+
+
+# ---------------------------------------------------------- det resume
+def _resume_roundtrip(world, st, mgr, *, mesh=None, megastep):
+    """Checkpoint, discard every live object, rebuild from bytes."""
+    guard.save_run(mgr, world, st, step=0)
+    del world, st
+    world2, aux, _meta = guard.restore_run(mgr, mesh=mesh)
+    st2 = _stepper(world2, megastep=megastep)
+    guard.restore_stepper(st2, aux)
+    return world2, st2
+
+
+@pytest.mark.parametrize("megastep", [1, 4])
+@pytest.mark.parametrize("tiles", [None, 2])
+def test_pipelined_kill_resume_bit_identity(megastep, tiles, tmp_path):
+    if tiles is not None and len(jax.devices()) < tiles:
+        pytest.skip("needs multiple (virtual) devices")
+    mesh = tiled.make_mesh(tiles) if tiles else None
+    K = 3
+
+    def fresh():
+        world = _world(mesh=mesh)
+        return world, _stepper(world, megastep=megastep)
+
+    # reference: checkpoints at K like the victim (the checkpoint's
+    # flush is part of the det schedule), then continues uninterrupted
+    world_a, st_a = fresh()
+    for _ in range(K):
+        st_a.step()
+    guard.save_run(
+        CheckpointManager(tmp_path / "ref"), world_a, st_a, step=K
+    )
+    for _ in range(K):
+        st_a.step()
+    ref = _fingerprint(world_a, st_a)
+
+    # K dispatches, checkpoint at the same boundary, "die", restore
+    # from the checkpoint bytes alone, K more dispatches
+    world_b, st_b = fresh()
+    for _ in range(K):
+        st_b.step()
+    mgr = CheckpointManager(tmp_path / "b", keep=3)
+    world_b, st_b = _resume_roundtrip(
+        world_b, st_b, mgr, mesh=mesh, megastep=megastep
+    )
+    for _ in range(K):
+        st_b.step()
+    _assert_identical(ref, _fingerprint(world_b, st_b))
+    st_b.check_consistency()
+
+
+def test_classic_driver_kill_resume_bit_identity(tmp_path):
+    K = 3
+
+    def drive(world, steps):
+        for _ in range(steps):
+            world.enzymatic_activity()
+            cm = world.cell_molecules
+            world.kill_cells(np.nonzero(cm[:, 1] < 0.05)[0].tolist())
+            world.mutate_cells(p=1e-3)
+            world.degrade_molecules()
+            world.diffuse_molecules()
+            world.increment_cell_lifetimes()
+
+    world_a = _world(seed=13)
+    drive(world_a, 2 * K)
+    ref = _fingerprint(world_a)
+
+    world_b = _world(seed=13)
+    drive(world_b, K)
+    mgr = CheckpointManager(tmp_path, keep=2)
+    guard.save_run(mgr, world_b, step=K)
+    del world_b
+    world_b, aux, meta = guard.restore_run(mgr)
+    assert aux is None and meta["step"] == K  # classic: no stepper aux
+    drive(world_b, K)
+    _assert_identical(ref, _fingerprint(world_b))
+
+
+def test_restore_refuses_config_mismatch(tmp_path):
+    world = _world()
+    st = _stepper(world, megastep=2)
+    st.step()
+    mgr = CheckpointManager(tmp_path)
+    guard.save_run(mgr, world, st)
+    world2, aux, _ = guard.restore_run(mgr)
+    other = _stepper(world2, megastep=4)  # trajectory-determining knob
+    with pytest.raises(CheckpointError, match="megastep") as e:
+        guard.restore_stepper(other, aux)
+    assert e.value.check == "config"
+
+
+# ----------------------------------------------------- health sentinels
+def test_sentinel_policy_does_not_change_trajectory():
+    # the sentinel lanes are computed UNCONDITIONALLY on device; the
+    # policy only decides what the host does on a trip — so a clean
+    # det run must be bit-identical whichever policy is armed
+    def run(policy):
+        world = _world(seed=21)
+        st = _stepper(world, sentinel_policy=policy)
+        for _ in range(4):
+            st.step()
+        return _fingerprint(world, st)
+
+    _assert_identical(run("warn"), run("rollback"))
+
+
+def test_sentinel_nan_warn_policy_counts_and_warns():
+    world = _world()
+    st = _stepper(
+        world,
+        kill_below=-1.0,
+        divide_above=1e30,
+        target_cells=None,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        sentinel_policy="warn",
+    )
+    st.step()
+    st.drain()
+    assert st.stats["sentinel_trips"] == 0
+    guard.inject_nan(st)
+    with pytest.warns(UserWarning, match="sentinel"):
+        st.step()
+        st.drain()
+    assert st.stats["sentinel_trips"] >= 1
+    flags = guard.decode_health(0b0100)
+    assert flags["cm_nonfinite"] is True
+    st.flush()
+
+
+def test_sentinel_rollback_policy_raises_typed():
+    world = _world()
+    st = _stepper(
+        world,
+        kill_below=-1.0,
+        divide_above=1e30,
+        target_cells=None,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        sentinel_policy="rollback",
+    )
+    st.step()
+    st.drain()
+    guard.inject_nan(st)
+    with pytest.raises(SentinelTripped) as e:
+        for _ in range(4):  # pipelined: the trip surfaces on replay
+            st.step()
+        st.drain()
+    assert e.value.flags != 0 and e.value.n_bad_cells >= 1
+
+
+def test_sentinel_quarantine_policy_kills_poisoned_cells():
+    world = _world()
+    st = _stepper(
+        world,
+        kill_below=-1.0,
+        divide_above=1e30,
+        target_cells=None,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        sentinel_policy="quarantine",
+    )
+    st.step()
+    st.drain()
+    n_before = world.n_cells
+    guard.inject_nan(st)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        st.step()
+        st.drain()  # replay sees the trip -> quarantine pending
+        st.step()  # quarantine applies at the next dispatch boundary
+    st.flush()
+    assert st.stats["quarantined"] >= 1
+    assert world.n_cells < n_before
+    assert np.isfinite(np.asarray(world.cell_molecules)[: world.n_cells]).all()
+    assert np.isfinite(np.asarray(jax.device_get(world.molecule_map))).all()
+
+
+def test_invalid_sentinel_policy_rejected():
+    world = _world(n_cells=4)
+    with pytest.raises(ValueError, match="sentinel_policy"):
+        _stepper(world, sentinel_policy="explode")
+
+
+# ------------------------------------------------- faults, retry, signals
+def test_dispatch_retry_absorbs_transient_fault():
+    world = _world()
+    st = _stepper(world, dispatch_retries=2)
+    st.step()
+    st.drain()
+    guard.inject_dispatch_failures(st, n=1)
+    st.step()  # transient failure -> bounded retry, not a crash
+    st.drain()
+    st.flush()
+    assert st.stats["dispatch_retries"] == 1
+
+
+def test_retry_call_backoff_and_nontransient_passthrough():
+    calls = {"n": 0}
+    delays = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise guard.TransientDispatchError()
+        return "ok"
+
+    assert (
+        guard.retry_call(flaky, retries=3, sleep=delays.append) == "ok"
+    )
+    assert calls["n"] == 3
+    assert delays == [0.5, 1.0]  # exponential backoff
+
+    def broken():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        guard.retry_call(broken, retries=5, sleep=delays.append)
+
+
+def test_graceful_shutdown_latches_signal():
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal handlers need the main thread")
+    before = signal.getsignal(signal.SIGTERM)
+    with guard.GracefulShutdown() as stop:
+        assert not stop
+        signal.raise_signal(signal.SIGTERM)
+        assert stop and stop.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before  # restored
+
+
+def test_watchdog_fires_diagnostics_once():
+    fired = []
+    wd = guard.Watchdog(
+        0.05, tag="t", on_timeout=lambda name, s: fired.append(name)
+    )
+    import time
+
+    with wd.phase("slow"):
+        time.sleep(0.2)
+    with wd.phase("fast"):
+        pass
+    assert fired == ["slow"] and wd.fired == 1
+
+
+def test_snapshot_survives_pickle_of_attached_telemetry(tmp_path):
+    # run_simulation checkpoints worlds whose telemetry recorder holds
+    # an open file handle; the pickle must drop it and resume must
+    # leave a working (detached) recorder behind
+    world = _world(n_cells=4)
+    world.telemetry.attach(tmp_path / "t.jsonl")
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    guard.save_run(mgr, world, step=0)
+    world2, _aux, _meta = guard.restore_run(mgr)
+    assert not world2.telemetry.attached
+    world2.telemetry.flush(sync=True)  # idempotent when detached
+    world.telemetry.flush(sync=True)

@@ -300,7 +300,9 @@ def test_record_layout_single_device_unchanged_mesh_appends_tail():
     st1, len1 = record_len(None)
     md, sb, cap = st1.max_divisions, st1.spawn_block, st1._cap
     nw_k, nw_s = -(-cap // 16), -(-sb // 16)
-    assert len1 == 8 + nw_k + md + 2 * md + nw_s + 2 * sb
+    # 9 header words (8 metric + the guard health flag word) and the
+    # trailing bad-cell bitmask lane (same nw_k width as the kill lane)
+    assert len1 == 9 + nw_k + md + 2 * md + nw_s + 2 * sb + nw_k
     assert st1._n_tiles == 1
 
     st8, len8 = record_len(tiled.make_mesh(8))
