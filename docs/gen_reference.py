@@ -61,6 +61,16 @@ MODULES = {
         " deterministic resume, health sentinels, watchdogs, and the"
         " fault injectors behind the chaos smoke."
     ),
+    "magicsoup_tpu.check": (
+        "graftcheck correctness checking: invariant flag decoding, the"
+        " host deep audit (`audit_world` / `assert_consistent`), and"
+        " typed violation reports."
+    ),
+    "magicsoup_tpu.check.differential": (
+        "The differential correctness harness: one seeded structural"
+        " schedule driven through every execution path, compared by"
+        " per-boundary state digests."
+    ),
     "magicsoup_tpu.parallel.tiled": (
         "Tile-sharded world stepping across a TPU device mesh"
         " (halo-exchange diffusion, sharded cell axis)."

@@ -14,6 +14,8 @@ Findings; registration at the bottom.
 | GL008 | host-callback-in-jit | no host round trips inside jitted bodies   |
 | GL009 | missing-sharding     | explicit placement in mesh-aware modules   |
 | GL010 | non-atomic-save      | crash-safe state persistence (guard.io)    |
+| GL011 | traced-assert        | invariants that actually fire (no traced   |
+|       |                      | `assert` inside jitted bodies)             |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -129,6 +131,13 @@ RULE_INFO = {
         "mid-write destroys BOTH the old snapshot and the new one; "
         "persistence must go through guard.io's "
         "write-temp->fsync->os.replace protocol",
+    ),
+    "GL011": (
+        "traced-assert",
+        "bare `assert` inside a jitted body — a condition on traced "
+        "values silently vanishes at trace time (tracers are truthy), "
+        "and a condition on Python values bakes into the compiled "
+        "program as a per-shape recompile hazard",
     ),
 }
 
@@ -983,6 +992,44 @@ def check_gl010(ctx: Context):
                 )
 
 
+# --------------------------------------------------------------- GL011
+def check_gl011(ctx: Context):
+    """Invariants inside a jitted body must use machinery that can
+    actually fire: a bare ``assert`` on traced values evaluates the
+    TRACER's truthiness at trace time — always true, so the check
+    silently vanishes from the compiled program — and an ``assert`` on
+    Python-level values bakes the outcome into the traced program,
+    turning a data-dependent check into a per-shape recompile hazard.
+    The sanctioned designs are the graftcheck invariant lanes (compute
+    the flag on device, pack it into the step record, police it on the
+    host replay — ``check.invariants``) or ``jax.experimental.checkify``
+    for a hard functional assert."""
+    fix = (
+        "compute the condition on device and pack it into the step "
+        "output record as an invariant lane (check.invariants; the host "
+        "replay polices it via sentinel_policy), or use "
+        "jax.experimental.checkify for a hard assert; waive a "
+        "deliberate trace-time shape check with "
+        "`# graftlint: disable=GL011`"
+    )
+    for f in ctx.files:
+        seen: set[int] = set()
+        for fn_node, _where, _kwargs in _jit_wrapped_defs(ctx, f):
+            for node in ast.walk(fn_node):
+                if not isinstance(node, ast.Assert) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                yield _finding(
+                    "GL011",
+                    f,
+                    node,
+                    f"bare `assert` inside jitted body `{fn_node.name}` "
+                    "— on traced values it silently vanishes at trace "
+                    "time; on Python values it is a recompile hazard",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -994,6 +1041,7 @@ CHECKERS = {
     "GL008": check_gl008,
     "GL009": check_gl009,
     "GL010": check_gl010,
+    "GL011": check_gl011,
 }
 
 

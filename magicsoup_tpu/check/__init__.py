@@ -1,0 +1,48 @@
+"""graftcheck: semantic correctness checking for simulation state.
+
+Three tiers, ordered by cost:
+
+- **Tier A — device invariant lanes** (:mod:`~magicsoup_tpu.check.invariants`):
+  per-step invariant flags computed unconditionally inside the fused
+  step program and packed into the same one-fetch record as the
+  telemetry and sentinel lanes (occupancy/alive agreement, duplicate
+  positions, dead-row residue, closed-system mass drift).  The stepper
+  routes trips through its ``sentinel_policy``.
+- **Tier B — host deep audit** (:func:`~magicsoup_tpu.check.audit.audit_world`):
+  fetches the device state once and runs the full semantic suite plus a
+  sampled genome→proteome re-translation cross-check against the
+  assembled kinetics params, returning typed
+  :class:`~magicsoup_tpu.check.audit.InvariantViolation` reports.
+  ``guard.restore_run(..., audit=True)`` runs it after every restore.
+- **Tier C — differential harness**
+  (:mod:`~magicsoup_tpu.check.differential`): one seeded
+  spawn/step/mutate/kill/divide/compact schedule driven through the
+  classic World driver, the pipelined stepper at K=1 and K=4, and a
+  2-tile mesh, comparing det-mode per-boundary state digests
+  (``performance/smoke.py --differential`` gates on it).
+
+This package is numpy/stdlib-only at import time (like ``guard``):
+importing it never initialises the XLA backend.  The differential
+runner imports jax lazily inside its entry points.
+"""
+from magicsoup_tpu.check.audit import (
+    AuditFailed,
+    InvariantViolation,
+    assert_consistent,
+    audit_world,
+)
+from magicsoup_tpu.check.invariants import (
+    INVARIANT_NAMES,
+    MASS_DRIFT_RTOL,
+    decode_invariants,
+)
+
+__all__ = [
+    "INVARIANT_NAMES",
+    "MASS_DRIFT_RTOL",
+    "AuditFailed",
+    "InvariantViolation",
+    "assert_consistent",
+    "audit_world",
+    "decode_invariants",
+]

@@ -1,0 +1,257 @@
+"""Tier C: the differential correctness harness.
+
+Four execution paths advance the same simulation: the classic
+:class:`World` driver, the :class:`PipelinedStepper` at ``K=1`` and
+``K=4`` (megastep fusion), and the stepper over a 2-tile device mesh.
+In det mode they are all documented BIT-identical — this module makes
+that a gating check instead of a promise: one seeded
+spawn/step/mutate/kill/divide/compact schedule is driven through every
+path, the full semantic state is digested at each schedule boundary,
+and any digest mismatch names the boundary where the trajectories
+forked.
+
+The schedule's structural ops (spawn, mutate, kill, divide — kill also
+exercises row compaction) run through the classic World API on EVERY
+path, with the world's RNG streams re-seeded from the schedule seed
+before each op: the differential axis is the CHEMISTRY execution path
+(``World.step_many`` vs the fused/pipelined/sharded stepper), not the
+host-side op implementations, and pinning the streams keeps a
+divergence report pointing at the device programs rather than at RNG
+consumption differences between drivers.
+
+``performance/smoke.py --differential`` gates on
+:func:`run_differential`; ``scripts/test.sh`` runs it after the unit
+tiers.  Import is numpy/stdlib-only; jax loads inside the entry points.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+#: the four gated execution paths, in report order
+PATHS = ("classic", "k1", "k4", "mesh2")
+
+#: chem-phase lengths between structural ops — multiples of 4 so the
+#: K=4 megastep divides every phase evenly
+PHASES = (4, 8, 4)
+
+#: schedule boundary names, in digest order (one digest per boundary)
+BOUNDARIES = (
+    "spawn",
+    "chem_a",
+    "mutate",
+    "chem_b",
+    "kill",
+    "divide",
+    "chem_c",
+)
+
+
+def _chemistry():
+    import magicsoup_tpu as ms
+
+    mols = [
+        ms.Molecule("dfx-a", 10e3),
+        ms.Molecule("dfx-atp", 8e3, half_life=100_000),
+    ]
+    return ms.Chemistry(
+        molecules=mols, reactions=[([mols[0]], [mols[1]])]
+    )
+
+
+def _reseed(world, seed: int, op_index: int) -> None:
+    """Pin both world RNG streams to a schedule-derived state before a
+    structural op (see module docstring)."""
+    world._rng.seed(seed * 10_007 + op_index)
+    world._nprng = np.random.default_rng(seed * 20_011 + op_index)
+
+
+def state_digest(world) -> str:
+    """sha256 over the full semantic state: map + live cell tensors,
+    positions, counters, and genomes.  Excludes RNG streams (the
+    schedule pins them) and dead capacity rows (capacity growth timing
+    is part of the digest only through ``n_cells``)."""
+    from magicsoup_tpu.util import fetch_host
+
+    n = int(world.n_cells)
+    mm, cm = fetch_host((world._molecule_map, world._cell_molecules))
+    h = hashlib.sha256()
+    for tag, part in (
+        ("n", np.int64(n).tobytes()),
+        ("mm", np.asarray(mm).tobytes()),
+        ("cm", np.asarray(cm)[:n].tobytes()),
+        ("pos", np.asarray(world.cell_positions).tobytes()),
+        ("map", np.asarray(world.cell_map).tobytes()),
+        ("lt", np.asarray(world.cell_lifetimes).tobytes()),
+        ("div", np.asarray(world.cell_divisions).tobytes()),
+        ("gen", "\x00".join(world.cell_genomes).encode()),
+    ):
+        h.update(tag.encode())
+        h.update(part)
+    return h.hexdigest()
+
+
+def structural_digest(world) -> str:
+    """sha256 over the jax-independent STRUCTURAL state only — cell
+    count, positions, occupancy map, lifetime/division counters, and
+    genomes.  Float tensors (molecule map, concentrations) are
+    excluded: XLA codegen details may legitimately move float bits
+    across jax versions and cache states, while the structure the
+    seeded schedule produces must never change — that is the contract
+    the committed golden-trajectory files under
+    ``tests/fast/data/golden/`` pin."""
+    n = int(world.n_cells)
+    h = hashlib.sha256()
+    for tag, part in (
+        ("n", np.int64(n).tobytes()),
+        ("pos", np.asarray(world.cell_positions).tobytes()),
+        ("map", np.asarray(world.cell_map).tobytes()),
+        ("lt", np.asarray(world.cell_lifetimes).tobytes()),
+        ("div", np.asarray(world.cell_divisions).tobytes()),
+        ("gen", "\x00".join(world.cell_genomes).encode()),
+    ):
+        h.update(tag.encode())
+        h.update(part)
+    return h.hexdigest()
+
+
+def _chem_phase(world, n_steps: int, path: str) -> None:
+    """Advance ``n_steps`` chemistry steps through the path's driver.
+
+    The stepper paths build a fresh chem-only stepper (selection
+    disabled: the schedule owns all structural ops) and flush it, so
+    the world is the source of truth again at the boundary."""
+    if path == "classic":
+        world.step_many(n_steps)
+        return
+    import magicsoup_tpu as ms
+
+    k = 4 if path == "k4" else 1
+    st = ms.PipelinedStepper(
+        world,
+        mol_name="dfx-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=200,
+        lag=1,
+        megastep=k,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+    assert n_steps % k == 0
+    for _ in range(n_steps // k):
+        st.step()
+    st.flush()
+
+
+def run_path(
+    path: str,
+    *,
+    seed: int = 11,
+    map_size: int = 16,
+    n_cells: int = 16,
+    digest_fn=None,
+) -> list[str]:
+    """Drive the seeded schedule through one execution path; returns the
+    per-boundary digests (same length for every path).  ``digest_fn``
+    defaults to the full :func:`state_digest`; the golden-trajectory
+    regression passes :func:`structural_digest` instead."""
+    import magicsoup_tpu as ms
+
+    if path not in PATHS:
+        raise ValueError(f"unknown path {path!r} (want one of {PATHS})")
+    if digest_fn is None:
+        digest_fn = state_digest
+    mesh = None
+    if path == "mesh2":
+        from magicsoup_tpu.parallel import tiled
+
+        mesh = tiled.make_mesh(2)
+    world = ms.World(
+        chemistry=_chemistry(), map_size=map_size, seed=seed, mesh=mesh
+    )
+    world.deterministic = True
+    digests: list[str] = []
+
+    # op 0: seeded spawn
+    _reseed(world, seed, 0)
+    rng = random.Random(seed)
+    world.spawn_cells(
+        [ms.random_genome(s=200, rng=rng) for _ in range(n_cells)]
+    )
+    digests.append(digest_fn(world))
+
+    # chem phase A
+    _chem_phase(world, PHASES[0], path)
+    digests.append(digest_fn(world))
+
+    # op 1: seeded point mutations (explicitly seeded stream)
+    _reseed(world, seed, 1)
+    mutated = ms.point_mutations(
+        list(world.cell_genomes), p=1e-3, seed=seed
+    )
+    world.update_cells(mutated)
+    digests.append(digest_fn(world))
+
+    # chem phase B
+    _chem_phase(world, PHASES[1], path)
+    digests.append(digest_fn(world))
+
+    # op 2: seeded kill (compacts surviving rows down)
+    _reseed(world, seed, 2)
+    pick = random.Random(seed + 1)
+    idxs = sorted(pick.sample(range(world.n_cells), world.n_cells // 4))
+    world.kill_cells(idxs)
+    digests.append(digest_fn(world))
+
+    # op 3: seeded divisions
+    _reseed(world, seed, 3)
+    idxs = sorted(pick.sample(range(world.n_cells), world.n_cells // 3))
+    world.divide_cells(idxs)
+    digests.append(digest_fn(world))
+
+    # chem phase C
+    _chem_phase(world, PHASES[2], path)
+    digests.append(digest_fn(world))
+    return digests
+
+
+def run_differential(
+    paths=PATHS, *, seed: int = 11, map_size: int = 16, n_cells: int = 16
+) -> dict:
+    """Run the schedule through every path and compare digests.
+
+    Returns ``{"ok": bool, "digests": {path: [...]}, "mismatches":
+    [{"boundary": i, "path": p, "want": d0, "got": d}, ...]}`` with the
+    first listed path as the reference.  Caller decides whether to gate
+    (the smoke exits nonzero on ``ok == False``).
+    """
+    digests = {
+        p: run_path(p, seed=seed, map_size=map_size, n_cells=n_cells)
+        for p in paths
+    }
+    ref_path = paths[0]
+    ref = digests[ref_path]
+    mismatches = []
+    for p in paths[1:]:
+        for i, (want, got) in enumerate(zip(ref, digests[p])):
+            if want != got:
+                mismatches.append(
+                    {
+                        "boundary": i,
+                        "boundary_name": BOUNDARIES[i],
+                        "path": p,
+                        "reference": ref_path,
+                        "want": want,
+                        "got": got,
+                    }
+                )
+    return {
+        "ok": not mismatches,
+        "digests": digests,
+        "mismatches": mismatches,
+    }

@@ -1,0 +1,65 @@
+"""Tier A: the on-device invariant lane contract.
+
+The fused step program (``stepper._step_body``, ``ms:invariants`` phase)
+computes one i32 flag word per step, UNCONDITIONALLY and pre-compaction,
+and packs it into the step record next to the graftguard health word —
+the compiled device program is byte-identical whether or not anything
+consumes the lanes, and the replay still costs exactly one fetch.  This
+module pins the bit layout and the mass-drift tolerance; it is
+numpy/stdlib-only at import time so both the stepper (device side) and
+the host policy code can import it without initialising a backend.
+
+Bit layout of the invariant flag word (word 9 of the record header):
+
+- bit 0 ``occ_alive_mismatch`` — occupied-pixel count != live-row count
+  (an occupancy-map desync: a kill/divide/spawn lost track of a pixel);
+- bit 1 ``pos_unoccupied`` — some live row's pixel is not marked
+  occupied in the map;
+- bit 2 ``dup_position`` — two live rows share a pixel;
+- bit 3 ``dead_cm_residue`` — a row at or beyond the high-water mark
+  holds a nonzero intracellular concentration (dead rows must be exact
+  zeros: the mass lanes and the det reductions rely on it);
+- bit 4 ``dead_param_residue`` — same, for any of the nine kinetics
+  parameter tensors;
+- bit 5 ``mass_drift`` — the physics phase (diffusion + permeation,
+  both closed-system) changed the total molecule mass by more than
+  ``MASS_DRIFT_RTOL`` relative to the post-degradation total.
+
+Word 10 of the header is the measured ABSOLUTE mass drift, an f32
+bitcast into the i32 record (divide on device would be the one
+non-deterministic op in the lane — the host divides if it wants the
+relative number).
+"""
+
+FLAG_OCC_ALIVE_MISMATCH = 1 << 0
+FLAG_POS_UNOCCUPIED = 1 << 1
+FLAG_DUP_POSITION = 1 << 2
+FLAG_DEAD_CM_RESIDUE = 1 << 3
+FLAG_DEAD_PARAM_RESIDUE = 1 << 4
+FLAG_MASS_DRIFT = 1 << 5
+
+# bit -> stable telemetry/report key, in bit order
+INVARIANT_NAMES = (
+    "occ_alive_mismatch",
+    "pos_unoccupied",
+    "dup_position",
+    "dead_cm_residue",
+    "dead_param_residue",
+    "mass_drift",
+)
+
+# relative tolerance for the closed-system mass-conservation lane: the
+# det-mode fixed-tree f32 sums agree to ~1e-7 relative; 1e-4 leaves
+# headroom for the non-det hardware reduction order while still
+# catching any real leak (a lost cell's worth of molecules is orders of
+# magnitude larger)
+MASS_DRIFT_RTOL = 1e-4
+
+
+def decode_invariants(flags: int) -> dict:
+    """Invariant flag word -> ``{name: bool}`` in bit order."""
+    flags = int(flags)
+    return {
+        name: bool(flags & (1 << bit))
+        for bit, name in enumerate(INVARIANT_NAMES)
+    }

@@ -43,13 +43,18 @@ Quickstart::
 """
 from magicsoup_tpu.guard.errors import (
     CheckpointError,
+    GuardConfigError,
     GuardError,
+    InvariantTripped,
     SentinelTripped,
     TransientDispatchError,
     WatchdogTimeout,
 )
 from magicsoup_tpu.guard.faults import (
+    corrupt_params_row,
+    desync_cell_map,
     flip_byte,
+    inject_dead_residue,
     inject_dispatch_failures,
     inject_nan,
 )
@@ -80,6 +85,8 @@ from magicsoup_tpu.guard.watchdog import Watchdog, dump_diagnostics
 __all__ = [
     "GuardError",
     "CheckpointError",
+    "GuardConfigError",
+    "InvariantTripped",
     "SentinelTripped",
     "TransientDispatchError",
     "WatchdogTimeout",
@@ -105,4 +112,7 @@ __all__ = [
     "flip_byte",
     "inject_nan",
     "inject_dispatch_failures",
+    "desync_cell_map",
+    "inject_dead_residue",
+    "corrupt_params_row",
 ]

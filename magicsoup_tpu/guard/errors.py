@@ -46,6 +46,37 @@ class SentinelTripped(GuardError):
         self.n_bad_cells = int(n_bad_cells)
 
 
+class InvariantTripped(SentinelTripped):
+    """A graftcheck state invariant fired under the ``rollback`` policy.
+
+    A subclass of :class:`SentinelTripped` so existing rollback handlers
+    catch both; ``flags`` here is the INVARIANT flag word (see
+    :func:`magicsoup_tpu.check.invariants.decode_invariants`), not the
+    health word.
+    """
+
+    def __init__(self, message: str, *, flags: int, step: int):
+        super().__init__(message, flags=flags, step=step, n_bad_cells=0)
+
+
+class GuardConfigError(GuardError):
+    """A guard environment knob holds an unusable value.
+
+    Raised at PARSE time (when the knob is first read) instead of
+    letting a garbage value propagate into a confusing ``float()``
+    traceback deep inside the watchdog.
+
+    Attributes:
+        variable: The environment variable name.
+        value: The raw string that failed to parse.
+    """
+
+    def __init__(self, message: str, *, variable: str, value: str):
+        super().__init__(message)
+        self.variable = variable
+        self.value = value
+
+
 class WatchdogTimeout(GuardError):
     """A dispatch/fetch exceeded its wall-clock budget.
 

@@ -8,6 +8,10 @@ Every guard mechanism needs a way to make its failure happen on demand:
   sentinel lanes and the quarantine/rollback policies),
 - :func:`inject_dispatch_failures` makes the next N step dispatches
   raise a transient error (exercises bounded retry-with-backoff),
+- :func:`desync_cell_map`, :func:`inject_dead_residue`, and
+  :func:`corrupt_params_row` seed the three semantic corruptions the
+  graftcheck deep audit (``check.audit_world``) must each reject with a
+  typed violation,
 - process-level chaos (SIGKILL mid-megastep, SIGTERM graceful drain)
   lives in ``performance/smoke.py --chaos``, which orchestrates child
   processes around these hooks.
@@ -71,6 +75,64 @@ def inject_nan(target, *, row: int = 0, mol: int = 0) -> None:
         w = target
         w._cell_molecules = w._cell_molecules.at[row, mol].set(jnp.nan)
         w._cm_cache = None
+
+
+def desync_cell_map(world) -> tuple:
+    """Clear one occupied pixel in the host occupancy map WITHOUT
+    removing the cell — the occupancy/position desync
+    ``check.audit_world`` reports as ``cell_map_desync`` (and the device
+    invariant lanes catch as ``occ_alive_mismatch`` once the map is
+    re-uploaded).  Returns the ``(row, col)`` pixel cleared so a test
+    can restore it."""
+    import numpy as np
+
+    hits = np.argwhere(world._np_cell_map)
+    if len(hits) == 0:
+        raise ValueError("world has no occupied pixels to desync")
+    r, c = (int(x) for x in hits[0])
+    world._np_cell_map[r, c] = False
+    return r, c
+
+
+def inject_dead_residue(world, *, mol: int = 0, value: float = 1.0) -> int:
+    """Write a nonzero concentration into a DEAD cell row (the first row
+    past the live prefix) — the dead-row residue ``check.audit_world``
+    reports as ``dead_cm_residue`` and the device lanes flag as bit 3.
+    Returns the corrupted row index."""
+    row = int(world.n_cells)
+    if row >= world._cell_molecules.shape[0]:
+        raise ValueError("world is at capacity: no dead rows to corrupt")
+    world._cell_molecules = world._cell_molecules.at[row, mol].set(value)
+    world._cm_cache = None
+    return row
+
+
+def corrupt_params_row(world, *, row: int | None = None) -> int:
+    """Overwrite a live cell's resident Vmax column WITHOUT touching its
+    genome — the params/genome desync ``check.audit_world``'s sampled
+    re-translation cross-check reports as ``params_genome_mismatch``.
+    Picks the first audited (sampled) row whose genome translates to at
+    least one protein unless ``row`` is given; returns the row."""
+    from magicsoup_tpu.check.audit import _sample_rows
+
+    if row is None:
+        n = int(world.n_cells)
+        counts, _, _ = world.genetics.translate_genomes_flat(
+            list(world.cell_genomes)
+        )
+        row = next(
+            (i for i in _sample_rows(n, 8) if int(counts[i]) > 0), None
+        )
+        if row is None:
+            raise ValueError(
+                "no sampled cell translates to any protein; nothing for "
+                "the cross-check to catch"
+            )
+    kin = world.kinetics
+    kin.params = kin.params._replace(
+        Vmax=kin.params.Vmax.at[row, 0].add(7.0)
+    )
+    return row
 
 
 def inject_dispatch_failures(stepper, n: int = 1) -> None:

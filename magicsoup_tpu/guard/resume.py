@@ -177,7 +177,7 @@ def _remesh_world(world, mesh) -> None:
     )
 
 
-def restore_run(source, *, mesh=None) -> tuple:
+def restore_run(source, *, mesh=None, audit: bool = False) -> tuple:
     """Load a run checkpoint; returns ``(world, stepper_aux, meta)``.
 
     ``source`` is a :class:`CheckpointManager` (loads the newest
@@ -186,6 +186,14 @@ def restore_run(source, *, mesh=None) -> tuple:
     (pickles are mesh-free by design).  ``stepper_aux`` is ``None`` for
     classic-driver checkpoints; otherwise construct a stepper with the
     SAME kwargs and hand both to :func:`restore_stepper`.
+
+    Pass ``audit=True`` to run the graftcheck deep audit
+    (:func:`magicsoup_tpu.check.assert_consistent`) on the restored
+    world — a checkpoint that verified its digest can still carry a
+    semantic desync from BEFORE the save, and a restore boundary is the
+    cheapest place to catch one (the state was just fetched anyway and
+    the pipeline is empty).  Raises
+    :class:`magicsoup_tpu.check.AuditFailed` listing the violations.
     """
     if isinstance(source, CheckpointManager):
         payload, meta, _path = source.load_latest()
@@ -215,6 +223,10 @@ def restore_run(source, *, mesh=None) -> tuple:
         aux = dict(aux)
         aux["world_rng_state"] = payload["world_rng_state"]
         aux["world_nprng_state"] = payload["world_nprng_state"]
+    if audit:
+        from magicsoup_tpu.check import assert_consistent
+
+        assert_consistent(world)
     return world, aux, meta
 
 
@@ -225,13 +237,25 @@ def restore_stepper(stepper, aux: dict) -> None:
 
     Refuses (``CheckpointError``, ``check="config"``) when a
     trajectory-determining knob differs — a silently different config
-    would break bit-identity invisibly.
+    would break bit-identity invisibly.  The one knob that is NOT
+    trajectory-determining in det mode is the mesh shape: the sharded
+    det trajectory is pinned bit-identical to the single-device one
+    (``performance/mesh_sweep.py --check``), so a det checkpoint may
+    restore onto a different tile count (single -> mesh or back); in
+    non-det mode reduction orders differ by shape and the refusal
+    stands.
     """
     want = aux["config"]
     have = stepper_config(stepper)
     diff = sorted(
         k for k in set(want) | set(have) if want.get(k) != have.get(k)
     )
+    if (
+        "n_tiles" in diff
+        and want.get("deterministic")
+        and have.get("deterministic")
+    ):
+        diff.remove("n_tiles")
     if diff:
         detail = ", ".join(
             f"{k}: checkpoint={want.get(k)!r} != stepper={have.get(k)!r}"

@@ -22,18 +22,53 @@ from contextlib import contextmanager
 _DEFAULT_FETCH_TIMEOUT = 300.0
 
 
+def _env_positive_float(name: str, default: float) -> float:
+    """Parse a positive-float guard knob from the environment.
+
+    Unset or empty means the default; anything else must parse as a
+    positive finite float or a typed
+    :class:`~magicsoup_tpu.guard.errors.GuardConfigError` NAMING THE
+    VARIABLE is raised at parse time — a garbage value must not
+    propagate into a confusing ``float()`` traceback (or a silent
+    fallback) deep inside the watchdog.
+    """
+    import math
+
+    raw = os.environ.get(name, "")
+    if raw.strip() == "":
+        return default
+    from magicsoup_tpu.guard.errors import GuardConfigError
+
+    try:
+        value = float(raw)
+    except ValueError:
+        raise GuardConfigError(
+            f"{name}={raw!r} is not a number (expected a positive "
+            "float, seconds)",
+            variable=name,
+            value=raw,
+        ) from None
+    if not math.isfinite(value) or value <= 0:
+        raise GuardConfigError(
+            f"{name}={raw!r} must be a positive finite number of "
+            "seconds",
+            variable=name,
+            value=raw,
+        )
+    return value
+
+
 def fetch_timeout() -> float:
     """Wall-clock budget (seconds) for a single result fetch.
 
     Overridable via ``MAGICSOUP_GUARD_FETCH_TIMEOUT`` so chaos tests can
     force a fast trip and huge sharded fetches can raise the ceiling.
+    A malformed value raises a typed ``GuardConfigError`` naming the
+    variable (unset/empty means the default).
     """
-    raw = os.environ.get("MAGICSOUP_GUARD_FETCH_TIMEOUT", "")
-    try:
-        value = float(raw)
-    except ValueError:
-        return _DEFAULT_FETCH_TIMEOUT
-    return value if value > 0 else _DEFAULT_FETCH_TIMEOUT
+    return _env_positive_float(
+        "MAGICSOUP_GUARD_FETCH_TIMEOUT", _DEFAULT_FETCH_TIMEOUT
+    )
 
 
 def dump_diagnostics(tag: str, extra: dict | None = None) -> dict:

@@ -83,6 +83,13 @@ from magicsoup_tpu.util import (
 # at import time, so this does not pull jax machinery in twice
 from magicsoup_tpu.guard.sentinel import NEG_EPS as _SENTINEL_NEG_EPS
 
+# graftcheck invariant-lane contract (bit layout + mass-drift tolerance
+# shared between the device lanes and the host policy); numpy/stdlib-only
+# at import time, same as the guard package
+from magicsoup_tpu.check.invariants import (
+    MASS_DRIFT_RTOL as _MASS_DRIFT_RTOL,
+)
+
 # numpy on purpose: a module-level jnp array would initialise the XLA
 # backend at import time, which breaks jax.distributed.initialize() in
 # multi-host programs importing this package
@@ -124,14 +131,21 @@ class StepOutputs(NamedTuple):
     # per-row bad-concentration bitmask behind it
     health: int = 0
     bad_cells: Any = None
+    # graftcheck invariant lanes (same unconditional contract): the flag
+    # word per check.invariants' bit layout and the measured absolute
+    # mass drift across the physics phase
+    invariants: int = 0
+    mass_drift: float = 0.0
 
 
 _BITS = 16  # bits packed per i32 word (16 keeps every value positive)
 # leading scalar words of the packed record: [n_placed, n_candidates,
 # n_attempted, n_rows, n_alive, n_occupied, mm_mass(f32 bits),
-# cm_mass(f32 bits), health_flags] — _step_body's pack and
-# _unpack_outputs must agree
-_HEADER_WORDS = 9
+# cm_mass(f32 bits), health_flags, invariant_flags,
+# mass_drift(f32 bits)] — _step_body's pack and _unpack_outputs must
+# agree (tests/fast/test_bench_parsing.py pins the record-length
+# formula)
+_HEADER_WORDS = 11
 
 
 def _pack_bits(b: jax.Array) -> jax.Array:
@@ -148,6 +162,29 @@ def _unpack_bits(words: np.ndarray, n: int) -> np.ndarray:
     """Inverse of :func:`_pack_bits` on host numpy."""
     bits = (words.astype(np.int64)[:, None] >> np.arange(_BITS)) & 1
     return bits.reshape(-1)[:n].astype(bool)
+
+
+def record_length(
+    cap: int, max_divisions: int, spawn_block: int, n_tiles: int = 1
+) -> int:
+    """Words in a packed step record for a given stepper config — THE
+    layout formula (pinned by tests/fast/test_bench_parsing.py; the
+    device-side pack in ``_step_body`` and the host-side
+    ``_unpack_outputs`` must both agree with it).  Mesh runs
+    (``n_tiles > 1``) append one per-tile occupancy word at the tail;
+    single-device records carry no tail."""
+    nw_k = -(-cap // _BITS)  # kill / bad-cell bitmask words
+    nw_s = -(-spawn_block // _BITS)  # spawn-ok bitmask words
+    return (
+        _HEADER_WORDS
+        + nw_k  # kill bitmask
+        + max_divisions  # division parent rows
+        + 2 * max_divisions  # division child positions
+        + nw_s  # spawn-ok bitmask
+        + 2 * spawn_block  # spawn positions
+        + nw_k  # bad-cell bitmask (graftguard)
+        + (n_tiles if n_tiles > 1 else 0)  # mesh tile occupancy tail
+    )
 
 
 class DeviceState(NamedTuple):
@@ -446,6 +483,17 @@ def _step_body(
     with jax.named_scope("ms:physics"):
         mm = mm * degrad_factors[:, None, None]
         cm = cm * degrad_factors[None, :]
+        # graftcheck mass anchor: diffusion (normalized torus kernel)
+        # and permeation (cell<->pixel exchange) are closed-system, so
+        # the total mass right after degradation is what the post-step
+        # metric sums must reproduce.  Same reduction as ms:metrics so
+        # det mode compares fixed trees against fixed trees.
+        if det:
+            mass_pre = _detmath.sum_axis(
+                mm.reshape(-1), 0
+            ) + _detmath.sum_axis(cm.reshape(-1), 0)
+        else:
+            mass_pre = jnp.sum(mm) + jnp.sum(cm)
         mm = _diff.diffuse(mm, kernels, det=det, mesh=mesh)
         xs, ys = pos[:, 0], pos[:, 1]
         ext = mm[:, xs, ys].T
@@ -502,6 +550,60 @@ def _step_body(
             | (cm_neg_rows.any().astype(jnp.int32) << 3)
         )
 
+    # ---- 4.7 graftcheck invariant lanes -------------------------------
+    # semantic state invariants, same contract again: unconditional (the
+    # program is byte-identical whether a policy consumes them), zero
+    # extra D2H (two more header words in the packed record), and BEFORE
+    # compaction so row indices match the kill/bad-cell lanes.  Every
+    # reduction is integer/boolean (exact in any order) except the mass
+    # comparison, which is an f32 sub/abs/compare of detmath tree sums —
+    # det-safe on both backends (see ops/detmath.py).
+    with jax.named_scope("ms:invariants"):
+        # occupied pixels vs live rows: each live cell owns exactly one
+        # pixel, so any desync (lost kill, phantom occupancy) breaks the
+        # count equality
+        occ_alive_mismatch = n_occupied != alive.sum(dtype=jnp.int32)
+        # every live row's pixel must be marked occupied
+        pos_unoccupied = (alive & ~occ[pos[:, 0], pos[:, 1]]).any()
+        # duplicate live positions: integer scatter-add of per-pixel
+        # counts (dead rows park at the dropped OOB slot)
+        lin = jnp.where(alive, pos[:, 0] * m + pos[:, 1], m * m)
+        pix_counts = jnp.zeros(m * m, dtype=jnp.int32).at[lin].add(
+            1, mode="drop"
+        )
+        dup_position = (pix_counts > 1).any()
+        # dead-row residue: rows at/beyond the high-water mark must be
+        # exact zeros in cm and in every params leaf — kill zeroes, the
+        # compaction fold zeroes, and scatter drops OOB, so any residue
+        # means a write escaped the row accounting
+        dead = rows >= n_rows
+        dead_cm_residue = (dead[:, None] & (cm != 0.0)).any()
+        row_has_params = jnp.zeros((cap,), dtype=bool)
+        for leaf in jax.tree_util.tree_leaves(params):
+            row_has_params = row_has_params | (
+                (leaf != 0).reshape(cap, -1).any(axis=1)
+            )
+        dead_param_residue = (dead & row_has_params).any()
+        # closed-system mass conservation across the physics phase,
+        # relative to the post-degradation anchor (multiply feeding a
+        # compare — no division on device)
+        mass_post = mm_mass.astype(jnp.float32) + cm_mass.astype(
+            jnp.float32
+        )
+        mass_drift = jnp.abs(mass_post - mass_pre.astype(jnp.float32))
+        drift_denom = jnp.maximum(jnp.abs(mass_pre), jnp.float32(1.0))
+        mass_drifted = mass_drift > jnp.float32(_MASS_DRIFT_RTOL) * (
+            drift_denom
+        )
+        invariants = (
+            occ_alive_mismatch.astype(jnp.int32)
+            | (pos_unoccupied.astype(jnp.int32) << 1)
+            | (dup_position.astype(jnp.int32) << 2)
+            | (dead_cm_residue.astype(jnp.int32) << 3)
+            | (dead_param_residue.astype(jnp.int32) << 4)
+            | (mass_drifted.astype(jnp.int32) << 5)
+        )
+
     # ---- 5. optional compaction ---------------------------------------
     child_pos_out = cpos[jnp.clip(p_idx, 0, cap - 1)]
     if compact:
@@ -522,8 +624,9 @@ def _step_body(
     # header words 5-7 are the telemetry lanes: occupied-pixel count and
     # the two f32 mass totals bitcast into i32 (the host re-views the
     # bits as float32 — exact, no rounding through a cast); word 8 is
-    # the graftguard health flag word, with the per-row bad-cell bitmask
-    # as the last pre-tail lane
+    # the graftguard health flag word (per-row bad-cell bitmask as the
+    # last pre-tail lane); words 9-10 are the graftcheck invariant flag
+    # word and the f32 mass-drift measurement, bitcast the same way
     with jax.named_scope("ms:pack_record"):
         lanes = [
             jnp.stack(
@@ -541,6 +644,10 @@ def _step_body(
                         cm_mass.astype(jnp.float32), jnp.int32
                     ),
                     health,
+                    invariants,
+                    jax.lax.bitcast_convert_type(
+                        mass_drift.astype(jnp.float32), jnp.int32
+                    ),
                 ]
             ).astype(jnp.int32),
             _pack_bits(kill),
@@ -1089,6 +1196,7 @@ class PipelinedStepper:
         )
         self._quarantine_pending = False
         self._sentinel_warned = False
+        self._invariant_warned = False
         self._fault_dispatch = 0  # armed by guard.faults
         self.stats = {
             "steps": 0,
@@ -1113,6 +1221,9 @@ class PipelinedStepper:
             "sentinel_trips": 0,
             "quarantined": 0,
             "dispatch_retries": 0,
+            # graftcheck counter: replayed steps whose invariant flag
+            # word was nonzero
+            "invariant_trips": 0,
         }
         # graftscope: share the world's recorder so one JSONL stream
         # carries both; detached recorders cost one dict update per
@@ -1634,6 +1745,9 @@ class PipelinedStepper:
         sb = self.spawn_block
         nw_k = -(-self._cap // _BITS)
         nw_s = -(-sb // _BITS)
+        assert arr.shape[0] == record_length(
+            self._cap, md, sb, self._n_tiles if self._mesh is not None else 1
+        ), "step record length drifted from stepper.record_length"
         off = _HEADER_WORDS
         kill = _unpack_bits(arr[off : off + nw_k], self._cap)
         off += nw_k
@@ -1657,8 +1771,10 @@ class PipelinedStepper:
             else None
         )
         # header words 6-7 are f32 mass totals bitcast into the i32
-        # record on device; re-view the bits, don't value-cast them
+        # record on device; re-view the bits, don't value-cast them —
+        # word 10 (graftcheck mass drift) gets the same treatment
         masses = np.ascontiguousarray(arr[6:8]).view(np.float32)
+        drift = np.ascontiguousarray(arr[10:11]).view(np.float32)
         return StepOutputs(
             kill=kill,
             parents=parents,
@@ -1676,6 +1792,8 @@ class PipelinedStepper:
             tile_occupancy=tile_occ,
             health=int(arr[8]),
             bad_cells=bad_cells,
+            invariants=int(arr[9]),
+            mass_drift=float(drift[0]),
         )
 
     def _drain(self, block: bool) -> None:
@@ -1791,6 +1909,53 @@ class PipelinedStepper:
                 "only via telemetry)"
             )
 
+    def _handle_invariant(self, out: StepOutputs) -> None:
+        """Host-side policy over a tripped invariant flag word (Tier A
+        graftcheck lanes) — routed through the SAME ``sentinel_policy``
+        machinery as the health sentinel: rollback raises a typed
+        :class:`~magicsoup_tpu.guard.errors.InvariantTripped`,
+        quarantine schedules the flush -> quarantine -> reattach cycle
+        (reattach rebuilds the occupancy map and cell index from the
+        positions, repairing a desync), warn warns once and counts."""
+        from magicsoup_tpu.check.invariants import decode_invariants
+        from magicsoup_tpu.guard.errors import InvariantTripped
+
+        flags = decode_invariants(out.invariants)
+        step = self.stats["replayed"]
+        self.stats["invariant_trips"] += 1
+        names = ", ".join(k for k, v in flags.items() if v)
+        if self.telemetry.attached:
+            self.telemetry.emit(
+                {
+                    "type": "invariant",
+                    "step": step,
+                    "flags": int(out.invariants),
+                    "mass_drift": float(out.mass_drift),
+                    "policy": self.sentinel_policy,
+                    **flags,
+                }
+            )
+        if self.sentinel_policy == "rollback":
+            raise InvariantTripped(
+                f"state invariant tripped at replayed step {step}: "
+                f"{names} (mass drift {out.mass_drift:.3g}) — restore "
+                "the last good checkpoint",
+                flags=out.invariants,
+                step=step,
+            )
+        if self.sentinel_policy == "quarantine":
+            self._quarantine_pending = True
+        elif not self._invariant_warned:
+            self._invariant_warned = True
+            import warnings
+
+            warnings.warn(
+                f"state invariant tripped at replayed step {step}: "
+                f"{names}; policy=warn — counting trips in "
+                "stats['invariant_trips'] (further trips warn only via "
+                "telemetry)"
+            )
+
     def _replay_record(
         self,
         out: StepOutputs,
@@ -1807,6 +1972,8 @@ class PipelinedStepper:
         self._join_evolution()
         if out.health:
             self._handle_sentinel(out)
+        if out.invariants:
+            self._handle_invariant(out)
         kill = out.kill
         parents = out.parents
         n_placed = out.n_placed

@@ -202,6 +202,12 @@ def validate_rows(rows: list[dict]) -> list[str]:
                 problems.append(
                     f"{where}: sentinel row missing 'flags'/'step'"
                 )
+        elif kind == "invariant":
+            # graftcheck invariant-lane trip (stepper._handle_invariant)
+            if not isinstance(row.get("flags"), int) or "step" not in row:
+                problems.append(
+                    f"{where}: invariant row missing 'flags'/'step'"
+                )
         elif kind != "meta":
             problems.append(f"{where}: unknown row type {kind!r}")
     return problems

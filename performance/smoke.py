@@ -27,7 +27,16 @@ checkpoint + flushed telemetry, and a NaN injection / failed dispatch
 must trip the health sentinel / bounded retry.  ``--chaos-child`` is the
 internal per-scenario entry point those subprocesses use.
 
-scripts/test.sh runs both after the fast tier.
+``--differential`` runs the graftcheck differential smoke (GATING): one
+seeded spawn/step/mutate/kill/divide/compact schedule driven through the
+classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
+mesh — all four det-mode trajectories must produce identical
+per-boundary state digests (``magicsoup_tpu.check.differential``).  The
+four paths run inside ONE child process with 2 forced host devices, so
+the comparison is free of the cache-loaded-vs-fresh-compile axis
+(tests/conftest.py) and of host-device-count skew.
+
+scripts/test.sh runs all three after the fast tier.
 """
 import argparse
 import json
@@ -60,11 +69,20 @@ def main() -> None:
     ap.add_argument("--total", type=int, default=6, help="chaos dispatches")
     ap.add_argument("--ckpt-every", type=int, default=2)
     ap.add_argument("--kill-after", type=int, default=0)
+    # graftcheck differential smoke (see differential_main below)
+    ap.add_argument("--differential", action="store_true")
+    ap.add_argument(
+        "--differential-child", action="store_true", help=argparse.SUPPRESS
+    )
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
     if args.chaos:
         return chaos_main(args)
+    if args.differential_child:
+        return differential_child(args)
+    if args.differential:
+        return differential_main(args)
 
     import jax
 
@@ -374,7 +392,9 @@ def chaos_child(args) -> None:
         )
 
     elif mode == "resume":
-        world, aux, meta = guard.restore_run(mgr)
+        # audit=True: the graftcheck deep audit must PASS on the state
+        # restored from the killed run's checkpoint (AuditFailed -> rc!=0)
+        world, aux, meta = guard.restore_run(mgr, audit=True)
         st = _chaos_stepper(world, args)
         guard.restore_stepper(st, aux)
         start = int(meta["step"])
@@ -384,12 +404,33 @@ def chaos_child(args) -> None:
             if i % args.ckpt_every == 0 and i > start:
                 guard.save_run(mgr, world, st, step=i)
             st.step()
+        digest = _chaos_digest(world, st)  # flushes; world is current
+        # ... and must FAIL on deliberately desynced state: each seeded
+        # corruption must surface as its typed InvariantViolation
+        from magicsoup_tpu import check
+        missed = []
+        for code, inject in (
+            ("cell_map_desync", guard.desync_cell_map),
+            ("dead_cm_residue", guard.inject_dead_residue),
+            ("params_genome_mismatch", guard.corrupt_params_row),
+        ):
+            inject(world)
+            if code not in {v.code for v in check.audit_world(world)}:
+                missed.append(code)
         print(
             json.dumps(
-                {"digest": _chaos_digest(world, st), "from_step": start}
+                {
+                    "digest": digest,
+                    "from_step": start,
+                    "audit_missed": missed,
+                }
             ),
             flush=True,
         )
+        if missed:
+            raise SystemExit(
+                "audit failed to reject corruption(s): " + ", ".join(missed)
+            )
 
     elif mode == "sigterm":
         world = _chaos_setup(args)
@@ -447,6 +488,93 @@ def chaos_child(args) -> None:
             raise SystemExit(
                 f"chaos faults child FAILED: retries={retries} trips={trips}"
             )
+
+
+def differential_child(args) -> None:
+    """All four execution paths of the graftcheck differential schedule,
+    in ONE process (same compile-cache state for every path — see
+    tests/conftest.py on cache-loaded vs fresh XLA:CPU executables).
+    Prints the result as a JSON line; exits nonzero on any digest
+    mismatch."""
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+    from magicsoup_tpu.check.differential import run_differential
+
+    out = run_differential(seed=args.seed, map_size=args.map_size)
+    print(
+        json.dumps(
+            {
+                "ok": out["ok"],
+                "boundaries": len(next(iter(out["digests"].values()))),
+                "paths": sorted(out["digests"]),
+                "mismatches": out["mismatches"],
+            }
+        ),
+        flush=True,
+    )
+    if not out["ok"]:
+        raise SystemExit("differential digests diverged")
+
+
+def differential_main(args) -> None:
+    """Spawn the differential child with 2 forced host devices (the
+    mesh path needs them) and GATE on its digest comparison."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    child = subprocess.run(
+        [
+            sys.executable,
+            str(Path(__file__).resolve()),
+            "--differential-child",
+            "--seed",
+            str(args.seed),
+            "--map-size",
+            str(args.map_size),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    rows = [
+        json.loads(line)
+        for line in (child.stdout or "").splitlines()
+        if line.strip().startswith("{")
+    ]
+    row = rows[-1] if rows else {}
+    ok = child.returncode == 0 and bool(row.get("ok"))
+    print(
+        json.dumps(
+            {
+                "metric": "differential smoke (graftcheck 4-path digests, cpu)",
+                "value": 1.0 if ok else 0.0,
+                "unit": "pass",
+                "boundaries": row.get("boundaries"),
+                "paths": row.get("paths"),
+                "mismatches": row.get("mismatches"),
+            }
+        ),
+        flush=True,
+    )
+    if not ok:
+        raise SystemExit(
+            f"differential smoke FAILED: child rc={child.returncode}\n"
+            + (child.stderr or "")[-2000:]
+        )
 
 
 def chaos_main(args) -> None:

@@ -8,6 +8,10 @@ TARGET="${1:-tests/fast}"
 # import) and a hot-path violation should fail before the suite spends
 # minutes compiling
 python -m magicsoup_tpu.analysis --check
+# the unit tier includes the graftcheck property-based suite
+# (tests/fast/test_check_properties.py): under Hypothesis it runs a
+# bounded CI profile (max_examples + deadline capped); without it the
+# same properties run over fixed seeded samples — gating either way
 python -m pytest "$TARGET" -q
 # steps/s smoke: prove the pipelined dispatch->replay->flush path end to
 # end and leave a throughput number in the CI log (JSON, no threshold —
@@ -28,6 +32,15 @@ python performance/mesh_sweep.py --check --devices 2 \
 # and resume it from its crash-safe checkpoint — the final state must be
 # BIT-identical to the uninterrupted run; also flips checkpoint bytes
 # (typed rejection + retention fallback), SIGTERMs a child (graceful
-# drain -> final checkpoint + flushed telemetry), and trips the NaN
-# sentinel / transient-dispatch retry.  Exits nonzero on any violation.
+# drain -> final checkpoint + flushed telemetry), trips the NaN
+# sentinel / transient-dispatch retry, and runs the graftcheck deep
+# audit post-resume (must pass clean, must reject seeded corruptions).
+# Exits nonzero on any violation.
 python performance/smoke.py --chaos
+# graftcheck differential smoke (GATING): one seeded
+# spawn/step/mutate/kill/divide/compact schedule through the classic
+# driver, the stepper at K=1 and K=4, and a 2-tile mesh — all four
+# det-mode trajectories must produce identical per-boundary state
+# digests (magicsoup_tpu/check/differential.py).  Exits nonzero on any
+# divergence.
+python performance/smoke.py --differential

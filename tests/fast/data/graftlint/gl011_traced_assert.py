@@ -1,0 +1,12 @@
+"""GL011 fixture: a bare ``assert`` planted inside a jitted body — on
+traced values the check silently vanishes at trace time (tracers are
+truthy); on Python values it bakes into the program as a recompile
+hazard."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_step(x):
+    assert (x >= 0).all()  # GL011: traced assert silently vanishes
+    return x * jnp.int32(2)

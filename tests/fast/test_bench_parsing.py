@@ -358,6 +358,27 @@ def test_publish_telemetry_refuses_invalid_stream(tmp_path, monkeypatch):
     assert published["telemetry"]["capture_dir"].endswith("cap-later")
 
 
+def test_step_record_length_formula():
+    # the packed step record's layout contract: 11 header words
+    # ([n_placed, n_candidates, n_attempted, n_rows, n_alive,
+    # n_occupied, mm_mass, cm_mass, health, invariant_flags,
+    # mass_drift]) + the kill bitmask, division, spawn, and bad-cell
+    # lanes, + one tile-occupancy word per mesh tile.  Record parsers
+    # outside the stepper (bench harnesses, telemetry tooling) size
+    # their buffers off this formula, so it is pinned here next to them
+    from magicsoup_tpu import stepper as sm
+
+    assert sm._HEADER_WORDS == 11
+    # cap=24 -> 2 bitmask words; md=4 -> 4 + 8; sb=8 -> 1 + 16
+    assert sm.record_length(24, 4, 8) == 11 + 2 + 4 + 8 + 1 + 16 + 2
+    # non-multiple-of-16 widths round the bitmask lanes up
+    assert sm.record_length(33, 2, 17, n_tiles=4) == (
+        11 + 3 + 2 + 4 + 2 + 34 + 3 + 4
+    )
+    # single-device records carry no tile tail (n_tiles=1 == default)
+    assert sm.record_length(24, 4, 8, n_tiles=1) == sm.record_length(24, 4, 8)
+
+
 def test_transient_markers_cover_tunnel_failure_modes():
     for msg in (
         "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE",

@@ -1,0 +1,291 @@
+"""
+graftcheck tests (:mod:`magicsoup_tpu.check`): the Tier A device
+invariant lanes, the Tier B host deep audit, and the Tier C
+differential harness entry points.
+
+Tier A: the lanes ride the packed step record unconditionally, so the
+tests corrupt the stepper's device state directly (a dead-row residue
+the compacting ops can never produce) and pin that the trip routes
+through the SAME ``sentinel_policy`` machinery as the health sentinel —
+warn warns once and counts, rollback raises a typed
+:class:`~magicsoup_tpu.guard.errors.InvariantTripped`, and an attached
+telemetry recorder gets a validating ``invariant`` row.
+
+Tier B: :func:`~magicsoup_tpu.check.audit_world` must return nothing on
+a healthy world and a typed report per seeded corruption — every fault
+injector in :mod:`magicsoup_tpu.guard.faults` maps to its audit code.
+
+The full four-path differential gate runs in ``performance/smoke.py
+--differential``; here only the cheap classic-vs-K=1 pair keeps the
+harness itself honest in the fast tier.
+"""
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import check, guard
+from magicsoup_tpu.check import differential
+from magicsoup_tpu.check.invariants import (
+    FLAG_DEAD_CM_RESIDUE,
+    FLAG_DUP_POSITION,
+    FLAG_MASS_DRIFT,
+    INVARIANT_NAMES,
+    decode_invariants,
+)
+from magicsoup_tpu.guard.errors import (
+    GuardConfigError,
+    InvariantTripped,
+    SentinelTripped,
+)
+from magicsoup_tpu.guard.watchdog import fetch_timeout
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.telemetry import TelemetryRecorder, read_jsonl, validate_rows
+
+_MOLS = [
+    ms.Molecule("cs-a", 10e3),
+    ms.Molecule("cs-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+
+def _world(*, seed=7, map_size=16, n_cells=12):
+    world = ms.World(chemistry=_CHEM, map_size=map_size, seed=seed)
+    world.deterministic = True
+    rng = random.Random(seed)
+    world.spawn_cells(
+        [ms.random_genome(s=200, rng=rng) for _ in range(n_cells)]
+    )
+    return world
+
+
+def _chem_stepper(world, **kwargs):
+    """A structurally quiet stepper: no kills, divisions, or spawns, so
+    the dead-row suffix stays dead and a seeded residue is purely ours."""
+    defaults = dict(
+        mol_name="cs-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=200,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+    defaults.update(kwargs)
+    return PipelinedStepper(world, **defaults)
+
+
+def _seed_dead_residue(st) -> int:
+    """Corrupt the stepper's DEVICE state with a dead-row concentration
+    (the host injector targets the world's buffers; the stepper threads
+    its own copies)."""
+    row = int(st._state.n_rows)
+    assert row < st._state.cm.shape[0], "no dead rows at this capacity"
+    st._state = st._state._replace(
+        cm=st._state.cm.at[row, 0].set(5.0)
+    )
+    return row
+
+
+# ------------------------------------------------ Tier A: lane decoding
+def test_decode_invariants_bit_layout():
+    assert decode_invariants(0) == {name: False for name in INVARIANT_NAMES}
+    only_dup = decode_invariants(FLAG_DUP_POSITION)
+    assert only_dup["dup_position"] and sum(only_dup.values()) == 1
+    both = decode_invariants(FLAG_DEAD_CM_RESIDUE | FLAG_MASS_DRIFT)
+    assert both["dead_cm_residue"] and both["mass_drift"]
+    assert sum(both.values()) == 2
+    # numpy integers (straight off the fetched record) decode too
+    assert decode_invariants(np.int32(FLAG_DEAD_CM_RESIDUE)) == decode_invariants(
+        FLAG_DEAD_CM_RESIDUE
+    )
+
+
+def test_clean_run_trips_nothing():
+    world = _world()
+    st = _chem_stepper(world)
+    for _ in range(4):
+        st.step()
+    st.drain()
+    assert st.stats["invariant_trips"] == 0
+    st.flush()
+    assert check.audit_world(world) == []
+
+
+def test_invariant_trip_warn_policy_counts_and_warns_once():
+    st = _chem_stepper(_world())
+    st.step()
+    st.drain()  # warm; the corrupted dispatch must not be the compile
+    _seed_dead_residue(st)
+    with pytest.warns(UserWarning, match="dead_cm_residue"):
+        for _ in range(3):
+            st.step()
+        st.drain()
+    # the alive-masked cm update scrubs the residue after one step, so
+    # the lane trips on exactly the record that saw it — and warns once
+    assert st.stats["invariant_trips"] >= 1
+    assert st._invariant_warned
+
+
+def test_invariant_trip_rollback_policy_raises_typed():
+    st = _chem_stepper(_world(), sentinel_policy="rollback")
+    st.step()
+    st.drain()
+    _seed_dead_residue(st)
+    with pytest.raises(InvariantTripped) as err:
+        for _ in range(3):
+            st.step()
+        st.drain()
+    # a SentinelTripped subclass: existing rollback handlers catch both
+    assert isinstance(err.value, SentinelTripped)
+    assert decode_invariants(err.value.flags)["dead_cm_residue"]
+    assert err.value.step >= 0
+
+
+def test_invariant_trip_emits_validating_telemetry_row(tmp_path):
+    path = tmp_path / "trip.jsonl"
+    st = _chem_stepper(_world())
+    st.telemetry = TelemetryRecorder(path)
+    st.step()
+    st.drain()
+    _seed_dead_residue(st)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        st.step()
+        st.drain()
+    st.telemetry.detach()
+    rows = read_jsonl(path)
+    trips = [r for r in rows if r.get("type") == "invariant"]
+    assert trips, "no invariant row emitted"
+    assert trips[0]["dead_cm_residue"] is True
+    assert isinstance(trips[0]["flags"], int)
+    assert validate_rows(rows) == []
+
+
+def test_validate_rows_rejects_malformed_invariant_row():
+    bad = [{"type": "invariant", "step": 3}]  # no flags word
+    assert any("invariant" in p for p in validate_rows(bad))
+
+
+def test_invariant_lanes_consumed_vs_ignored_identical_trajectory(tmp_path):
+    # the lanes are computed UNCONDITIONALLY inside the fused step
+    # program; policy and telemetry only change what the HOST does with
+    # the fetched words — so a clean det run is bit-identical whether
+    # the lanes are consumed (rollback/quarantine, recorder attached)
+    # or ignored (warn, no recorder)
+    from magicsoup_tpu.check.differential import state_digest
+
+    def run(policy, attach=False):
+        world = _world(seed=9)
+        st = _chem_stepper(world, sentinel_policy=policy)
+        if attach:
+            st.telemetry = TelemetryRecorder(tmp_path / f"{policy}.jsonl")
+        for _ in range(4):
+            st.step()
+        st.flush()
+        if attach:
+            st.telemetry.detach()
+        return state_digest(world)
+
+    base = run("warn")
+    assert base == run("rollback")
+    assert base == run("quarantine")
+    assert base == run("warn", attach=True)
+
+
+# --------------------------------------------------- Tier B: deep audit
+def test_audit_clean_world_full_coverage():
+    world = _world()
+    assert check.audit_world(world, sample=world.n_cells) == []
+
+
+def test_audit_detects_cell_map_desync():
+    world = _world()
+    r, c = guard.desync_cell_map(world)
+    violations = check.audit_world(world)
+    codes = {v.code for v in violations}
+    assert "cell_map_desync" in codes
+    world._np_cell_map[r, c] = True  # restore
+    assert check.audit_world(world) == []
+
+
+def test_audit_detects_dead_cm_residue():
+    world = _world()
+    row = guard.inject_dead_residue(world)
+    violations = check.audit_world(world)
+    hits = [v for v in violations if v.code == "dead_cm_residue"]
+    assert hits and row in hits[0].rows
+
+
+def test_audit_detects_params_genome_mismatch():
+    world = _world()
+    row = guard.corrupt_params_row(world)
+    violations = check.audit_world(world, sample=world.n_cells)
+    hits = [v for v in violations if v.code == "params_genome_mismatch"]
+    assert hits and row in hits[0].rows
+    assert "Vmax" in hits[0].details.get("tensors", ())
+
+
+def test_assert_consistent_raises_audit_failed():
+    world = _world()
+    guard.inject_dead_residue(world)
+    with pytest.raises(check.AuditFailed) as err:
+        check.assert_consistent(world)
+    assert any(v.code == "dead_cm_residue" for v in err.value.violations)
+    assert "dead_cm_residue" in str(err.value)
+
+
+def test_restore_run_audit_flag(tmp_path):
+    # a checkpoint that VERIFIES its digest can still carry a semantic
+    # desync from before the save — audit=True catches it at restore
+    world = _world()
+    mgr = guard.CheckpointManager(tmp_path / "ck")
+    guard.save_run(mgr, world)
+    restored, aux, _meta = guard.restore_run(mgr, audit=True)  # clean: passes
+    assert aux is None and restored.n_cells == world.n_cells
+
+    guard.desync_cell_map(world)
+    mgr2 = guard.CheckpointManager(tmp_path / "ck2")
+    guard.save_run(mgr2, world)
+    guard.restore_run(mgr2)  # without audit the desync restores silently
+    with pytest.raises(check.AuditFailed):
+        guard.restore_run(mgr2, audit=True)
+
+
+# ----------------------------------------- satellite: guard config knob
+@pytest.mark.parametrize("bad", ["abc", "-1", "0", "inf", "nan"])
+def test_fetch_timeout_rejects_garbage_at_parse_time(monkeypatch, bad):
+    monkeypatch.setenv("MAGICSOUP_GUARD_FETCH_TIMEOUT", bad)
+    with pytest.raises(GuardConfigError) as err:
+        fetch_timeout()
+    assert err.value.variable == "MAGICSOUP_GUARD_FETCH_TIMEOUT"
+    assert err.value.value == bad
+    assert "MAGICSOUP_GUARD_FETCH_TIMEOUT" in str(err.value)
+
+
+def test_fetch_timeout_accepts_override_and_default(monkeypatch):
+    monkeypatch.setenv("MAGICSOUP_GUARD_FETCH_TIMEOUT", "12.5")
+    assert fetch_timeout() == 12.5
+    monkeypatch.setenv("MAGICSOUP_GUARD_FETCH_TIMEOUT", "")
+    assert fetch_timeout() == 300.0
+    monkeypatch.delenv("MAGICSOUP_GUARD_FETCH_TIMEOUT")
+    assert fetch_timeout() == 300.0
+
+
+# ------------------------------------- Tier C: differential harness
+def test_differential_classic_vs_k1_digests_identical(monkeypatch):
+    # the cheap pair; K=4 and the 2-tile mesh run in the gating smoke
+    monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    report = differential.run_differential(
+        paths=("classic", "k1"), seed=11, map_size=16, n_cells=12
+    )
+    assert report["ok"], report["mismatches"]
+    digests = report["digests"]
+    assert digests["classic"] == digests["k1"]
+    # one digest per schedule boundary, and the state actually evolved
+    assert len(digests["classic"]) == len(differential.BOUNDARIES)
+    assert len(set(digests["classic"])) > 1

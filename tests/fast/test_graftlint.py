@@ -54,6 +54,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl008_io_callback.py", "GL008"),
         ("gl009_unplaced.py", "GL009"),
         ("gl010_unsafe_save.py", "GL010"),
+        ("gl011_traced_assert.py", "GL011"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -130,6 +131,21 @@ def test_gl010_waivable_like_the_other_rules(tmp_path):
     )
     assert waived != src
     p = tmp_path / "gl010_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl011_waivable_like_the_other_rules(tmp_path):
+    # a deliberate trace-time shape assertion (a Python-value check that
+    # is INTENDED to bake into the trace) waives with the standard
+    # inline annotation; pin that the machinery covers GL011
+    src = (FIXTURES / "gl011_traced_assert.py").read_text()
+    waived = src.replace(
+        "# GL011: traced assert silently vanishes",
+        "# graftlint: disable=GL011 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl011_waived.py"
     p.write_text(waived)
     assert analyze([p]) == []
 

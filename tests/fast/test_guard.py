@@ -241,6 +241,70 @@ def test_pipelined_kill_resume_bit_identity(megastep, tiles, tmp_path):
     st_b.check_consistency()
 
 
+@pytest.mark.parametrize("direction", ["single_to_mesh", "mesh_to_single"])
+def test_cross_shape_restore_bit_identity(direction, tmp_path):
+    # PR 6 pinned same-shape resume; this pins CROSS-shape: a det
+    # checkpoint written on one mesh shape restores onto another and
+    # continues bit-identically (det mode makes the trajectory
+    # shape-independent — the mesh_sweep gate — so the tile count is
+    # not trajectory-determining and restore_stepper allows the change)
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple (virtual) devices")
+    to_mesh = direction == "single_to_mesh"
+    src_mesh = None if to_mesh else tiled.make_mesh(2)
+    dst_mesh = tiled.make_mesh(2) if to_mesh else None
+    K = 3
+
+    # reference: uninterrupted on the DESTINATION shape, checkpointing
+    # at the same boundary (a pipelined checkpoint IS a flush)
+    world_a = _world(mesh=dst_mesh)
+    st_a = _stepper(world_a)
+    for _ in range(K):
+        st_a.step()
+    guard.save_run(
+        CheckpointManager(tmp_path / "ref"), world_a, st_a, step=K
+    )
+    for _ in range(K):
+        st_a.step()
+    ref = _fingerprint(world_a, st_a)
+
+    # victim: K dispatches on the SOURCE shape, checkpoint, die,
+    # restore re-sharded onto the destination, K more dispatches
+    world_b = _world(mesh=src_mesh)
+    st_b = _stepper(world_b)
+    for _ in range(K):
+        st_b.step()
+    mgr = CheckpointManager(tmp_path / "x")
+    guard.save_run(mgr, world_b, st_b, step=K)
+    del world_b, st_b
+    world_c, aux, _meta = guard.restore_run(mgr, mesh=dst_mesh, audit=True)
+    st_c = _stepper(world_c)
+    guard.restore_stepper(st_c, aux)
+    for _ in range(K):
+        st_c.step()
+    _assert_identical(ref, _fingerprint(world_c, st_c))
+    st_c.check_consistency()
+
+
+def test_cross_shape_restore_refused_outside_det_mode(tmp_path):
+    # non-det reduction orders differ by shape, so there the n_tiles
+    # config refusal still stands
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple (virtual) devices")
+    world = _world()
+    world.deterministic = False
+    st = _stepper(world)
+    st.step()
+    mgr = CheckpointManager(tmp_path)
+    guard.save_run(mgr, world, st)
+    world2, aux, _ = guard.restore_run(mgr, mesh=tiled.make_mesh(2))
+    world2.deterministic = False
+    other = _stepper(world2)
+    with pytest.raises(CheckpointError, match="n_tiles") as e:
+        guard.restore_stepper(other, aux)
+    assert e.value.check == "config"
+
+
 def test_classic_driver_kill_resume_bit_identity(tmp_path):
     K = 3
 
