@@ -71,6 +71,26 @@ MODULES = {
         " schedule driven through every execution path, compared by"
         " per-boundary state digests."
     ),
+    "magicsoup_tpu.fleet": (
+        "graftfleet multi-world batching: run B independent worlds as"
+        " ONE compiled program with one dispatch and one host fetch per"
+        " megastep for the whole fleet."
+    ),
+    "magicsoup_tpu.fleet.scheduler": (
+        "The `FleetScheduler`: admits/retires worlds dynamically, packs"
+        " same-capacity-rung worlds into shared compiled variants, and"
+        " drives each rung group with one batched dispatch."
+    ),
+    "magicsoup_tpu.fleet.persist": (
+        "Batch-aware checkpointing: atomic whole-fleet snapshots, and"
+        " extracting a single world out of a fleet checkpoint as a"
+        " standalone run."
+    ),
+    "magicsoup_tpu.fleet.sharding": (
+        "World-axis data parallelism: shard the fleet's leading axis"
+        " over a `P(\"world\")` device mesh (no collectives — worlds are"
+        " independent)."
+    ),
     "magicsoup_tpu.parallel.tiled": (
         "Tile-sharded world stepping across a TPU device mesh"
         " (halo-exchange diffusion, sharded cell axis)."

@@ -16,6 +16,8 @@ Findings; registration at the bottom.
 | GL010 | non-atomic-save      | crash-safe state persistence (guard.io)    |
 | GL011 | traced-assert        | invariants that actually fire (no traced   |
 |       |                      | `assert` inside jitted bodies)             |
+| GL012 | shared-prng-key      | per-world randomness in fleet modules (no  |
+|       |                      | one key consumed across the world axis)    |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -138,6 +140,14 @@ RULE_INFO = {
         "values silently vanishes at trace time (tracers are truthy), "
         "and a condition on Python values bakes into the compiled "
         "program as a per-shape recompile hazard",
+    ),
+    "GL012": (
+        "shared-prng-key",
+        "a `jax.random.*` draw in a fleet module consuming a key that "
+        "is not per-world — one unsplit key broadcast across the world "
+        "axis gives every world of the batch the SAME random stream, "
+        "silently correlating trajectories that are documented "
+        "independent",
     ),
 }
 
@@ -350,6 +360,19 @@ def _is_jit_ctor(func: ast.expr) -> bool:
     return False
 
 
+def _memo_decorated(fn_node: ast.AST) -> bool:
+    """True when the enclosing builder is itself memoized
+    (``functools.lru_cache`` / ``functools.cache``) — the decorator IS
+    the once-per-static-configuration guard, same contract as an
+    explicit cache dict."""
+    for dec in getattr(fn_node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = _attr_chain(target)
+        if chain.rsplit(".", 1)[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
 def _cache_guarded(f, node: ast.AST) -> bool:
     """The sanctioned memoized-jit idiom: the wrapper is built under an
     ``if key not in cache:`` guard or stored into a cache subscript, so
@@ -422,7 +445,7 @@ def check_gl002(ctx: Context):
                 enclosing = _enclosing_function(f, node)
                 if enclosing is None:
                     continue  # module-scope jit compiles once
-                if _cache_guarded(f, node):
+                if _cache_guarded(f, node) or _memo_decorated(enclosing):
                     continue
                 yield _finding(
                     "GL002",
@@ -1030,6 +1053,97 @@ def check_gl011(ctx: Context):
                 )
 
 
+# --------------------------------------------------------------- GL012
+#: key-plumbing forms, never draws — exempt from the per-world check
+_KEY_PLUMBING = {
+    "PRNGKey",
+    "key",
+    "split",
+    "fold_in",
+    "wrap_key_data",
+    "key_data",
+    "clone",
+}
+#: first-arg forms that ARE per-world: a subscripted key array
+#: (``keys[w]``) or a fresh derivation from the world lane
+_PER_WORLD_DERIVES = {"split", "fold_in"}
+
+
+def _is_fleet_scoped(f) -> bool:
+    """A file is fleet-scoped when it lives under a ``fleet`` package or
+    imports one — the modules whose code runs under the stacked world
+    axis, where a non-per-world key is a correctness hazard rather than
+    a style choice."""
+    if "fleet" in f.path.parts:
+        return True
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "fleet" in node.module.split("."):
+                return True
+            if any(a.name == "fleet" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("fleet" in a.name.split(".") for a in node.names):
+                return True
+    return False
+
+
+def check_gl012(ctx: Context):
+    """Randomness under the fleet's stacked world axis must be
+    per-world: every ``jax.random.*`` draw in a fleet-scoped module has
+    to consume a key indexed out of a per-world key array (``keys[w]``)
+    or freshly derived from one (``split`` / ``fold_in``).  A bare key
+    name — one unsplit key reused across the batch — broadcasts the
+    SAME stream to every world, so B "independent" trajectories share
+    their mutation draws, spawn positions, and recombination points.
+    The solo stepper's single-key discipline is exactly the bug here:
+    stacking it without splitting correlates the fleet."""
+    fix = (
+        "index a per-world key array (`keys[w]`) or derive the lane key "
+        "with jax.random.fold_in(key, world_index) / jax.random.split "
+        "before drawing; waive a deliberately shared stream (e.g. a "
+        "common environment shock) with `# graftlint: disable=GL012`"
+    )
+    for f in ctx.files:
+        if not _is_fleet_scoped(f):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain.startswith("jax.random."):
+                continue
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in _KEY_PLUMBING:
+                continue
+            if not node.args:
+                yield _finding(
+                    "GL012",
+                    f,
+                    node,
+                    f"`{chain}()` without a key argument in a fleet "
+                    "module — there is no per-world stream at all",
+                    fix,
+                )
+                continue
+            k = node.args[0]
+            per_world = isinstance(k, ast.Subscript) or (
+                isinstance(k, ast.Call)
+                and _attr_chain(k.func).rsplit(".", 1)[-1]
+                in _PER_WORLD_DERIVES
+            )
+            if not per_world:
+                yield _finding(
+                    "GL012",
+                    f,
+                    node,
+                    f"`{chain}()` consumes a key shared across the "
+                    "world axis — every world of the fleet draws the "
+                    "same stream",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1042,6 +1156,7 @@ CHECKERS = {
     "GL009": check_gl009,
     "GL010": check_gl010,
     "GL011": check_gl011,
+    "GL012": check_gl012,
 }
 
 

@@ -33,6 +33,12 @@ import numpy as np
 #: the four gated execution paths, in report order
 PATHS = ("classic", "k1", "k4", "mesh2")
 
+#: fleet execution paths — a B=1 fleet driven through the
+#: FleetScheduler at K=1 / K=4.  Not in the default gated tuple (the
+#: fleet has its own gating smoke); tests/fast/test_fleet.py pins these
+#: against the solo digests per boundary.
+FLEET_PATHS = ("fleet1", "fleet4")
+
 #: chem-phase lengths between structural ops — multiples of 4 so the
 #: K=4 megastep divides every phase evenly
 PHASES = (4, 8, 4)
@@ -128,9 +134,8 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         return
     import magicsoup_tpu as ms
 
-    k = 4 if path == "k4" else 1
-    st = ms.PipelinedStepper(
-        world,
+    k = 4 if path in ("k4", "fleet4") else 1
+    kwargs = dict(
         mol_name="dfx-atp",
         kill_below=-1.0,
         divide_above=1e30,
@@ -143,6 +148,19 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         p_recombination=0.0,
     )
     assert n_steps % k == 0
+    if path in FLEET_PATHS:
+        # B=1 fleet: same world, same kwargs, driven through the
+        # scheduler's stacked program — digests must not move a bit
+        from magicsoup_tpu.fleet import FleetScheduler
+
+        fleet = FleetScheduler(block=1)
+        lane = fleet.admit(world, **kwargs)
+        for _ in range(n_steps // k):
+            fleet.step()
+        fleet.flush()
+        fleet.retire(lane)
+        return
+    st = ms.PipelinedStepper(world, **kwargs)
     for _ in range(n_steps // k):
         st.step()
     st.flush()
@@ -162,8 +180,10 @@ def run_path(
     regression passes :func:`structural_digest` instead."""
     import magicsoup_tpu as ms
 
-    if path not in PATHS:
-        raise ValueError(f"unknown path {path!r} (want one of {PATHS})")
+    if path not in PATHS + FLEET_PATHS:
+        raise ValueError(
+            f"unknown path {path!r} (want one of {PATHS + FLEET_PATHS})"
+        )
     if digest_fn is None:
         digest_fn = state_digest
     mesh = None

@@ -1,0 +1,53 @@
+"""
+graftfleet — multi-world batch axis: B independent worlds as ONE
+compiled program.
+
+The production shape for "millions of users" is not one giant world but
+thousands of independent ones (sessions, replicates, sweeps) packed
+onto shared hardware.  This subsystem stacks same-rung worlds on a
+leading world axis and steps them with a single dispatch and a single
+host fetch per megastep:
+
+    fleet = FleetScheduler(block=4)
+    a = fleet.admit(world_a, mol_name="atp", ...)   # solo stepper kwargs
+    b = fleet.admit(world_b, mol_name="atp", ...)
+    fleet.step()          # ONE dispatch + ONE fetch for the whole rung
+    fleet.flush()         # sync every World
+    solo = fleet.retire(b)  # b continues as a standalone stepper
+
+Contracts (all pinned in tests/fast/test_fleet.py and the gating fleet
+smoke):
+
+- **bit-identity**: in det mode every world in a fleet computes exactly
+  what it would compute alone — a B=1 fleet matches the solo
+  ``PipelinedStepper`` at any megastep K.
+- **one fetch per megastep per fleet group**: member lanes share one
+  physical D2H transfer of the batched ``(B, k, record)`` step record.
+- **zero-compile admission**: admitting a world into a rung whose group
+  has a free slot and a warm program compiles nothing.
+
+Submodules: :mod:`~magicsoup_tpu.fleet.batch` (the stacked device
+program), :mod:`~magicsoup_tpu.fleet.lanes` (per-world steppers),
+:mod:`~magicsoup_tpu.fleet.scheduler` (admission/rungs/dispatch),
+:mod:`~magicsoup_tpu.fleet.sharding` (world-axis mesh placement),
+:mod:`~magicsoup_tpu.fleet.persist` (batch-aware guard checkpoints).
+"""
+from magicsoup_tpu.fleet.lanes import FleetLane
+from magicsoup_tpu.fleet.persist import (
+    FLEET_FORMAT,
+    restore_fleet,
+    restore_world,
+    save_fleet,
+    snapshot_fleet,
+)
+from magicsoup_tpu.fleet.scheduler import FleetScheduler
+
+__all__ = [
+    "FLEET_FORMAT",
+    "FleetLane",
+    "FleetScheduler",
+    "restore_fleet",
+    "restore_world",
+    "save_fleet",
+    "snapshot_fleet",
+]

@@ -1,0 +1,262 @@
+"""
+graftfleet device program: B independent worlds, ONE compiled program.
+
+The fleet stacks every per-world input of the fused megastep
+(:func:`magicsoup_tpu.stepper._megastep`) on a leading **world axis**
+and runs a ``lax.scan`` over that axis — each scan iteration steps one
+world's slice through the exact solo step body, so a world inside a
+fleet computes bit-for-bit what it would compute alone (the det-mode
+bit-identity tests pin this).  One dispatch advances all B worlds by
+``k`` fused steps; the batched ``(B, k, record)`` output is fetched
+ONCE per megastep for the whole fleet and sliced per world on the host
+(the one-fetch-per-megastep-per-fleet contract).
+
+Compaction inside the fleet is a TRACED per-world decision, not the
+solo path's static variant flag: every world computes both the
+compacted and uncompacted next state and selects per leaf with its
+``do_compact`` lane (same op sequence as the solo static-compact
+branch, so the selected values are bitwise identical — only record
+header word 3, the post-step row count, needs a select; word 4 is a
+permutation-invariant alive count).  Paying the sort every step buys
+the property that makes dynamic admission cheap: a fleet group has
+exactly ONE compiled variant per shape, so admitting a world into a
+warm capacity rung compiles nothing.
+
+Inactive slots (retired, or not yet admitted) hold all-zero state and
+parameters.  A zero slot is an exact no-op through every phase: the
+``alive`` mask is all-False (no chemistry/kill/divide writes land), the
+spawn-valid lane is all-False, push rows are zero-padded scatters into
+dead rows, and the zero PRNG key is a valid key that is never consumed
+into live state.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from magicsoup_tpu.ops.params import compact_rows, permute_params
+from magicsoup_tpu.stepper import DeviceState, _donate_step_buffers, _megastep
+
+__all__ = [
+    "FleetConsts",
+    "extract_world",
+    "fleet_step",
+    "fleet_step_program",
+    "insert_world",
+    "lane_consts",
+    "stack_worlds",
+    "zeros_world_like",
+]
+
+
+class FleetConsts(NamedTuple):
+    """Per-world constant inputs of the fused step, in stacking order.
+
+    One leading world axis over everything the solo dispatch passes as
+    loose positional constants — keeping them in one pytree lets the
+    scheduler restack membership changes with a single (warm) program.
+    """
+
+    kernels: Any
+    perm_factors: Any
+    degrad_factors: Any
+    mol_idx: Any
+    kill_below: Any
+    divide_above: Any
+    divide_cost: Any
+    tables: Any
+    abs_temp: Any
+
+
+def lane_consts(stepper) -> FleetConsts:
+    """One lane's per-world constants (unstacked) in fleet order."""
+    return FleetConsts(
+        kernels=stepper._kernels_dev,
+        perm_factors=stepper._perm_dev,
+        degrad_factors=stepper._degrad_dev,
+        mol_idx=stepper._mol_idx_dev,
+        kill_below=stepper._kill_below_dev,
+        divide_above=stepper._divide_above_dev,
+        divide_cost=stepper._divide_cost_dev,
+        tables=stepper._tables(),
+        abs_temp=stepper._abs_temp_dev,
+    )
+
+
+def fleet_step_program(
+    fstate: DeviceState,
+    fparams: Any,
+    consts: FleetConsts,
+    spawn_dense: jax.Array,
+    spawn_valid: jax.Array,
+    push_dense: jax.Array,
+    push_rows: jax.Array,
+    div_budget: jax.Array,
+    do_compact: jax.Array,
+    *,
+    det: bool,
+    max_div: int,
+    n_rounds: int,
+    k: int,
+    use_pallas: bool,
+) -> tuple[DeviceState, Any, jax.Array]:
+    """The raw (unjitted) fleet program: scan the solo megastep over the
+    world axis, then apply each world's traced maybe-compact.
+
+    Every argument carries a leading world axis; ``div_budget`` is
+    ``(B,)`` i32 and ``do_compact`` ``(B,)`` bool.  Returns the stacked
+    next state/params and the ``(B, k, record)`` packed step records.
+    """
+    cap = fstate.cm.shape[1]
+    rows = jnp.arange(cap, dtype=jnp.int32)
+
+    def body(_, wxs):
+        state, params, c, sd, sv, pd, pr, db, do = wxs
+        state, params, outs = _megastep.__wrapped__(
+            state,
+            params,
+            c.kernels,
+            c.perm_factors,
+            c.degrad_factors,
+            c.mol_idx,
+            c.kill_below,
+            c.divide_above,
+            c.divide_cost,
+            db,
+            sd,
+            sv,
+            pd,
+            pr,
+            c.tables,
+            c.abs_temp,
+            det=det,
+            max_div=max_div,
+            n_rounds=n_rounds,
+            compact=False,
+            q=cap,
+            use_pallas=use_pallas,
+            k=k,
+            mesh=None,
+        )
+        # traced per-world maybe-compact: the solo static-compact
+        # branch's exact op sequence, computed unconditionally and
+        # selected per leaf — so the selected values are bitwise what
+        # the solo compact variant produces
+        perm = jnp.argsort(~state.alive, stable=True).astype(jnp.int32)
+        n_keep = state.alive.sum(dtype=jnp.int32)
+        cm2 = compact_rows(state.cm, perm, n_keep)
+        pos2 = compact_rows(state.pos, perm, n_keep)
+        params2 = permute_params(params, perm, n_keep)
+        alive2 = rows < n_keep
+
+        def sel(a, b):
+            return jnp.where(do, a, b)
+
+        state = DeviceState(
+            mm=state.mm,
+            cm=sel(cm2, state.cm),
+            pos=sel(pos2, state.pos),
+            occ=state.occ,
+            alive=sel(alive2, state.alive),
+            n_rows=sel(n_keep, state.n_rows),
+            key=state.key,
+        )
+        params = jax.tree_util.tree_map(sel, params2, params)
+        # record fixup: only header word 3 (post-step row count) of the
+        # final record depends on the compact decision — word 4 (alive
+        # count) is permutation-invariant and needs no select
+        outs = outs.at[-1, 3].set(jnp.where(do, n_keep, outs[-1, 3]))
+        return _, (state, params, outs)
+
+    _, (fstate, fparams, fouts) = jax.lax.scan(
+        body,
+        0,
+        (
+            fstate,
+            fparams,
+            consts,
+            spawn_dense,
+            spawn_valid,
+            push_dense,
+            push_rows,
+            div_budget,
+            do_compact,
+        ),
+    )
+    return fstate, fparams, fouts
+
+
+_STATICS = ("det", "max_div", "n_rounds", "k", "use_pallas")
+
+_fleet_step_donated = functools.partial(
+    jax.jit, static_argnames=_STATICS, donate_argnums=(0, 1)
+)(fleet_step_program)
+
+_fleet_step_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of the fleet step; donation races XLA:CPU async execution
+    jax.jit, static_argnames=_STATICS
+)(fleet_step_program)
+
+
+def fleet_step(*args, **statics):
+    """Dispatch one fleet megastep through the backend-appropriate jit
+    twin (donated on accelerators, retained on XLA:CPU — same split as
+    the solo ``_megastep``/``_megastep_retained`` pair)."""
+    fn = _fleet_step_donated if _donate_step_buffers() else _fleet_step_retained
+    return fn(*args, **statics)
+
+
+# ------------------------------------------------------------------ #
+# world-axis stacking helpers                                        #
+# ------------------------------------------------------------------ #
+# All three are jitted with ARRAY slot indices so the compiled program
+# is shared across slots: a python-int index would bake into the jaxpr
+# and give every slot its own compile, defeating the zero-compile
+# admission contract.
+
+
+@jax.jit
+def _stack(*trees):
+    return jax.tree_util.tree_map(lambda *ts: jnp.stack(ts), *trees)
+
+
+def stack_worlds(trees):
+    """Stack per-world pytrees into one batched pytree (leading B axis)."""
+    return _stack(*trees)
+
+
+@jax.jit
+def _extract(tree, idx):
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.dynamic_index_in_dim(t, idx, axis=0, keepdims=False),
+        tree,
+    )
+
+
+def extract_world(tree, slot: int):
+    """One world's slice out of a batched pytree (checkout path)."""
+    return _extract(tree, jnp.asarray(slot, jnp.int32))
+
+
+@jax.jit
+def _insert(tree, sub, idx):
+    return jax.tree_util.tree_map(
+        lambda t, s: jax.lax.dynamic_update_slice_in_dim(
+            t, s[None], idx, axis=0
+        ),
+        tree,
+        sub,
+    )
+
+
+def insert_world(tree, slot: int, sub):
+    """Write one world's pytree into slot ``slot`` of a batched pytree."""
+    return _insert(tree, sub, jnp.asarray(slot, jnp.int32))
+
+
+def zeros_world_like(tree):
+    """All-zero single-world pytree — the inactive-slot filler (an exact
+    no-op through every step phase; see module docstring)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)

@@ -1,0 +1,82 @@
+"""
+Fleet lanes: one :class:`~magicsoup_tpu.stepper.PipelinedStepper` per
+admitted world, with its device state RESIDENT in the group's stacked
+arrays instead of its own.
+
+A lane keeps the full solo host machinery — spawn/push queues, replay,
+growth and compaction decisions, telemetry, guard hooks — untouched.
+Only the device boundary changes: the scheduler runs the lane's
+``_prepare_dispatch`` (host half), stacks the planned batches of every
+lane in the group, dispatches ONE fleet program, and hands the lane its
+slice of the shared fetch via ``_commit_dispatch``.  Because every host
+decision is the solo code path, a lane's trajectory is bit-identical to
+running the same world alone (pinned in tests/fast/test_fleet.py).
+
+Checkout protocol: while resident, ``lane._state`` / ``lane.kin.params``
+are STALE — the truth lives in the group stack.  Every operation that
+touches them host-side (flush, consistency audit, standalone push
+programs) first checks the lane out (extracts its slice); the scheduler
+re-admits checked-out lanes before the next group dispatch.
+"""
+from __future__ import annotations
+
+from magicsoup_tpu.stepper import PipelinedStepper
+
+__all__ = ["FleetLane"]
+
+
+class FleetLane(PipelinedStepper):
+    """A :class:`PipelinedStepper` whose device state is a slot of a
+    fleet group's stacked arrays.  Constructed by
+    :meth:`~magicsoup_tpu.fleet.scheduler.FleetScheduler.admit`; after
+    :meth:`~magicsoup_tpu.fleet.scheduler.FleetScheduler.retire` it is a
+    plain standalone stepper again."""
+
+    def __init__(self, world, **kwargs):
+        # set before super().__init__ — the constructor's _attach path
+        # must see a detached lane
+        self._fleet = None
+        self._fleet_slot = None  # (group, slot index) while a member
+        self._fleet_resident = False  # device truth lives in the stack
+        super().__init__(world, **kwargs)
+
+    # ------------------------------------------------------------ #
+    # checkout boundary                                            #
+    # ------------------------------------------------------------ #
+
+    def _checkout(self) -> None:
+        """Pull this lane's current slice out of the group stack so
+        ``self._state`` / ``self.kin.params`` are the device truth
+        again.  No-op when detached or already checked out."""
+        if self._fleet is not None and self._fleet_resident:
+            self._fleet._checkout(self)
+
+    def flush(self) -> None:
+        self._checkout()
+        super().flush()
+
+    def check_consistency(self) -> None:
+        self._checkout()
+        super().check_consistency()
+
+    def _grow_tokens(self, n_prots: int, n_doms: int) -> None:
+        # the resize pads kin.params in place — while resident that is a
+        # STALE copy; pull the truth out of the stack first so the
+        # padded tensor is the one the scheduler restacks
+        if n_prots > self.kin.max_proteins or n_doms > self.kin.max_doms:
+            self._checkout()
+        super()._grow_tokens(n_prots, n_doms)
+
+    def _apply_push_now(self, genomes, rows, seq) -> None:
+        # standalone push programs scatter into kin.params directly —
+        # that buffer must be the truth, not a stale pre-stack copy
+        self._checkout()
+        super()._apply_push_now(genomes, rows, seq)
+
+    def step(self) -> None:
+        if self._fleet is not None:
+            raise RuntimeError(
+                "lane is managed by a FleetScheduler — drive it with "
+                "scheduler.step(), or retire() it for solo stepping"
+            )
+        super().step()

@@ -1,0 +1,128 @@
+"""
+Batch-aware guard checkpointing for fleets.
+
+Two restore shapes, both riding the verified ``.msck`` container and
+the single-run snapshot format of :mod:`magicsoup_tpu.guard.resume`:
+
+- **whole fleet, atomically**: :func:`save_fleet` flushes every lane
+  (one drain boundary for the whole fleet) and writes ONE checkpoint
+  file nesting one run payload per world — a crash mid-save never
+  leaves a half-fleet on disk (``guard.io.atomic_write_bytes``), and
+  the chaos smoke SIGKILLs through it (``performance/smoke.py
+  --chaos``).
+- **one world out of a running fleet**: :func:`restore_world` extracts
+  a single world's run payload and restores it as a standalone
+  :class:`~magicsoup_tpu.World` + stepper aux — bit-identical to a solo
+  checkpoint of that world (pinned in tests/fast/test_fleet_guard.py),
+  because a lane's snapshot IS a solo ``snapshot_run`` (the flush
+  checks the lane out of the stack first).
+"""
+from __future__ import annotations
+
+from magicsoup_tpu.guard.checkpoint import (
+    CheckpointManager,
+    read_checkpoint,
+    write_checkpoint,
+)
+from magicsoup_tpu.guard.errors import CheckpointError
+from magicsoup_tpu.guard.resume import (
+    restore_run_payload,
+    restore_stepper,
+    snapshot_run,
+)
+
+__all__ = [
+    "FLEET_FORMAT",
+    "restore_fleet",
+    "restore_world",
+    "save_fleet",
+    "snapshot_fleet",
+]
+
+FLEET_FORMAT = "magicsoup_tpu.fleet.run/1"
+
+
+def snapshot_fleet(scheduler) -> dict:
+    """Flush every lane (checking them out of the group stacks) and
+    capture one single-run payload per world."""
+    runs = [snapshot_run(lane.world, lane) for lane in scheduler.lanes]
+    return {"format": FLEET_FORMAT, "runs": runs}
+
+
+def save_fleet(target, scheduler, *, step: int = 0, meta: dict | None = None):
+    """Atomically write the whole fleet as ONE verified checkpoint.
+
+    ``target`` is a :class:`~magicsoup_tpu.guard.CheckpointManager`
+    (step-indexed rolling retention) or a path to a single ``.msck``
+    file.  Returns the written path."""
+    payload = snapshot_fleet(scheduler)
+    meta = {
+        **(meta or {}),
+        "format": FLEET_FORMAT,
+        "worlds": len(payload["runs"]),
+    }
+    if isinstance(target, CheckpointManager):
+        return target.save(payload, step=step, meta=meta)
+    return write_checkpoint(target, payload, meta=meta)
+
+
+def _load(source) -> tuple[dict, dict]:
+    if isinstance(source, CheckpointManager):
+        payload, meta, _path = source.load_latest()
+    else:
+        payload, meta = read_checkpoint(source)
+    if not isinstance(payload, dict) or payload.get("format") != FLEET_FORMAT:
+        raise CheckpointError(
+            f"checkpoint payload is not a {FLEET_FORMAT} fleet snapshot "
+            f"(got {type(payload).__name__}"
+            + (
+                f" with format={payload.get('format')!r})"
+                if isinstance(payload, dict)
+                else ")"
+            ),
+            check="format",
+        )
+    return payload, meta
+
+
+def restore_world(source, index: int = 0, *, audit: bool = False) -> tuple:
+    """Restore ONE world out of a fleet checkpoint as a standalone run;
+    returns ``(world, stepper_aux, meta)`` exactly like
+    :func:`magicsoup_tpu.guard.restore_run` — construct a stepper with
+    the same kwargs and hand both to ``guard.restore_stepper`` (or keep
+    driving it with the classic API)."""
+    payload, meta = _load(source)
+    runs = payload["runs"]
+    if not -len(runs) <= index < len(runs):
+        raise CheckpointError(
+            f"fleet checkpoint holds {len(runs)} worlds; index {index} "
+            "is out of range",
+            check="index",
+        )
+    world, aux = restore_run_payload(runs[index], audit=audit)
+    return world, aux, meta
+
+
+def restore_fleet(
+    source, scheduler, stepper_kwargs, *, audit: bool = False
+) -> tuple[list, dict]:
+    """Rebuild every world of a fleet checkpoint into ``scheduler``.
+
+    ``stepper_kwargs`` is the ctor kwargs dict each lane was originally
+    built with (or a callable ``index -> kwargs`` when lanes differ) —
+    the same same-kwargs contract as ``guard.restore_stepper``, which
+    refuses on any trajectory-determining mismatch.  Returns the list
+    of admitted lanes (in checkpoint order) and the checkpoint meta."""
+    payload, meta = _load(source)
+    lanes = []
+    for i, run in enumerate(payload["runs"]):
+        world, aux = restore_run_payload(run, audit=audit)
+        kwargs = (
+            stepper_kwargs(i)
+            if callable(stepper_kwargs)
+            else dict(stepper_kwargs)
+        )
+        lane = scheduler.admit(world, **kwargs)
+        restore_stepper(lane, aux)
+        lanes.append(lane)
+    return lanes, meta

@@ -1,0 +1,487 @@
+"""
+FleetScheduler: admit/retire worlds dynamically, pack same-rung worlds
+into shared compiled variants, and step the whole fleet with ONE
+dispatch + ONE fetch per group per megastep.
+
+Grouping
+    Worlds are bucketed by **capacity rung** — the tuple of every
+    shape/static that feeds the compiled fleet program (state and
+    constant leaf shapes, spawn/push blocks, megastep ``k``, division
+    budget cap, det/pallas flags).  Each rung owns one group with a
+    power-of-two number of slots; admitting a world into a rung whose
+    group has a free slot changes NO program shape, so a warm rung
+    admits with **zero new compiles** (pinned via ``analysis.runtime``
+    compile counters in tests/fast/test_fleet.py).  A full group
+    doubles its slot count — that is a new shape and recompiles, the
+    one documented admission cliff.
+
+Stepping
+    ``step()`` runs every lane's solo ``_prepare_dispatch`` (all host
+    decisions — spawn batches, push rides, compaction, growth — are the
+    UNCHANGED solo code paths), re-buckets lanes whose rung changed,
+    unifies token capacities across each group (grow-only, so solo
+    trajectories are preserved — capacity invariance is pinned by the
+    kinetics tests), stacks the planned batches, and dispatches one
+    fleet program per group.  All member lanes share one physical fetch
+    of the batched ``(B, k, record)`` output; each lane replays its own
+    slice through the unchanged solo replay.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from magicsoup_tpu.fleet.batch import (
+    extract_world,
+    fleet_step,
+    insert_world,
+    lane_consts,
+    stack_worlds,
+    zeros_world_like,
+)
+from magicsoup_tpu.fleet.lanes import FleetLane
+from magicsoup_tpu.stepper import _LazyFetch
+
+__all__ = ["FleetScheduler"]
+
+_OOB_ROW = np.iinfo(np.int32).max
+
+
+class _SharedFetch:
+    """ONE physical D2H fetch of a group's batched step record, shared
+    by every member lane — the whole fleet pays a single transfer per
+    megastep (the fetch-census test pins this)."""
+
+    def __init__(self, fut):
+        self._fut = fut
+        self._value = None
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._value is not None or self._fut.done()
+
+    def result(self, timeout=None):
+        with self._lock:
+            if self._value is None:
+                self._value = np.asarray(self._fut.result(timeout=timeout))
+                self._fut = None  # drop the device buffer reference
+            return self._value
+
+
+class _SliceFetch:
+    """A lane's view of the shared fetch: ``result()`` is that world's
+    ``(k, record)`` slice of the batched record."""
+
+    __slots__ = ("_shared", "_slot")
+
+    def __init__(self, shared: _SharedFetch, slot: int):
+        self._shared = shared
+        self._slot = slot
+
+    def done(self) -> bool:
+        return self._shared.done()
+
+    def result(self, timeout=None):
+        return self._shared.result(timeout=timeout)[self._slot]
+
+
+def _rung_key(lane: FleetLane) -> tuple:
+    """Everything that feeds the compiled fleet program's shape/static
+    signature.  Token capacities are deliberately EXCLUDED — they are
+    unified per group (grow-only), so worlds whose kinetics grew at
+    different times still share one program."""
+    state_sig = tuple(
+        (tuple(l.shape), str(l.dtype))
+        for l in jax.tree_util.tree_leaves(lane._state)
+    )
+    # constant shapes EXCLUDING tables: table leaves are token-capacity
+    # shaped and may be regrown; they are checked at stack time instead
+    c = lane_consts(lane)
+    const_sig = tuple(
+        (tuple(l.shape), str(l.dtype))
+        for l in jax.tree_util.tree_leaves(c._replace(tables=()))
+    )
+    return (
+        state_sig,
+        const_sig,
+        lane.spawn_block,
+        lane.push_block,
+        lane.megastep,
+        lane.max_divisions,
+        lane.n_rounds,
+        bool(lane.world.deterministic),
+        bool(lane.world.use_pallas),
+    )
+
+
+class _FleetGroup:
+    """One capacity rung's stacked program state."""
+
+    def __init__(self, key: tuple, block: int):
+        self.key = key
+        self.slots: list[FleetLane | None] = [None] * block
+        self.fstate = None
+        self.fparams = None
+        self.consts = None
+        self.consts_ids: tuple | None = None
+        self.maxp = 0
+        self.maxd = 0
+        self.dirty = True  # full restack needed before next dispatch
+        self.warm: set[tuple] = set()
+        self.empty_spawn: dict[tuple, Any] = {}
+        self.empty_push: dict[tuple, Any] = {}
+        self.budget_cache: dict[tuple, Any] = {}
+        self.compact_cache: dict[tuple, Any] = {}
+
+    def members(self) -> list[tuple[int, FleetLane]]:
+        return [
+            (i, lane) for i, lane in enumerate(self.slots) if lane is not None
+        ]
+
+
+class FleetScheduler:
+    """Run B independent worlds as one compiled program per capacity
+    rung.  ``admit`` wraps a :class:`~magicsoup_tpu.World` in a
+    :class:`FleetLane`; ``step`` advances every admitted world by its
+    ``megastep`` with one dispatch and one fetch per group.
+
+    Parameters:
+        block: Initial slot count of a new group (power of two).  Spare
+            slots are what make admission free — a group only recompiles
+            when it outgrows its block and doubles.
+    """
+
+    def __init__(self, *, block: int = 4):
+        if block < 1:
+            raise ValueError("block must be >= 1")
+        self.block = 1 << (int(block) - 1).bit_length()  # round up to pow2
+        self.lanes: list[FleetLane] = []
+        self._groups: dict[tuple, _FleetGroup] = {}
+
+    # ------------------------------------------------------------ #
+    # membership                                                   #
+    # ------------------------------------------------------------ #
+
+    def admit(self, world, **stepper_kwargs) -> FleetLane:
+        """Wrap ``world`` in a :class:`FleetLane` and join the fleet.
+        Placement into a rung group happens at the next ``step()``."""
+        if getattr(world, "_mesh", None) is not None:
+            raise ValueError(
+                "fleet worlds must be single-device; shard the WORLD axis "
+                "instead (magicsoup_tpu.fleet.sharding)"
+            )
+        lane = FleetLane(world, **stepper_kwargs)
+        lane._fleet = self
+        self.lanes.append(lane)
+        return lane
+
+    def retire(self, lane: FleetLane) -> FleetLane:
+        """Remove ``lane`` from the fleet (its slot is restacked to
+        zeros) and return it as a standalone stepper — ``lane.step()``
+        works solo afterwards, no state is lost."""
+        if lane._fleet is not self:
+            raise ValueError("lane is not managed by this scheduler")
+        if lane._fleet_resident:
+            self._checkout(lane)
+        if lane._fleet_slot is not None:
+            group, slot = lane._fleet_slot
+            group.slots[slot] = None
+            group.dirty = True
+            group.consts_ids = None
+            lane._fleet_slot = None
+            if not group.members():
+                self._groups.pop(group.key, None)
+        self.lanes.remove(lane)
+        lane._fleet = None
+        return lane
+
+    # ------------------------------------------------------------ #
+    # stepping                                                     #
+    # ------------------------------------------------------------ #
+
+    def step(self) -> None:
+        """One fleet megastep: every world advances ``megastep`` fused
+        steps.  One dispatch + one fetch per rung group."""
+        plans = {}
+        for lane in list(self.lanes):
+            plans[id(lane)] = lane._prepare_dispatch()
+        self._place()
+        for group in list(self._groups.values()):
+            if group.members():
+                self._dispatch_group(group, plans)
+
+    def drain(self) -> None:
+        """Block until every lane's dispatched steps are replayed."""
+        for lane in self.lanes:
+            lane.drain()
+
+    def flush(self) -> None:
+        """Drain + sync every lane's ``World`` (checks all lanes out of
+        the stacks; they are re-admitted at the next ``step``)."""
+        for lane in self.lanes:
+            lane.flush()
+
+    # ------------------------------------------------------------ #
+    # placement                                                    #
+    # ------------------------------------------------------------ #
+
+    def _place(self) -> None:
+        for lane in self.lanes:
+            key = _rung_key(lane)
+            if lane._fleet_slot is not None:
+                group, slot = lane._fleet_slot
+                if group.key == key:
+                    continue
+                # rung changed (capacity growth, flag flip): leave the
+                # old group — its stack restacks without this lane
+                if lane._fleet_resident:
+                    self._checkout(lane)
+                group.slots[slot] = None
+                group.dirty = True
+                group.consts_ids = None
+                lane._fleet_slot = None
+                if not group.members():
+                    self._groups.pop(group.key, None)
+            self._assign(lane, key)
+
+    def _assign(self, lane: FleetLane, key: tuple) -> None:
+        group = self._groups.get(key)
+        if group is None:
+            group = _FleetGroup(key, self.block)
+            self._groups[key] = group
+        if None not in group.slots:
+            # the documented admission cliff: a full group doubles its
+            # slot count — new shapes, one recompile for the whole rung
+            group.slots.extend([None] * len(group.slots))
+            group.dirty = True
+            group.warm.clear()
+            group.empty_spawn.clear()
+            group.empty_push.clear()
+            group.budget_cache.clear()
+            group.compact_cache.clear()
+        slot = group.slots.index(None)
+        group.slots[slot] = lane
+        lane._fleet_slot = (group, slot)
+        lane._fleet_resident = False
+        group.consts_ids = None  # membership changed -> restack consts
+
+    # ------------------------------------------------------------ #
+    # checkout / restack                                           #
+    # ------------------------------------------------------------ #
+
+    def _checkout(self, lane: FleetLane) -> None:
+        group, slot = lane._fleet_slot
+        lane._state = extract_world(group.fstate, slot)
+        lane.kin.params = extract_world(group.fparams, slot)
+        lane._fleet_resident = False
+
+    def _restack(self, group: _FleetGroup) -> None:
+        """Rebuild the group's stacked state/params from its member
+        lanes (zeros in empty slots).  Used for every membership or
+        shape change — ONE program regardless of which slot changed, so
+        a warm rung's restack never compiles."""
+        members = group.members()
+        # residents' truth lives in the old stack — pull it back first
+        for _, lane in members:
+            if lane._fleet_resident:
+                self._checkout(lane)
+        for _, lane in members:
+            lane.kin.ensure_token_limits(group.maxp, group.maxd)
+        _, first = members[0]
+        zs = zeros_world_like(first._state)
+        zp = zeros_world_like(first.kin.params)
+        group.fstate = stack_worlds(
+            [l._state if l is not None else zs for l in group.slots]
+        )
+        group.fparams = stack_worlds(
+            [l.kin.params if l is not None else zp for l in group.slots]
+        )
+        for _, lane in members:
+            lane._fleet_resident = True
+        group.dirty = False
+        # warm the checkout AND re-admit programs for this shape NOW:
+        # a later admission/checkout must not be the first extract or
+        # insert at these shapes (results discarded — pure programs)
+        insert_world(group.fstate, 0, extract_world(group.fstate, 0))
+        insert_world(group.fparams, 0, extract_world(group.fparams, 0))
+
+    def _ensure_stacked(self, group: _FleetGroup) -> None:
+        members = group.members()
+        maxp = max(l.kin.max_proteins for _, l in members)
+        maxd = max(l.kin.max_doms for _, l in members)
+        if maxp > group.maxp or maxd > group.maxd:
+            # token capacities are grow-only and growth is trajectory
+            # invariant; the params shapes change, so restack
+            group.maxp, group.maxd = max(group.maxp, maxp), max(
+                group.maxd, maxd
+            )
+            group.dirty = True
+        if group.dirty:
+            self._restack(group)
+        else:
+            for slot, lane in members:
+                if not lane._fleet_resident:
+                    lane.kin.ensure_token_limits(group.maxp, group.maxd)
+                    group.fstate = insert_world(group.fstate, slot, lane._state)
+                    group.fparams = insert_world(
+                        group.fparams, slot, lane.kin.params
+                    )
+                    lane._fleet_resident = True
+        ids = tuple(
+            (id(lane), id(lane.kin.tables)) if lane is not None else None
+            for lane in group.slots
+        )
+        if group.consts is None or ids != group.consts_ids:
+            _, first = members[0]
+            zc = zeros_world_like(lane_consts(first))
+            group.consts = stack_worlds(
+                [
+                    lane_consts(l) if l is not None else zc
+                    for l in group.slots
+                ]
+            )
+            group.consts_ids = ids
+
+    # ------------------------------------------------------------ #
+    # batched dispatch                                             #
+    # ------------------------------------------------------------ #
+
+    def _dispatch_group(self, group: _FleetGroup, plans: dict) -> None:
+        import time as _time
+
+        self._ensure_stacked(group)
+        members = group.members()
+        _, first = members[0]
+        B = len(group.slots)
+        cap = first._cap
+        sb, pb = first.spawn_block, first.push_block
+        maxp, maxd = group.maxp, group.maxd
+
+        # ---- stacked spawn/push uploads (one H2D each, cached when
+        # every lane is idle on that input — mirrors the solo
+        # _empty_spawn/_empty_push caching) ----
+        lane_plans = {slot: plans[id(l)] for slot, l in members}
+        if any(p.spawn_entries is not None for p in lane_plans.values()):
+            dense_pad = np.zeros((B, sb, maxp, maxd, 5), dtype=np.int16)
+            valid_pad = np.zeros((B, sb), dtype=bool)
+            for slot, lane in members:
+                plan = lane_plans[slot]
+                if plan.spawn_entries is None:
+                    continue
+                dense = lane.world.phenotypes.dense_rows(
+                    plan.spawn_entries, maxp, maxd
+                )
+                dense_pad[slot, : len(plan.spawn)] = dense
+                valid_pad[slot, : len(plan.spawn)] = True
+                lane.telemetry.note(
+                    "spawn", _time.perf_counter() - plan.t_spawn0
+                )
+            spawn_dense = jax.device_put(dense_pad)
+            spawn_valid = jax.device_put(valid_pad)
+        else:
+            ck = (B, sb, maxp, maxd)
+            if ck not in group.empty_spawn:
+                group.empty_spawn[ck] = (
+                    jax.device_put(
+                        np.zeros((B, sb, maxp, maxd, 5), dtype=np.int16)
+                    ),
+                    jax.device_put(np.zeros((B, sb), dtype=bool)),
+                )
+            spawn_dense, spawn_valid = group.empty_spawn[ck]
+        if any(p.ride is not None for p in lane_plans.values()):
+            push_pad = np.zeros((B, pb, maxp, maxd, 5), dtype=np.int16)
+            rows_pad = np.full((B, pb), _OOB_ROW, dtype=np.int32)
+            for slot, lane in members:
+                plan = lane_plans[slot]
+                if plan.ride is None:
+                    continue
+                entries, rows = plan.ride
+                with lane.telemetry.span("push"):
+                    dense = lane.world.phenotypes.dense_rows(
+                        entries, maxp, maxd
+                    )
+                    push_pad[slot, : len(rows)] = dense
+                    # same OOB padding value the solo densify uses
+                    rows_pad[slot] = cap
+                    rows_pad[slot, : len(rows)] = rows
+            push_dense = jax.device_put(push_pad)
+            push_rows = jax.device_put(rows_pad)
+        else:
+            ck = (B, pb, maxp, maxd)
+            if ck not in group.empty_push:
+                group.empty_push[ck] = (
+                    jax.device_put(
+                        np.zeros((B, pb, maxp, maxd, 5), dtype=np.int16)
+                    ),
+                    jax.device_put(np.full((B, pb), _OOB_ROW, dtype=np.int32)),
+                )
+            push_dense, push_rows = group.empty_push[ck]
+        for slot, lane in members:
+            lane.telemetry.note(
+                "param_assembly",
+                _time.perf_counter() - lane_plans[slot].t_asm0,
+            )
+
+        budgets = tuple(
+            lane_plans[i].div_budget if l is not None else 0
+            for i, l in enumerate(group.slots)
+        )
+        dev_budget = group.budget_cache.get(budgets)
+        if dev_budget is None:
+            if len(group.budget_cache) > 256:
+                group.budget_cache.clear()
+            dev_budget = jax.device_put(np.asarray(budgets, dtype=np.int32))
+            group.budget_cache[budgets] = dev_budget
+        compacts = tuple(
+            bool(lane_plans[i].compact) if l is not None else False
+            for i, l in enumerate(group.slots)
+        )
+        do_compact = group.compact_cache.get(compacts)
+        if do_compact is None:
+            if len(group.compact_cache) > 256:
+                group.compact_cache.clear()
+            do_compact = jax.device_put(np.asarray(compacts, dtype=bool))
+            group.compact_cache[compacts] = do_compact
+
+        vkey = (B, cap, maxp, maxd)
+        cold = vkey not in group.warm
+        t_dispatch0 = _time.perf_counter()
+        group.fstate, group.fparams, fouts = fleet_step(
+            group.fstate,
+            group.fparams,
+            group.consts,
+            spawn_dense,
+            spawn_valid,
+            push_dense,
+            push_rows,
+            dev_budget,
+            do_compact,
+            det=first.world.deterministic,
+            max_div=first.max_divisions,
+            n_rounds=first.n_rounds,
+            k=first.megastep,
+            use_pallas=first.world.use_pallas,
+        )
+        t_dispatched = _time.perf_counter()
+        group.warm.add(vkey)
+
+        # one fetch for the whole group; lanes replay their slices
+        fut = (
+            first._fetcher.submit(fouts)
+            if first._fetcher is not None
+            else _LazyFetch(fouts)
+        )
+        shared = _SharedFetch(fut)
+        for slot, lane in members:
+            lane._commit_dispatch(
+                lane_plans[slot],
+                _SliceFetch(shared, slot),
+                q=cap,
+                cold=cold,
+                t_dispatch0=t_dispatch0,
+                t_dispatched=t_dispatched,
+                extra_row={"fleet_slot": slot, "fleet_size": B},
+            )

@@ -1,0 +1,125 @@
+"""
+World-axis sharding: data-parallel fleets over a device mesh.
+
+Worlds are independent, so the fleet's world axis shards with NO
+collectives: a 1D ``P("world")`` mesh gives every device its own
+contiguous block of worlds, each block stepped by the same local scan
+the single-device fleet program runs (``shard_map`` over
+:func:`magicsoup_tpu.fleet.batch.fleet_step_program`).  This composes
+with, but is distinct from, the cell/row sharding of
+:mod:`magicsoup_tpu.parallel.tiled` — a fleet world must itself be
+single-device (the scheduler enforces it); scale-out for fleets is MORE
+WORLDS PER MESH, not bigger worlds.
+
+In det mode the sharded fleet step is bit-identical to the unsharded
+one (pinned in tests/fast/test_fleet.py): no cross-world reduction
+exists anywhere in the program, so placement cannot reorder any float
+work.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pragma: no cover - version-dependent import
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+from jax.experimental import enable_x64 as _enable_x64
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from magicsoup_tpu.fleet.batch import _donate_step_buffers, fleet_step_program
+
+__all__ = [
+    "WORLD_AXIS",
+    "make_world_mesh",
+    "shard_fleet",
+    "sharded_fleet_step",
+]
+
+WORLD_AXIS = "world"
+
+
+def make_world_mesh(n_devices: int | None = None) -> Mesh:
+    """1D device mesh over the fleet's world axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (WORLD_AXIS,))
+
+
+def world_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis ``P("world")`` placement for every stacked leaf."""
+    return NamedSharding(mesh, P(WORLD_AXIS))
+
+
+def shard_fleet(tree, mesh: Mesh):
+    """Place a stacked fleet pytree world-sharded on ``mesh`` (the
+    leading axis of every leaf must be divisible by the device count)."""
+    sh = world_sharding(mesh)
+    return jax.tree_util.tree_map(lambda t: jax.device_put(t, sh), tree)
+
+
+@functools.lru_cache(maxsize=None)
+def _build(mesh: Mesh, det, max_div, n_rounds, k, use_pallas, donate):
+    spec = P(WORLD_AXIS)
+
+    def body(*args):
+        state, params, outs = fleet_step_program(
+            *args,
+            det=det,
+            max_div=max_div,
+            n_rounds=n_rounds,
+            k=k,
+            use_pallas=use_pallas,
+        )
+        # the x64 tracing scope below widens the packed record's counter
+        # lanes to i64; values are identical (int arithmetic is exact),
+        # so pin the wire dtype back to the solo record's
+        return state, params, outs.astype(jnp.int32)
+
+    mapped = shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
+    if donate:
+        fn = jax.jit(mapped, donate_argnums=(0, 1))
+    else:
+        fn = jax.jit(mapped)  # graftlint: disable=GL006 CPU twin of the sharded fleet step; donation races XLA:CPU async execution
+
+    @functools.wraps(fn)
+    def call(*args):
+        # trace AND lower inside the x64 scope: shard_map re-canonicalizes
+        # body avals at lowering time, so det mode's f64 reduction trees
+        # (detmath.sum_axis) produce inconsistent IR unless the scope is
+        # still open — plain jit only canonicalizes literals (see the
+        # traced_zeros32 notes); shard_map verifies the whole module
+        with _enable_x64(True):
+            return fn(*args)
+
+    return call
+
+
+def sharded_fleet_step(
+    mesh: Mesh,
+    *,
+    det: bool,
+    max_div: int,
+    n_rounds: int,
+    k: int,
+    use_pallas: bool = False,
+):
+    """A jitted world-sharded fleet step for ``mesh`` with the given
+    statics — same signature as the positional part of
+    :func:`magicsoup_tpu.fleet.batch.fleet_step` (9 stacked inputs,
+    world axis divisible by the mesh's device count).  Compiled
+    programs are cached per (mesh, statics)."""
+    return _build(
+        mesh,
+        bool(det),
+        int(max_div),
+        int(n_rounds),
+        int(k),
+        bool(use_pallas),
+        _donate_step_buffers(),
+    )

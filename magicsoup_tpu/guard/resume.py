@@ -199,6 +199,19 @@ def restore_run(source, *, mesh=None, audit: bool = False) -> tuple:
         payload, meta, _path = source.load_latest()
     else:
         payload, meta = read_checkpoint(source)
+    world, aux = restore_run_payload(payload, mesh=mesh, audit=audit)
+    return world, aux, meta
+
+
+def restore_run_payload(payload, *, mesh=None, audit: bool = False) -> tuple:
+    """Restore a single run from an in-memory snapshot payload (the dict
+    :func:`snapshot_run` produces); returns ``(world, stepper_aux)``.
+
+    The verification/IO layers stay with the caller — this is the
+    payload-level half of :func:`restore_run`, split out so the fleet
+    checkpoint format (``magicsoup_tpu.fleet.persist``), which nests one
+    run payload per world inside ONE verified file, can reuse the exact
+    single-run restore semantics per world."""
     if not isinstance(payload, dict) or payload.get("format") != RUN_FORMAT:
         raise CheckpointError(
             f"checkpoint payload is not a {RUN_FORMAT} run snapshot "
@@ -227,7 +240,7 @@ def restore_run(source, *, mesh=None, audit: bool = False) -> tuple:
         from magicsoup_tpu.check import assert_consistent
 
         assert_consistent(world)
-    return world, aux, meta
+    return world, aux
 
 
 def restore_stepper(stepper, aux: dict) -> None:

@@ -55,6 +55,33 @@ from magicsoup_tpu.ops.params import (
 )
 
 
+def _grow_params(params: CellParams, *, cp: tuple, cps: tuple) -> CellParams:
+    """Pad every parameter tensor up to the target capacities.  Module
+    level + static targets so the compiled pad program is shared across
+    instances — a fleet admitting a world through the same capacity step
+    its peers took must hit a warm cache, not recompile per lane."""
+
+    def g(o: jax.Array, tgt: tuple) -> jax.Array:
+        return jnp.pad(o, [(0, t - d) for t, d in zip(tgt, o.shape)])
+
+    return CellParams(
+        Ke=g(params.Ke, cp),
+        Kmf=g(params.Kmf, cp),
+        Kmb=g(params.Kmb, cp),
+        Kmr=g(params.Kmr, cps),
+        Vmax=g(params.Vmax, cp),
+        N=g(params.N, cps),
+        Nf=g(params.Nf, cps),
+        Nb=g(params.Nb, cps),
+        A=g(params.A, cps),
+    )
+
+
+# capacity regrow runs once per capacity step (capacity never shrinks),
+# not once per simulation step — graftlint: disable=GL002
+_grow_params_jit = jax.jit(_grow_params, static_argnames=("cp", "cps"))
+
+
 def _gather_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
     """Concatenated ``arange(start, start + count)`` runs — the
     vectorized flat-buffer row gather of the rung-grouped assembly (no
@@ -426,36 +453,25 @@ class Kinetics:
             self.max_cells = c
             self.max_proteins = p
             return
-        # grow-only (ensure_capacity never shrinks): one fused+donated pad
+        # grow-only (ensure_capacity never shrinks): one fused pad
         # program instead of 9 eager slice/scatter pairs — growth used to
-        # cost seconds of eager compiles per pow2 step
+        # cost seconds of eager compiles per pow2 step.  Donation would
+        # be useless — the padded outputs are strictly larger than the
+        # inputs, so no buffer can be reused.
         s = self.n_signals
-
-        def _grow(params: CellParams) -> CellParams:
-            def g(o: jax.Array, tgt: tuple) -> jax.Array:
-                return jnp.pad(o, [(0, t - d) for t, d in zip(tgt, o.shape)])
-
-            cp, cps = (c, p), (c, p, s)
-            return CellParams(
-                Ke=g(params.Ke, cp),
-                Kmf=g(params.Kmf, cp),
-                Kmb=g(params.Kmb, cp),
-                Kmr=g(params.Kmr, cps),
-                Vmax=g(params.Vmax, cp),
-                N=g(params.N, cps),
-                Nf=g(params.Nf, cps),
-                Nb=g(params.Nb, cps),
-                A=g(params.A, cps),
+        if self.cell_sharding is None:
+            # module-level jit: the pad program is shared across
+            # Kinetics instances (zero-compile fleet admission)
+            self.params = _grow_params_jit(old, cp=(c, p), cps=(c, p, s))
+        else:
+            # sharded resize is per-mesh and rare; keep the out_shardings
+            # bound locally — graftlint: disable=GL002
+            fn = jax.jit(
+                _grow_params,
+                static_argnames=("cp", "cps"),
+                out_shardings=CellParams(*([self.cell_sharding] * 9)),
             )
-
-        # note: donation would be useless here — the padded outputs are
-        # strictly larger than the inputs, so no buffer can be reused
-        kwargs = {}
-        if self.cell_sharding is not None:
-            kwargs["out_shardings"] = CellParams(*([self.cell_sharding] * 9))
-        # capacity regrow runs once per capacity step (capacity never
-        # shrinks), not once per simulation step — graftlint: disable=GL002
-        self.params = jax.jit(_grow, **kwargs)(old)
+            self.params = fn(old, cp=(c, p), cps=(c, p, s))
         self.max_cells = c
         self.max_proteins = p
 

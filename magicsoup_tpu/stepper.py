@@ -1008,6 +1008,32 @@ class _Pending(NamedTuple):
     k: int  # fused steps in this dispatch (records in ``out``)
 
 
+class _DispatchPlan(NamedTuple):
+    """Host-side decisions for ONE dispatch, produced by
+    :meth:`PipelinedStepper._prepare_dispatch` before any device input
+    is densified.
+
+    The prepare/finalize/commit split exists for the fleet coordinator
+    (``magicsoup_tpu.fleet``): it runs ``_prepare_dispatch`` on every
+    lane FIRST (so token-capacity growth across the group settles
+    before any dense tensor is built), stacks the planned batches into
+    one batched upload, dispatches once, and hands each lane its slice
+    of the shared fetch via ``_commit_dispatch``.  The solo ``step()``
+    recomposes the same three pieces back-to-back.
+    """
+
+    t_start: float  # perf_counter at step entry (step_ms accounting)
+    fetch0: float  # _fetch_acc at step entry (fetch_ms accounting)
+    spawn: list  # [(genome, label)] taken off the spawn queue
+    spawn_entries: Any  # phenotype-cache entries for ``spawn`` (or None)
+    ride: Any  # (entries, rows) push refresh riding this dispatch
+    compact: bool  # this dispatch's final record compacts
+    div_budget: int  # per-step division budget (quantized int)
+    k: int  # fused steps in this dispatch
+    t_asm0: float  # param_assembly span start
+    t_spawn0: float  # spawn span start
+
+
 class PipelinedStepper:
     """
     Pipelined driver for the canonical selection workload over a
@@ -1453,7 +1479,88 @@ class PipelinedStepper:
 
     def step(self) -> None:
         """Dispatch one workload step (``megastep`` fused device steps)
-        and replay any arrived outputs."""
+        and replay any arrived outputs.
+
+        Internally this is ``_prepare_dispatch`` (host decisions) →
+        ``_finalize_inputs`` (densify to device buffers) → the dispatch
+        itself → ``_commit_dispatch`` (pending bookkeeping, stats,
+        telemetry).  The fleet coordinator reuses the same pieces around
+        ONE batched dispatch for B worlds (``magicsoup_tpu.fleet``).
+        """
+        import time as _time
+
+        plan = self._prepare_dispatch()
+        (
+            spawn_dense,
+            spawn_valid,
+            push_dense,
+            push_rows,
+            dev_budget,
+            q,
+        ) = self._finalize_inputs(plan)
+
+        cold = not self._warm_sched.is_warm(self._variant_key(q, plan.compact))
+        t_dispatch0 = _time.perf_counter()
+        step_fn = self._step_fn()
+        compact = plan.compact
+
+        def _dispatch():
+            # armed chaos faults fire BEFORE any buffer is touched, so a
+            # retried dispatch re-sends bit-identical inputs
+            if self._fault_dispatch > 0:
+                from magicsoup_tpu.guard.faults import consume_dispatch_fault
+
+                consume_dispatch_fault(self)
+            return step_fn(
+                self._state,
+                self.kin.params,
+                self._kernels_dev,
+                self._perm_dev,
+                self._degrad_dev,
+                self._mol_idx_dev,
+                self._kill_below_dev,
+                self._divide_above_dev,
+                self._divide_cost_dev,
+                dev_budget,
+                spawn_dense,
+                spawn_valid,
+                push_dense,
+                push_rows,
+                self._tables(),
+                self._abs_temp_dev,
+                det=self.world.deterministic,
+                max_div=self.max_divisions,
+                n_rounds=self.n_rounds,
+                compact=compact,
+                q=q,
+                use_pallas=self.world.use_pallas,
+            )
+
+        self._state, self.kin.params, out = self._dispatch_with_retry(
+            _dispatch
+        )
+        t_dispatched = _time.perf_counter()
+        self._note_warm(q, compact)
+        out_fut = (
+            self._fetcher.submit(out)
+            if self._fetcher is not None
+            else _LazyFetch(out)
+        )
+        self._commit_dispatch(
+            plan,
+            out_fut,
+            q=q,
+            cold=cold,
+            t_dispatch0=t_dispatch0,
+            t_dispatched=t_dispatched,
+        )
+
+    def _prepare_dispatch(self) -> _DispatchPlan:
+        """Host half of one dispatch: drain, growth/compaction decisions,
+        spawn/push batch selection, token-capacity growth — everything
+        that must settle BEFORE device inputs are densified.  Returns the
+        :class:`_DispatchPlan` consumed by ``_finalize_inputs`` (solo) or
+        the fleet coordinator's batched densify."""
         import time as _time
 
         t_start = _time.perf_counter()
@@ -1545,12 +1652,51 @@ class PipelinedStepper:
             self._push_queue = []
         for ent in (spawn_entries, ride[0] if ride else None):
             if ent:
-                self.kin.ensure_token_limits(
+                self._grow_tokens(
                     max(e.n_prots for e in ent),
                     max(e.max_doms for e in ent),
                 )
 
-        if has_spawn:
+        # Division budget is adaptive (recent demand x2) so the live-row
+        # bound stays tight; genuine demand spikes clamp for one step,
+        # are counted as drops, and raise the next estimate.  Quantized
+        # to 64 so the per-step scalar upload hits a small cache of
+        # device constants instead of paying its own transfer each step.
+        div_budget = int(
+            min(self.max_divisions, -(-(2 * g_est + 64) // 64) * 64)
+        )
+        return _DispatchPlan(
+            t_start=t_start,
+            fetch0=fetch0,
+            spawn=spawn,
+            spawn_entries=spawn_entries,
+            ride=ride,
+            compact=compact,
+            div_budget=div_budget,
+            k=self.megastep,
+            t_asm0=t_asm0,
+            t_spawn0=t_spawn0,
+        )
+
+    def _grow_tokens(self, n_prots: int, n_doms: int) -> None:
+        """Grow the kinetics token capacities for a planned batch.
+        Split out so fleet lanes can check their params out of the
+        group stack BEFORE the resize pads them (growing a stale copy
+        would be silently discarded at the next checkout)."""
+        self.kin.ensure_token_limits(n_prots, n_doms)
+
+    def _finalize_inputs(self, plan: _DispatchPlan):
+        """Densify the planned spawn/push batches at the CURRENT token
+        capacities into device buffers, fetch the cached division-budget
+        scalar, and pick the live-row prefix ``q`` — the device half of
+        a solo dispatch.  Fleet lanes skip this and densify at their
+        GROUP's unified capacities instead (fleet/scheduler.py)."""
+        import time as _time
+
+        spawn = plan.spawn
+        spawn_entries = plan.spawn_entries
+        ride = plan.ride
+        if spawn_entries is not None:
             dense = self.world.phenotypes.dense_rows(
                 spawn_entries, self.kin.max_proteins, self.kin.max_doms
             )
@@ -1562,7 +1708,9 @@ class PipelinedStepper:
             valid = np.zeros(self.spawn_block, dtype=bool)
             valid[: len(spawn)] = True
             spawn_valid = self._dev(valid)
-            self.telemetry.note("spawn", _time.perf_counter() - t_spawn0)
+            self.telemetry.note(
+                "spawn", _time.perf_counter() - plan.t_spawn0
+            )
         else:
             # cached all-zero device buffers: the spawn path always runs
             # (no extra compiled variant) but places nothing and scatters
@@ -1574,23 +1722,19 @@ class PipelinedStepper:
         else:
             push_dense, push_rows = self._empty_push()
         self.telemetry.note(
-            "param_assembly", _time.perf_counter() - t_asm0
+            "param_assembly", _time.perf_counter() - plan.t_asm0
         )
 
-        # Live-row prefix for this dispatch: an EXACT upper bound on the
-        # device's row count (replayed rows + each outstanding step's
-        # division budget + spawn batch), quantized — the integrator then
-        # skips the dead tail.  The division budget is adaptive (recent
-        # demand x2) so the bound stays tight; genuine demand spikes clamp
-        # for one step, are counted as drops, and raise the next estimate.
-        # quantized to 64 so the per-step scalar upload hits a small cache
-        # of device constants instead of paying its own transfer each step
-        div_budget = int(min(self.max_divisions, -(-(2 * g_est + 64) // 64) * 64))
+        div_budget = plan.div_budget
         dev_budget = self._budget_cache.get(div_budget)
         if dev_budget is None:
             dev_budget = self._dev(div_budget, jnp.int32)
             self._budget_cache[div_budget] = dev_budget
-        k = self.megastep
+        k = plan.k
+        # Live-row prefix for this dispatch: an EXACT upper bound on the
+        # device's row count (replayed rows + each outstanding step's
+        # division budget + spawn batch), quantized — the integrator then
+        # skips the dead tail.
         if self._mesh is not None:
             # the live-row prefix is a PREFIX slice of the cell-sharded
             # axis: any q < cap puts the whole prefix on the first tiles
@@ -1606,62 +1750,38 @@ class PipelinedStepper:
             for p in self._pending:
                 upper += p.div_budget + len(p.spawn_genomes)
             q = quantize_rows(upper, self._cap)
+        return spawn_dense, spawn_valid, push_dense, push_rows, dev_budget, q
 
-        cold = not self._warm_sched.is_warm(self._variant_key(q, compact))
-        t_dispatch0 = _time.perf_counter()
-        step_fn = self._step_fn()
+    def _commit_dispatch(
+        self,
+        plan: _DispatchPlan,
+        out_fut,
+        *,
+        q: int,
+        cold: bool,
+        t_dispatch0: float,
+        t_dispatched: float,
+        extra_row: dict | None = None,
+    ) -> None:
+        """Post-dispatch bookkeeping: append the pending replay, drain,
+        update stats/trace, and emit the graftscope dispatch row.  The
+        fleet coordinator calls this once per lane with that lane's
+        SLICE of the shared fleet fetch (``extra_row`` carries the
+        fleet slot/size annotations)."""
+        import time as _time
 
-        def _dispatch():
-            # armed chaos faults fire BEFORE any buffer is touched, so a
-            # retried dispatch re-sends bit-identical inputs
-            if self._fault_dispatch > 0:
-                from magicsoup_tpu.guard.faults import consume_dispatch_fault
-
-                consume_dispatch_fault(self)
-            return step_fn(
-                self._state,
-                self.kin.params,
-                self._kernels_dev,
-                self._perm_dev,
-                self._degrad_dev,
-                self._mol_idx_dev,
-                self._kill_below_dev,
-                self._divide_above_dev,
-                self._divide_cost_dev,
-                dev_budget,
-                spawn_dense,
-                spawn_valid,
-                push_dense,
-                push_rows,
-                self._tables(),
-                self._abs_temp_dev,
-                det=self.world.deterministic,
-                max_div=self.max_divisions,
-                n_rounds=self.n_rounds,
-                compact=compact,
-                q=q,
-                use_pallas=self.world.use_pallas,
-            )
-
-        self._state, self.kin.params, out = self._dispatch_with_retry(
-            _dispatch
-        )
-        t_dispatched = _time.perf_counter()
-        self._note_warm(q, compact)
+        compact = plan.compact
+        k = plan.k
         self._pending.append(
             _Pending(
-                out=(
-                    self._fetcher.submit(out)
-                    if self._fetcher is not None
-                    else _LazyFetch(out)
-                ),
-                spawn_genomes=[g for g, _ in spawn],
-                spawn_labels=[l for _, l in spawn],
+                out=out_fut,
+                spawn_genomes=[g for g, _ in plan.spawn],
+                spawn_labels=[l for _, l in plan.spawn],
                 compacted=compact,
                 # what the device saw: only DISPATCHED pushes — a batch
                 # still held in the compaction buffer is invisible to it
                 change_seq=self._dispatched_seq,
-                div_budget=k * div_budget,
+                div_budget=k * plan.div_budget,
                 k=k,
             )
         )
@@ -1676,24 +1796,24 @@ class PipelinedStepper:
         self.stats["cold_dispatches"] += cold
         # float ms accumulators (bench.py int-casts on report): per-step
         # int truncation would zero out sub-ms fetches
-        self.stats["fetch_ms"] += (self._fetch_acc - fetch0) * 1e3
+        self.stats["fetch_ms"] += (self._fetch_acc - plan.fetch0) * 1e3
         self.stats["dispatch_ms"] += (t_dispatched - t_dispatch0) * 1e3
-        self.stats["step_ms"] += (t_end - t_start) * 1e3
+        self.stats["step_ms"] += (t_end - plan.t_start) * 1e3
         if len(self.trace) >= 4096:
             del self.trace[:2048]
         self.trace.append(
             {
-                "t": t_end - t_start,
+                "t": t_end - plan.t_start,
                 "dispatch": t_dispatched - t_dispatch0,
-                "fetch": self._fetch_acc - fetch0,
+                "fetch": self._fetch_acc - plan.fetch0,
                 "q": q,
                 "rows": self._n_rows,
                 "alive": int(self._alive.sum()),
                 "cold": cold,
                 "compact": compact,
                 "k": k,
-                "push": 0 if ride is None else len(ride[1]),
-                "spawn": len(spawn),
+                "push": 0 if plan.ride is None else len(plan.ride[1]),
+                "spawn": len(plan.spawn),
                 "pend": len(self._pending),
             }
         )
@@ -1718,6 +1838,8 @@ class PipelinedStepper:
                 # JSONL is self-describing about the sharded topology
                 row["tiles"] = self._n_tiles
                 row["mesh_axis"] = str(self._mesh.axis_names[0])
+            if extra_row:
+                row.update(extra_row)
             rec.emit(row)
 
     # -------------------------------------------------------------- #

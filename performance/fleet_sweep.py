@@ -1,0 +1,149 @@
+"""
+graftfleet B x K sweep: B independent worlds stacked into one compiled
+program (``magicsoup_tpu.fleet``) timed across fleet sizes and megastep
+settings, one JSON line per (B, K) point.
+
+    python performance/fleet_sweep.py [--bs 1,4,16,64] [--ks 1,4]
+
+The headline number is PER-WORLD steps/s: ``dispatches * K`` simulation
+steps advance EVERY world of the fleet per measured window, so aggregate
+throughput is ``per_world * B``.  The fleet amortizes the fixed
+per-dispatch cost (host dispatch, device launch, the ONE shared D2H
+fetch per megastep) over B worlds — per-world steps/s at B=16 vs B=1 is
+the direct measurement of that amortization, and the number
+``scripts/summarize_capture.py`` folds into BASELINE.json under
+``published["fleet"]``.
+
+Worlds are chemistry-only (selection disabled) and identically
+constructed so all B share ONE capacity rung — a single compiled
+variant, a single group dispatch, zero admission compiles.  BENCH_NOTES
+records the measured sweep.
+"""
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", default="1,4,16,64", help="comma-separated fleet sizes")
+    ap.add_argument("--ks", default="1,4", help="comma-separated K values")
+    ap.add_argument("--n-cells", type=int, default=64)
+    ap.add_argument("--map-size", type=int, default=32)
+    ap.add_argument("--genome-size", type=int, default=300)
+    ap.add_argument("--warmup", type=int, default=4, help="warmup dispatches")
+    ap.add_argument(
+        "--steps", type=int, default=16, help="measured SIM steps per point"
+    )
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform pin ('' = whatever jax finds)",
+    )
+    args = ap.parse_args()
+    bs = sorted({int(b) for b in args.bs.split(",")})
+    ks = sorted({int(k) for k in args.ks.split(",")})
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from bench import _acquire_accel_lock
+
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    try:
+        _lock = _acquire_accel_lock(max_wait_s=600.0, platform=args.platform)
+    except TimeoutError as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "fleet sweep steps/sec",
+                    "error": f"accelerator lock contention: {exc}",
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(1)
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.fleet import FleetScheduler
+
+    mols = [
+        ms.Molecule("fsw-a", 10e3),
+        ms.Molecule("fsw-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+    def _world(seed):
+        w = ms.World(chemistry=chem, map_size=args.map_size, seed=seed)
+        # identical genome streams -> identical token caps -> one rung
+        rng = random.Random(args.seed)
+        w.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        return w
+
+    for k in ks:
+        for b in bs:
+            fleet = FleetScheduler(block=b)
+            for i in range(b):
+                fleet.admit(
+                    _world(args.seed + i),
+                    mol_name="fsw-atp",
+                    kill_below=-1.0,
+                    divide_above=1e30,
+                    divide_cost=0.0,
+                    target_cells=None,
+                    genome_size=args.genome_size,
+                    lag=1,
+                    megastep=k,
+                    p_mutation=0.0,
+                    p_recombination=0.0,
+                )
+            for _ in range(max(args.warmup, 2)):
+                fleet.step()
+            fleet.drain()
+            n_disp = max(1, -(-args.steps // k))
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                fleet.step()
+            fleet.drain()
+            dt = (time.perf_counter() - t0) / (n_disp * k)
+            fleet.flush()
+            print(
+                json.dumps(
+                    {
+                        "metric": (
+                            f"fleet B={b} K={k} per-world steps/sec "
+                            f"({args.n_cells} cells, {args.map_size}x"
+                            f"{args.map_size} map, {jax.default_backend()})"
+                        ),
+                        "value": round(1.0 / dt, 4),
+                        "unit": "steps/s",
+                        "fleet_size": b,
+                        "megastep": k,
+                        "dispatches": n_disp,
+                        "ms_per_step": round(dt * 1e3, 2),
+                        "aggregate_steps_per_s": round(b / dt, 4),
+                        "groups": len(fleet._groups),
+                        "backend": jax.default_backend(),
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

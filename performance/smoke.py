@@ -27,6 +27,13 @@ checkpoint + flushed telemetry, and a NaN injection / failed dispatch
 must trip the health sentinel / bounded retry.  ``--chaos-child`` is the
 internal per-scenario entry point those subprocesses use.
 
+``--fleet`` runs the graftfleet smoke (GATING): B=3 det-mode worlds
+across two capacity rungs stepped by the ``FleetScheduler`` — batched
+telemetry must validate (with per-world ``fleet_slot``/``fleet_size``
+lanes on every dispatch row), the warm steady state must pass
+``hot_path_guard(compile_budget=0)``, and the fetch census must show
+exactly ONE host fetch per rung group per megastep (no per-world D2H).
+
 ``--differential`` runs the graftcheck differential smoke (GATING): one
 seeded spawn/step/mutate/kill/divide/compact schedule driven through the
 classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
@@ -62,7 +69,14 @@ def main() -> None:
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument(
         "--chaos-child",
-        choices=("run", "resume", "sigterm", "faults"),
+        choices=(
+            "run",
+            "resume",
+            "sigterm",
+            "faults",
+            "fleet-run",
+            "fleet-resume",
+        ),
         default=None,
     )
     ap.add_argument("--chaos-dir", default="")
@@ -74,6 +88,8 @@ def main() -> None:
     ap.add_argument(
         "--differential-child", action="store_true", help=argparse.SUPPRESS
     )
+    # graftfleet smoke (see fleet_main below)
+    ap.add_argument("--fleet", action="store_true")
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
@@ -83,6 +99,8 @@ def main() -> None:
         return differential_child(args)
     if args.differential:
         return differential_main(args)
+    if args.fleet:
+        return fleet_main(args)
 
     import jax
 
@@ -246,7 +264,7 @@ def main() -> None:
 
 
 # --------------------------------------------------------------- chaos
-def _chaos_setup(args):
+def _chaos_setup(args, seed=None):
     """Deterministic tiny world for the chaos children (fixed seed)."""
     import random
 
@@ -257,8 +275,9 @@ def _chaos_setup(args):
         ms.Molecule("chs-atp", 8e3, half_life=100_000),
     ]
     chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
-    rng = random.Random(args.seed)
-    world = ms.World(chemistry=chem, map_size=args.map_size, seed=args.seed)
+    seed = args.seed if seed is None else seed
+    rng = random.Random(seed)
+    world = ms.World(chemistry=chem, map_size=args.map_size, seed=seed)
     world.spawn_cells(
         [
             ms.random_genome(s=args.genome_size, rng=rng)
@@ -268,12 +287,10 @@ def _chaos_setup(args):
     return world
 
 
-def _chaos_stepper(world, args, **overrides):
-    """Stepper with the smoke's default dynamics — every child (and the
-    resume path, whose config must MATCH the checkpoint) builds through
-    here so the kwargs cannot drift apart."""
-    import magicsoup_tpu as ms
-
+def _chaos_kw(args, **overrides) -> dict:
+    """The smoke's default stepper dynamics as a kwargs dict — shared
+    between the solo children and the fleet children (and the resume
+    paths, whose config must MATCH the checkpoint)."""
     kw = dict(
         mol_name="chs-atp",
         kill_below=0.1,
@@ -285,7 +302,15 @@ def _chaos_stepper(world, args, **overrides):
         megastep=args.megastep,
     )
     kw.update(overrides)
-    return ms.PipelinedStepper(world, **kw)
+    return kw
+
+
+def _chaos_stepper(world, args, **overrides):
+    """Stepper with the smoke's default dynamics — every child builds
+    through here so the kwargs cannot drift apart."""
+    import magicsoup_tpu as ms
+
+    return ms.PipelinedStepper(world, **_chaos_kw(args, **overrides))
 
 
 def _chaos_digest(world, st) -> str:
@@ -334,6 +359,17 @@ def _chaos_digest(world, st) -> str:
     for name in sorted(state):
         digest.update(name.encode())
         digest.update(hashlib.sha256(pickle.dumps(state[name])).digest())
+    return digest.hexdigest()
+
+
+def _fleet_digest(scheduler) -> str:
+    """One digest for the whole fleet: the per-lane full-state digests
+    combined in lane order (each lane digest flushes that lane)."""
+    import hashlib
+
+    digest = hashlib.sha256()
+    for lane in scheduler.lanes:
+        digest.update(_chaos_digest(lane.world, lane).encode())
     return digest.hexdigest()
 
 
@@ -489,6 +525,60 @@ def chaos_child(args) -> None:
                 f"chaos faults child FAILED: retries={retries} trips={trips}"
             )
 
+    elif mode == "fleet-run":
+        # a B=2 fleet with atomic whole-fleet checkpoints on the same
+        # cadence as the solo children; --kill-after SIGKILLs it
+        # mid-megastep like the solo victim
+        from magicsoup_tpu.fleet import FleetScheduler, save_fleet
+
+        fleet = FleetScheduler(block=2)
+        for j in range(2):
+            fleet.admit(_chaos_setup(args, seed=args.seed + j), **_chaos_kw(args))
+        written = 0
+        for i in range(args.total):
+            if i % args.ckpt_every == 0 and i > 0:
+                save_fleet(mgr, fleet, step=i)
+                written += 1
+                if args.kill_after and written >= args.kill_after:
+                    print(
+                        json.dumps({"marker": "checkpointed", "step": i}),
+                        flush=True,
+                    )
+                    for _ in range(1000):
+                        fleet.step()
+                    raise SystemExit(3)  # the parent failed to kill us
+            fleet.step()
+        print(
+            json.dumps(
+                {"digest": _fleet_digest(fleet), "steps": args.total}
+            ),
+            flush=True,
+        )
+
+    elif mode == "fleet-resume":
+        # restore the killed fleet's ATOMIC checkpoint (every world +
+        # every lane's aux from one file) and finish the schedule; the
+        # deep audit must pass on every restored world
+        from magicsoup_tpu.fleet import FleetScheduler, restore_fleet, save_fleet
+
+        fleet = FleetScheduler(block=2)
+        _lanes, meta = restore_fleet(mgr, fleet, _chaos_kw(args), audit=True)
+        start = int(meta["step"])
+        for i in range(start, args.total):
+            if i % args.ckpt_every == 0 and i > start:
+                save_fleet(mgr, fleet, step=i)
+            fleet.step()
+        print(
+            json.dumps(
+                {
+                    "digest": _fleet_digest(fleet),
+                    "from_step": start,
+                    "worlds": int(meta["worlds"]),
+                }
+            ),
+            flush=True,
+        )
+
 
 def differential_child(args) -> None:
     """All four execution paths of the graftcheck differential schedule,
@@ -575,6 +665,142 @@ def differential_main(args) -> None:
             f"differential smoke FAILED: child rc={child.returncode}\n"
             + (child.stderr or "")[-2000:]
         )
+
+
+def fleet_main(args) -> None:
+    """GATING graftfleet smoke: B=3 det-mode worlds across two capacity
+    rungs stepped by the :class:`~magicsoup_tpu.fleet.FleetScheduler`.
+
+    Gates, in order: the steady state must pass
+    ``hot_path_guard(compile_budget=0)`` once warm; the fetch census
+    must count exactly ONE host fetch per rung group per megastep (the
+    one-fetch-per-megastep-per-fleet contract — no per-world D2H); and
+    the batched telemetry stream must validate against the schema with
+    ``fleet_slot``/``fleet_size`` on every dispatch row.
+    """
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.fleet import FleetScheduler
+    from magicsoup_tpu.telemetry import (
+        fetch_stats,
+        read_jsonl,
+        validate_rows,
+    )
+
+    mols = [
+        ms.Molecule("flt-a", 10e3),
+        ms.Molecule("flt-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+    def _world(seed, map_size):
+        w = ms.World(chemistry=chem, map_size=map_size, seed=seed)
+        w.deterministic = True
+        rng = random.Random(99)  # same genomes -> same token rung
+        w.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        return w
+
+    # chemistry-only dynamics: the capacity rungs freeze after the first
+    # step, which is what makes the zero-compile steady state gateable
+    kw = dict(
+        mol_name="flt-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=args.megastep,
+    )
+    fleet = FleetScheduler(block=2)
+    lanes = [
+        fleet.admit(_world(7, args.map_size), **kw),
+        fleet.admit(_world(11, args.map_size), **kw),
+        # double map size -> a different capacity rung, its own group
+        fleet.admit(_world(13, args.map_size * 2), **kw),
+    ]
+    tel_dir = Path(tempfile.mkdtemp(prefix="msoup-fleet-smoke-"))
+    tel_paths = {}
+    for i in (0, 2):  # one observed lane per rung
+        tel_paths[i] = tel_dir / f"lane{i}.jsonl"
+        lanes[i].telemetry.attach(tel_paths[i])
+
+    for _ in range(args.warmup + 1):
+        fleet.step()
+    fleet.drain()
+    n_groups = len(fleet._groups)
+
+    problems = []
+    f0 = fetch_stats()["fetches"]
+    t0 = time.perf_counter()
+    try:
+        with runtime.hot_path_guard(compile_budget=0):
+            for _ in range(args.steps):
+                fleet.step()
+            fleet.drain()
+    except runtime.CompileBudgetExceeded as e:
+        problems.append(str(e))
+    dt = time.perf_counter() - t0
+    fetches = fetch_stats()["fetches"] - f0
+    fleet.flush()
+
+    if n_groups != 2:
+        problems.append(f"expected 2 rung groups, got {n_groups}")
+    if fetches != args.steps * n_groups:
+        problems.append(
+            f"fetch census: {fetches} fetches for {args.steps} megasteps "
+            f"x {n_groups} groups (want exactly one per group-megastep)"
+        )
+    for i, path in tel_paths.items():
+        rows = read_jsonl(path)
+        problems += [f"lane{i}: {p}" for p in validate_rows(rows)]
+        dispatch = [r for r in rows if r.get("type") == "dispatch"]
+        if not dispatch:
+            problems.append(f"lane{i}: no dispatch rows")
+        for r in dispatch:
+            if "fleet_slot" not in r or "fleet_size" not in r:
+                problems.append(
+                    f"lane{i}: dispatch row lacks fleet_slot/fleet_size"
+                )
+                break
+    per_world = args.steps * args.megastep / dt if dt > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"fleet smoke (B={len(lanes)} worlds, "
+                    f"{n_groups} rungs, cpu)"
+                ),
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "per_world_steps_per_s": round(per_world, 4),
+                "fetches_per_megastep": fetches / max(args.steps, 1),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("fleet smoke FAILED: " + "; ".join(problems))
 
 
 def chaos_main(args) -> None:
@@ -791,6 +1017,86 @@ def chaos_main(args) -> None:
             f"faults child rc={flt.returncode}: {flt.stderr[-500:]}"
         )
 
+    # -- fleet kill/resume: a B=2 fleet checkpointed ATOMICALLY must
+    # survive the same SIGKILL/resume cycle bit-identically (warmup
+    # child first — the fleet program's cache entries must be LOADED by
+    # both digest-bearing children, see the solo warmup note above)
+    fleet_digest_a = fleet_marker = None
+    fwarm = subprocess.run(
+        _cmd("fleet-run", "fw"), env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    if fwarm.returncode != 0:
+        problems.append(
+            f"fleet warmup child rc={fwarm.returncode}: "
+            + (fwarm.stderr or "")[-500:]
+        )
+    else:
+        fref = subprocess.run(
+            _cmd("fleet-run", "fa"), env=env, capture_output=True,
+            text=True, timeout=900,
+        )
+        fref_rows = [r for r in _json_lines(fref.stdout) if "digest" in r]
+        if fref.returncode != 0 or not fref_rows:
+            problems.append(
+                f"fleet baseline child rc={fref.returncode}: "
+                + (fref.stderr or "")[-500:]
+            )
+        else:
+            fleet_digest_a = fref_rows[-1]["digest"]
+            fvic = subprocess.Popen(
+                _cmd("fleet-run", "fb", "--kill-after", "1"),
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            for line in fvic.stdout:
+                line = line.strip()
+                if line.startswith("{") and "checkpointed" in line:
+                    fleet_marker = json.loads(line)
+                    break
+            if fleet_marker is None:
+                fvic.kill()
+                fvic.wait(timeout=60)
+                problems.append(
+                    "fleet victim exited before its checkpoint marker"
+                )
+            else:
+                fvic.send_signal(signal.SIGKILL)
+                rc = fvic.wait(timeout=60)
+                if rc != -signal.SIGKILL:
+                    problems.append(
+                        f"fleet victim rc={rc}, expected -SIGKILL"
+                    )
+            fvic.stdout.close()
+            if fleet_marker is not None:
+                fres = subprocess.run(
+                    _cmd("fleet-resume", "fb"), env=env,
+                    capture_output=True, text=True, timeout=900,
+                )
+                rows = [
+                    r for r in _json_lines(fres.stdout) if "digest" in r
+                ]
+                if fres.returncode != 0 or not rows:
+                    problems.append(
+                        f"fleet resume child rc={fres.returncode}: "
+                        + (fres.stderr or "")[-500:]
+                    )
+                else:
+                    if rows[-1].get("from_step") != fleet_marker["step"]:
+                        problems.append(
+                            "fleet resumed from step "
+                            f"{rows[-1].get('from_step')}, victim "
+                            f"checkpointed at {fleet_marker['step']}"
+                        )
+                    if rows[-1]["digest"] != fleet_digest_a:
+                        problems.append(
+                            "fleet kill/resume digest mismatch: "
+                            f"{fleet_digest_a[:16]} != "
+                            f"{rows[-1]['digest'][:16]}"
+                        )
+
     print(
         json.dumps(
             {
@@ -800,6 +1106,10 @@ def chaos_main(args) -> None:
                 "digest": digest_a,
                 "resumed_from": marker["step"] if marker else None,
                 "faults": flt_rows[-1] if flt_rows else None,
+                "fleet_digest": fleet_digest_a,
+                "fleet_resumed_from": (
+                    fleet_marker["step"] if fleet_marker else None
+                ),
                 "problems": problems,
             }
         ),

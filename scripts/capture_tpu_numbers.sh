@@ -119,6 +119,11 @@ run bench_diffusion 1800 python bench.py --config diffusion --warmup 4 --steps 8
 # that summarize_capture publishes under published["multichip"].
 # --platform '' lets the child take real TPU chips when present.
 run multichip       1800 python performance/mesh_sweep.py --devices 1,2,4,8 --platform ''
+# per-world throughput across fleet sizes (B x K grid): one JSON line
+# per point that summarize_capture publishes under published["fleet"].
+# The B=1 vs B=16 per-world ratio IS the dispatch-amortization number
+# the graftfleet batch axis exists for.
+run fleet           1800 python performance/fleet_sweep.py --platform ''
 run check           1200 python performance/check.py
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"

@@ -35,8 +35,18 @@ python performance/mesh_sweep.py --check --devices 2 \
 # drain -> final checkpoint + flushed telemetry), trips the NaN
 # sentinel / transient-dispatch retry, and runs the graftcheck deep
 # audit post-resume (must pass clean, must reject seeded corruptions).
-# Exits nonzero on any violation.
+# Also SIGKILLs a B=2 FLEET child after an atomic fleet checkpoint and
+# resumes it — the resumed fleet digest must equal the uninterrupted
+# baseline's.  Exits nonzero on any violation.
 python performance/smoke.py --chaos
+# graftfleet smoke (GATING): B=3 det-mode worlds across two capacity
+# rungs stepped as a fleet — the warm steady state must pass
+# hot_path_guard(compile_budget=0), the fetch census must show exactly
+# ONE host fetch per rung group per megastep (no per-world D2H), and
+# the batched telemetry must validate with per-world fleet_slot /
+# fleet_size lanes on every dispatch row.  Exits nonzero on any
+# violation.
+python performance/smoke.py --fleet
 # graftcheck differential smoke (GATING): one seeded
 # spawn/step/mutate/kill/divide/compact schedule through the classic
 # driver, the stepper at K=1 and K=4, and a 2-tile mesh — all four

@@ -1,0 +1,16 @@
+"""GL012 fixture: a fleet-scoped module drawing from ONE unsplit key —
+the same stream broadcasts to every world of the batch, so the "B
+independent worlds" are silently correlated.  The per-world forms
+(``keys[w]``, ``fold_in(key, w)``) right below it stay silent."""
+import jax
+import jax.numpy as jnp
+
+from magicsoup_tpu import fleet  # noqa: F401  (marks the module fleet-scoped)
+
+
+def mutate_fleet(keys: jax.Array, w: int):
+    shared = jax.random.PRNGKey(0)
+    bad = jax.random.uniform(shared, (4,))  # GL012: shared across worlds
+    good = jax.random.uniform(keys[w], (4,))
+    also_good = jax.random.uniform(jax.random.fold_in(shared, w), (4,))
+    return bad + good + also_good + jnp.float32(0)

@@ -266,6 +266,83 @@ def test_publish_multichip_best_value_per_count(tmp_path, monkeypatch):
     assert out["1"]["capture_dir"].endswith("cap-a")
 
 
+def _fleet_row(
+    b: int, k: int, value: float, *, error: str | None = None
+) -> str:
+    row = {
+        "metric": (
+            f"fleet B={b} K={k} per-world steps/sec "
+            f"(64 cells, 32x32 map, tpu)"
+        ),
+        "value": value,
+        "unit": "steps/s",
+        "fleet_size": b,
+        "megastep": k,
+        "aggregate_steps_per_s": value * b,
+        "groups": 1,
+    }
+    if error is not None:
+        row["error"] = error
+    return json.dumps(row)
+
+
+def test_summarize_fleet_per_point_rows(tmp_path):
+    # performance/fleet_sweep.py prints one per-world steps/s row per
+    # (B, K) point; the summary keys them "B{b}K{k}", last clean row per
+    # point wins and error rows never shadow a clean one
+    (tmp_path / "fleet.log").write_text(
+        _fleet_row(1, 1, 100.0)
+        + "\n"
+        + _fleet_row(4, 1, 0.0, error="oom")
+        + "\n"
+        + _fleet_row(4, 1, 40.0)
+        + "\n"
+        + _fleet_row(16, 4, 12.0)
+        + "\n"
+        + _fleet_row(64, 4, 0.0, error="tunnel dropped")
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    fleet = summary["fleet"]
+    assert fleet["B1K1"]["value"] == 100.0
+    assert fleet["B4K1"]["value"] == 40.0 and "error" not in fleet["B4K1"]
+    assert fleet["B16K4"]["value"] == 12.0
+    # error-only point: the error survives into the summary (visibility)
+    assert fleet["B64K4"]["error"] == "tunnel dropped"
+
+
+def test_publish_fleet_best_value_per_point(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(rows: list[str], tag: str) -> dict:
+        cap = tmp_path / f"cap-{tag}"
+        cap.mkdir(exist_ok=True)
+        (cap / "fleet.log").write_text("\n".join(rows) + "\n")
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]["fleet"]
+
+    out = pub([_fleet_row(1, 1, 100.0), _fleet_row(4, 1, 40.0)], "a")
+    assert out["B1K1"]["value"] == 100.0 and out["B4K1"]["value"] == 40.0
+    # per-world steps/s are higher-is-better: a faster later window
+    # upgrades one point without degrading the other; errors are refused
+    out = pub(
+        [
+            _fleet_row(1, 1, 90.0),
+            _fleet_row(4, 1, 55.0),
+            _fleet_row(64, 4, 0.0, error="tunnel dropped"),
+        ],
+        "b",
+    )
+    assert out["B1K1"]["value"] == 100.0  # best record kept
+    assert out["B4K1"]["value"] == 55.0  # upgraded
+    assert "B64K4" not in out  # error never published
+    # provenance: each point carries the capture dir it was measured in
+    assert out["B4K1"]["capture_dir"].endswith("cap-b")
+    assert out["B1K1"]["capture_dir"].endswith("cap-a")
+
+
 def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(json.dumps({"published": {}}) + "\n")

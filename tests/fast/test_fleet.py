@@ -1,0 +1,314 @@
+"""
+graftfleet tests (:mod:`magicsoup_tpu.fleet`): the three subsystem
+contracts from the module docstring, pinned.
+
+1. **bit-identity** — a B=1 fleet equals the solo
+   :class:`~magicsoup_tpu.stepper.PipelinedStepper` at K=1 and K=4
+   (per-boundary digests through ``check.differential``), and every
+   world of a B=N fleet equals its own solo run under a full
+   spawn/kill/divide/mutate workload.
+2. **one fetch per megastep per fleet** — the fetch census counts
+   exactly one sanctioned D2H transfer per group megastep, no
+   per-world fetches.
+3. **zero-compile admission** — admitting a world into a warm capacity
+   rung compiles nothing (``analysis.runtime`` compile counters), and
+   the steady state passes ``hot_path_guard(compile_budget=0)``.
+
+Plus the placement edges (retire -> solo, managed ``step()`` refusal)
+and the world-axis sharded program's det-mode equality.
+"""
+import json
+import random
+
+import jax
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import guard
+from magicsoup_tpu.analysis import runtime
+from magicsoup_tpu.check import differential
+from magicsoup_tpu.fleet import FleetScheduler
+from magicsoup_tpu.stepper import PipelinedStepper
+from magicsoup_tpu.telemetry import fetch_stats, validate_rows
+
+_MOLS = [
+    ms.Molecule("gg-a", 10e3),
+    ms.Molecule("gg-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+
+def _world(*, seed=5, map_size=16, n_cells=24, genome_rng=None):
+    world = ms.World(chemistry=_CHEM, map_size=map_size, seed=seed)
+    world.deterministic = True
+    rng = random.Random(seed if genome_rng is None else genome_rng)
+    world.spawn_cells(
+        [ms.random_genome(s=200, rng=rng) for _ in range(n_cells)]
+    )
+    return world
+
+
+#: full selection workload — spawn/mutate/kill/divide all active
+_KW_EVO = dict(
+    mol_name="gg-atp",
+    kill_below=0.1,
+    divide_above=3.0,
+    divide_cost=1.0,
+    target_cells=24,
+    genome_size=200,
+    lag=1,
+    p_mutation=1e-3,
+    p_recombination=1e-4,
+    megastep=2,
+)
+
+#: chemistry-only workload — no kill/divide/spawn, so the capacity rung
+#: FREEZES after the first step (what makes same-rung admission real)
+_KW_CHEM = dict(
+    mol_name="gg-atp",
+    kill_below=-1.0,
+    divide_above=1e30,
+    divide_cost=0.0,
+    target_cells=None,
+    genome_size=200,
+    lag=1,
+    p_mutation=0.0,
+    p_recombination=0.0,
+    megastep=2,
+)
+
+
+def _fingerprint(world, st=None) -> dict:
+    """Canonical resume-relevant state (flushes the stepper first)."""
+    snap = guard.snapshot_run(world, st)
+    n = world.n_cells
+    out = {
+        "n_cells": n,
+        "genomes": list(world.cell_genomes),
+        "mm": np.asarray(jax.device_get(world.molecule_map)),
+        "cm": np.asarray(world.cell_molecules)[:n],
+        "positions": np.asarray(world.cell_positions),
+        "lifetimes": np.asarray(world.cell_lifetimes),
+        "divisions": np.asarray(world.cell_divisions),
+        "world_rng": snap["world_rng_state"],
+        "world_nprng": repr(snap["world_nprng_state"]),
+    }
+    if st is not None:
+        aux = snap["stepper"]
+        out.update(
+            key=np.asarray(aux["key"]),
+            stepper_rng=repr(aux["rng_state"]),
+        )
+    return out
+
+
+def _assert_identical(a: dict, b: dict, label=""):
+    assert a.keys() == b.keys()
+    for k in a:
+        if isinstance(a[k], np.ndarray):
+            assert a[k].tobytes() == b[k].tobytes(), f"{label}{k} differs"
+        else:
+            assert a[k] == b[k], f"{label}{k} differs"
+
+
+# ------------------------------------------------------- bit-identity
+@pytest.mark.parametrize(
+    "fleet_path,solo_path", [("fleet1", "k1"), ("fleet4", "k4")]
+)
+def test_b1_fleet_matches_solo_per_boundary(fleet_path, solo_path):
+    """A B=1 fleet replays the exact solo trajectory: every schedule
+    boundary digest matches the plain stepper's at the same K."""
+    solo = differential.run_path(solo_path)
+    fleet = differential.run_path(fleet_path)
+    for i, (want, got) in enumerate(zip(solo, fleet)):
+        assert want == got, (
+            f"{fleet_path} forked from {solo_path} at boundary "
+            f"{differential.BOUNDARIES[i]}"
+        )
+
+
+def test_fleet_of_n_each_world_matches_solo():
+    """Every world of a B=4 fleet is bit-identical to its own solo run
+    under the full selection workload (spawn/mutate/kill/divide), and a
+    retired lane keeps stepping solo from exactly that state."""
+    seeds = (7, 11, 17, 23)
+    n_megasteps = 2
+
+    solo_prints = []
+    for s in seeds:
+        st = PipelinedStepper(_world(seed=s), **_KW_EVO)
+        for _ in range(n_megasteps):
+            st.step()
+        solo_prints.append(_fingerprint(st.world, st))
+
+    fleet = FleetScheduler(block=4)
+    lanes = [fleet.admit(_world(seed=s), **_KW_EVO) for s in seeds]
+    for _ in range(n_megasteps):
+        fleet.step()
+    for i, lane in enumerate(lanes):
+        _assert_identical(
+            solo_prints[i],
+            _fingerprint(lane.world, lane),
+            label=f"world {i}: ",
+        )
+
+    # managed lanes refuse solo stepping ...
+    with pytest.raises(RuntimeError, match="retire"):
+        lanes[0].step()
+    # ... and a retired lane is a plain stepper again
+    solo = fleet.retire(lanes[0])
+    solo.step()
+    solo.flush()
+    assert len(fleet.lanes) == 3
+
+
+# ------------------------------------- warm-rung admission + censuses
+@pytest.fixture(scope="module")
+def chem_fleet():
+    """A warm chemistry-only fleet of two identically-shaped worlds
+    (same genomes, different seeds): after the warmup steps the
+    capacity rung is frozen, which is what the admission/fetch/compile
+    contracts below are defined over."""
+    fleet = FleetScheduler(block=4)
+    for s in (7, 11):
+        fleet.admit(_world(seed=s, genome_rng=99), **_KW_CHEM)
+    for _ in range(4):
+        fleet.step()
+    fleet.drain()
+    return fleet
+
+
+def test_admission_into_warm_rung_compiles_nothing(chem_fleet):
+    """Acceptance criterion: admitting a world whose rung has a warm
+    compiled variant and a free slot triggers ZERO new compiles —
+    through admit and the next two fleet steps."""
+    before = runtime.compile_count()
+    lane = chem_fleet.admit(_world(seed=17, genome_rng=99), **_KW_CHEM)
+    chem_fleet.step()
+    chem_fleet.step()
+    chem_fleet.drain()
+    assert runtime.compile_count() - before == 0
+    # truly the SAME rung: one group, three members
+    assert len(chem_fleet._groups) == 1
+    assert lane._fleet_slot is not None
+
+
+def test_one_fetch_per_megastep_for_whole_fleet(chem_fleet):
+    """The fetch census: B worlds cost ONE sanctioned D2H transfer per
+    megastep (the shared batched record), not one per world."""
+    n_lanes = len(chem_fleet.lanes)
+    assert n_lanes >= 2
+    chem_fleet.drain()
+    before = fetch_stats()["fetches"]
+    for _ in range(4):
+        chem_fleet.step()
+    chem_fleet.drain()
+    assert fetch_stats()["fetches"] - before == 4
+
+
+def test_steady_state_passes_hot_path_guard(chem_fleet):
+    """Once warm, fleet stepping compiles nothing and makes no implicit
+    transfers — the same ``hot_path_guard(compile_budget=0)`` bar the
+    solo stepper's gating smoke holds."""
+    chem_fleet.drain()
+    with runtime.hot_path_guard(compile_budget=0):
+        chem_fleet.step()
+        chem_fleet.step()
+        chem_fleet.drain()
+
+
+def test_fleet_telemetry_rows_validate(chem_fleet, tmp_path):
+    """Batched dispatch rows pass the telemetry schema gate and carry
+    the per-world fleet lanes (slot + size)."""
+    lane = chem_fleet.lanes[0]
+    path = tmp_path / "fleet.jsonl"
+    lane.telemetry.attach(path)
+    try:
+        chem_fleet.step()
+        chem_fleet.step()
+        chem_fleet.drain()
+        lane.telemetry.flush()
+    finally:
+        lane.telemetry.detach()
+    rows = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line.strip()
+    ]
+    assert validate_rows(rows) == []
+    dispatch = [r for r in rows if r.get("type") == "dispatch"]
+    assert dispatch, "no dispatch rows emitted"
+    group, slot = lane._fleet_slot
+    for row in dispatch:
+        assert row["fleet_slot"] == slot
+        assert row["fleet_size"] == len(group.slots)
+
+
+# --------------------------------------------------- world-axis mesh
+@pytest.mark.slow
+def test_sharded_fleet_step_matches_unsharded():
+    """`P("world")` placement cannot move a bit: the shard_map'd fleet
+    program equals the single-device one leaf-for-leaf in det mode."""
+    from magicsoup_tpu.fleet import batch, sharding
+
+    fleet = FleetScheduler(block=2)
+    lanes = [
+        fleet.admit(_world(seed=s, genome_rng=99), **_KW_CHEM)
+        for s in (7, 11)
+    ]
+    fleet.step()
+    fleet.drain()
+    group, _slot = lanes[0]._fleet_slot
+    first = lanes[0]
+
+    B = len(group.slots)
+    sb, pb = first.spawn_block, first.push_block
+    maxp, maxd = group.maxp, group.maxd
+    spawn_dense = np.zeros((B, sb, maxp, maxd, 5), dtype=np.int16)
+    spawn_valid = np.zeros((B, sb), dtype=bool)
+    push_dense = np.zeros((B, pb, maxp, maxd, 5), dtype=np.int16)
+    push_rows = np.full((B, pb), np.iinfo(np.int32).max, dtype=np.int32)
+    budgets = np.zeros((B,), dtype=np.int32)
+    compacts = np.zeros((B,), dtype=bool)
+    statics = dict(
+        det=True,
+        max_div=first.max_divisions,
+        n_rounds=first.n_rounds,
+        k=first.megastep,
+        use_pallas=False,
+    )
+    args = (
+        group.fstate,
+        group.fparams,
+        group.consts,
+        spawn_dense,
+        spawn_valid,
+        push_dense,
+        push_rows,
+        budgets,
+        compacts,
+    )
+    # CPU twins retain their inputs, so the same args can feed both
+    assert not batch._donate_step_buffers()
+    ref_state, ref_params, ref_outs = batch.fleet_step(*args, **statics)
+
+    mesh = sharding.make_world_mesh(2)
+    assert B % 2 == 0
+    got_state, got_params, got_outs = sharding.sharded_fleet_step(
+        mesh, **statics
+    )(*map(lambda t: sharding.shard_fleet(t, mesh), args[:3]), *args[3:])
+
+    for name, ref, got in (
+        ("state", ref_state, got_state),
+        ("params", ref_params, got_params),
+        ("outs", ref_outs, got_outs),
+    ):
+        rl = jax.tree_util.tree_leaves(ref)
+        gl = jax.tree_util.tree_leaves(got)
+        assert len(rl) == len(gl)
+        for r, g in zip(rl, gl):
+            assert (
+                np.asarray(jax.device_get(r)).tobytes()
+                == np.asarray(jax.device_get(g)).tobytes()
+            ), f"{name} leaf differs under world sharding"

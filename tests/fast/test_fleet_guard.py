@@ -1,0 +1,186 @@
+"""
+Batch-aware guard checkpointing (:mod:`magicsoup_tpu.fleet.persist`):
+
+- a single world EXTRACTED from a fleet checkpoint restores into a
+  standalone :class:`World` + stepper bit-identically to the lane it
+  was cut from — and keeps stepping identically after the cut;
+- a whole-fleet checkpoint round-trips atomically through a
+  :class:`~magicsoup_tpu.guard.CheckpointManager` (meta step included)
+  and the restored fleet's future is bit-identical to the original's;
+- wrong-format and out-of-range payloads are rejected with TYPED
+  errors, both directions (fleet reader on a solo checkpoint, solo
+  reader on a fleet checkpoint).
+
+The SIGKILL/resume survival of a fleet checkpoint is exercised by the
+chaos smoke (``performance/smoke.py --chaos``, fleet section).
+"""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import guard
+from magicsoup_tpu.fleet import (
+    FleetScheduler,
+    restore_fleet,
+    restore_world,
+    save_fleet,
+)
+from magicsoup_tpu.stepper import PipelinedStepper
+
+_MOLS = [
+    ms.Molecule("fg-a", 10e3),
+    ms.Molecule("fg-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+_KW = dict(
+    mol_name="fg-atp",
+    kill_below=0.1,
+    divide_above=3.0,
+    divide_cost=1.0,
+    target_cells=24,
+    genome_size=200,
+    lag=1,
+    p_mutation=1e-3,
+    p_recombination=1e-4,
+    megastep=2,
+)
+
+
+def _world(seed):
+    world = ms.World(chemistry=_CHEM, map_size=16, seed=seed)
+    world.deterministic = True
+    rng = random.Random(seed)
+    world.spawn_cells([ms.random_genome(s=200, rng=rng) for _ in range(24)])
+    return world
+
+
+def _fingerprint(world, st) -> dict:
+    snap = guard.snapshot_run(world, st)
+    n = world.n_cells
+    aux = snap["stepper"]
+    return {
+        "n_cells": n,
+        "genomes": list(world.cell_genomes),
+        "mm": np.asarray(jax.device_get(world.molecule_map)),
+        "cm": np.asarray(world.cell_molecules)[:n],
+        "positions": np.asarray(world.cell_positions),
+        "lifetimes": np.asarray(world.cell_lifetimes),
+        "divisions": np.asarray(world.cell_divisions),
+        "world_rng": snap["world_rng_state"],
+        "world_nprng": repr(snap["world_nprng_state"]),
+        "key": np.asarray(aux["key"]),
+        "stepper_rng": repr(aux["rng_state"]),
+    }
+
+
+def _assert_identical(a: dict, b: dict, label=""):
+    assert a.keys() == b.keys()
+    for k in a:
+        if isinstance(a[k], np.ndarray):
+            assert a[k].tobytes() == b[k].tobytes(), f"{label}{k} differs"
+        else:
+            assert a[k] == b[k], f"{label}{k} differs"
+
+
+@pytest.fixture()
+def stepped_fleet():
+    fleet = FleetScheduler(block=4)
+    lanes = [fleet.admit(_world(s), **_KW) for s in (7, 11, 17)]
+    for _ in range(2):
+        fleet.step()
+    return fleet, lanes
+
+
+def test_single_world_extracts_bit_identically(stepped_fleet, tmp_path):
+    """ISSUE contract: snapshot/restore a single world OUT of a running
+    fleet — the standalone restore equals the lane byte-for-byte, and
+    its future trajectory stays identical too."""
+    fleet, lanes = stepped_fleet
+    path = save_fleet(tmp_path / "fleet.msck", fleet, meta={"tag": "x"})
+    for i, lane in enumerate(lanes):
+        world, aux, meta = restore_world(path, i)
+        assert meta["format"] == "magicsoup_tpu.fleet.run/1"
+        assert meta["worlds"] == 3
+        assert meta["tag"] == "x"
+        st = PipelinedStepper(world, **_KW)
+        guard.restore_stepper(st, aux)
+        _assert_identical(
+            _fingerprint(lane.world, lane),
+            _fingerprint(world, st),
+            label=f"world {i}: ",
+        )
+    # negative index follows sequence semantics
+    world, aux, _meta = restore_world(path, -1)
+    st = PipelinedStepper(world, **_KW)
+    guard.restore_stepper(st, aux)
+    _assert_identical(_fingerprint(lanes[-1].world, lanes[-1]),
+                      _fingerprint(world, st))
+    # the cut world keeps stepping exactly like the lane it came from
+    st.step()
+    st.flush()
+    fleet.step()
+    fleet.flush()
+    _assert_identical(
+        _fingerprint(lanes[-1].world, lanes[-1]),
+        _fingerprint(world, st),
+        label="post-cut step: ",
+    )
+
+
+def test_fleet_checkpoint_roundtrip_via_manager(stepped_fleet, tmp_path):
+    """Whole-fleet atomic checkpoint through a CheckpointManager: the
+    restored fleet matches lane-for-lane NOW and after further fleet
+    steps (futures identical, not just the snapshot)."""
+    fleet, lanes = stepped_fleet
+    mgr = guard.CheckpointManager(tmp_path / "ck", keep=2)
+    save_fleet(mgr, fleet, step=2)
+
+    fleet2 = FleetScheduler(block=4)
+    lanes2, meta = restore_fleet(mgr, fleet2, _KW, audit=True)
+    assert meta["step"] == 2
+    assert meta["worlds"] == len(lanes2) == 3
+    for i, (a, b) in enumerate(zip(lanes, lanes2)):
+        _assert_identical(
+            _fingerprint(a.world, a),
+            _fingerprint(b.world, b),
+            label=f"restored world {i}: ",
+        )
+    for _ in range(2):
+        fleet.step()
+        fleet2.step()
+    for i, (a, b) in enumerate(zip(lanes, lanes2)):
+        _assert_identical(
+            _fingerprint(a.world, a),
+            _fingerprint(b.world, b),
+            label=f"future world {i}: ",
+        )
+
+
+def test_wrong_format_rejected_both_directions(stepped_fleet, tmp_path):
+    fleet, lanes = stepped_fleet
+    fleet_path = save_fleet(tmp_path / "fleet.msck", fleet)
+    solo_path = tmp_path / "solo.msck"
+    lane = lanes[0]
+    guard.write_checkpoint(
+        solo_path, guard.snapshot_run(lane.world, lane)
+    )
+
+    # solo reader on a fleet checkpoint: typed format refusal
+    with pytest.raises(guard.CheckpointError) as e:
+        guard.restore_run(fleet_path)
+    assert e.value.check == "format"
+    # fleet reader on a solo checkpoint: same
+    with pytest.raises(guard.CheckpointError) as e:
+        restore_world(solo_path, 0)
+    assert e.value.check == "format"
+    # out-of-range world index: typed, names the range
+    with pytest.raises(guard.CheckpointError) as e:
+        restore_world(fleet_path, 3)
+    assert e.value.check == "index"
+    with pytest.raises(guard.CheckpointError) as e:
+        restore_world(fleet_path, -4)
+    assert e.value.check == "index"

@@ -55,6 +55,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl009_unplaced.py", "GL009"),
         ("gl010_unsafe_save.py", "GL010"),
         ("gl011_traced_assert.py", "GL011"),
+        ("gl012_shared_key.py", "GL012"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -148,6 +149,37 @@ def test_gl011_waivable_like_the_other_rules(tmp_path):
     p = tmp_path / "gl011_waived.py"
     p.write_text(waived)
     assert analyze([p]) == []
+
+
+def test_gl012_waivable_like_the_other_rules(tmp_path):
+    # a deliberately shared stream (a common environment shock hitting
+    # every world identically) waives with the standard inline
+    # annotation; pin that the machinery covers GL012
+    src = (FIXTURES / "gl012_shared_key.py").read_text()
+    waived = src.replace(
+        "# GL012: shared across worlds",
+        "# graftlint: disable=GL012 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl012_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl012_scoped_to_fleet_modules(tmp_path):
+    # the SAME shared-key draw is silent once the module stops being
+    # fleet-scoped: solo steppers have exactly one world, so one key IS
+    # the per-world key and forcing splits would be noise
+    src = (FIXTURES / "gl012_shared_key.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import fleet"
+        "  # noqa: F401  (marks the module fleet-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl012_not_fleet.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL012"]) == []
 
 
 def test_gl010_write_form_detected(tmp_path):
