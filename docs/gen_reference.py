@@ -86,6 +86,12 @@ MODULES = {
         " extracting a single world out of a fleet checkpoint as a"
         " standalone run."
     ),
+    "magicsoup_tpu.fleet.warden": (
+        "graftwarden per-world fault isolation: warn/quarantine/heal"
+        " policies over the per-slot health flags of the shared fleet"
+        " fetch, rolling per-world checkpoint streams, and a bounded"
+        " restart budget with circuit breaking."
+    ),
     "magicsoup_tpu.fleet.sharding": (
         "World-axis data parallelism: shard the fleet's leading axis"
         " over a `P(\"world\")` device mesh (no collectives — worlds are"

@@ -18,6 +18,9 @@ Findings; registration at the bottom.
 |       |                      | `assert` inside jitted bodies)             |
 | GL012 | shared-prng-key      | per-world randomness in fleet modules (no  |
 |       |                      | one key consumed across the world axis)    |
+| GL013 | swallowed-guard-error| typed guard errors reach their policy layer|
+|       |                      | (no broad `except` without re-raise in     |
+|       |                      | guard/fleet-scoped modules)                |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -148,6 +151,15 @@ RULE_INFO = {
         "axis gives every world of the batch the SAME random stream, "
         "silently correlating trajectories that are documented "
         "independent",
+    ),
+    "GL013": (
+        "swallowed-guard-error",
+        "broad `except Exception:`/`except BaseException:` without a "
+        "re-raise in a guard/fleet-scoped module — the typed guard "
+        "errors (CheckpointError, SentinelTripped, WatchdogTimeout) "
+        "exist so the policy layer can react; a blanket handler that "
+        "logs-and-continues turns a refused checkpoint or a tripped "
+        "sentinel into silent corruption",
     ),
 }
 
@@ -1144,6 +1156,90 @@ def check_gl012(ctx: Context):
                 )
 
 
+#: broad handler types GL013 flags — anything these catch includes the
+#: whole typed guard hierarchy (GuardError is a RuntimeError)
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_guard_scoped(f) -> bool:
+    """A file is guard-scoped when it lives under a ``guard`` package
+    or imports one — the modules that handle the typed guard errors
+    (and every fleet-scoped module, which sits above them)."""
+    if "guard" in f.path.parts:
+        return True
+    if _is_fleet_scoped(f):
+        return True
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "guard" in node.module.split("."):
+                return True
+            if any(a.name == "guard" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("guard" in a.name.split(".") for a in node.names):
+                return True
+    return False
+
+
+def check_gl013(ctx: Context):
+    """Typed guard errors must reach their policy layer.  The guard
+    hierarchy (``CheckpointError``, ``SentinelTripped``,
+    ``WatchdogTimeout``, ...) exists so callers can REACT — restore a
+    checkpoint, quarantine a world, kill a wedged fetch.  A broad
+    ``except Exception:`` (or ``BaseException:``, or a bare
+    ``except:``) in a guard/fleet-scoped module that never re-raises
+    swallows all of them indistinguishably from a transient hiccup:
+    the run continues on corrupt state and the fault surfaces far from
+    its cause.  A handler whose body contains any ``raise`` passes —
+    wrapping into a typed error or re-raising after cleanup is exactly
+    the sanctioned shape."""
+    fix = (
+        "catch the specific errors the block can actually handle, or "
+        "re-raise (`raise` / `raise TypedError(...) from exc`) after "
+        "cleanup; waive a handler that deliberately delivers the error "
+        "elsewhere (e.g. future.set_exception) with "
+        "`# graftlint: disable=GL013`"
+    )
+    for f in ctx.files:
+        if not _is_guard_scoped(f):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            excs = (
+                list(node.type.elts)
+                if isinstance(node.type, ast.Tuple)
+                else [node.type]
+            )
+            broad = any(
+                e is None or _attr_chain(e).rsplit(".", 1)[-1] in _BROAD_EXC
+                for e in excs
+            )
+            if not broad:
+                continue
+            if any(
+                isinstance(n, ast.Raise)
+                for stmt in node.body
+                for n in ast.walk(stmt)
+            ):
+                continue
+            what = (
+                "bare `except:`"
+                if node.type is None
+                else f"`except {ast.unparse(node.type)}:`"
+            )
+            yield _finding(
+                "GL013",
+                f,
+                node,
+                f"{what} without re-raise in a guard-scoped module "
+                "swallows the typed guard errors (CheckpointError, "
+                "SentinelTripped, WatchdogTimeout) the policy layer "
+                "needs to see",
+                fix,
+            )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1157,6 +1253,7 @@ CHECKERS = {
     "GL010": check_gl010,
     "GL011": check_gl011,
     "GL012": check_gl012,
+    "GL013": check_gl013,
 }
 
 

@@ -30,7 +30,9 @@ Submodules: :mod:`~magicsoup_tpu.fleet.batch` (the stacked device
 program), :mod:`~magicsoup_tpu.fleet.lanes` (per-world steppers),
 :mod:`~magicsoup_tpu.fleet.scheduler` (admission/rungs/dispatch),
 :mod:`~magicsoup_tpu.fleet.sharding` (world-axis mesh placement),
-:mod:`~magicsoup_tpu.fleet.persist` (batch-aware guard checkpoints).
+:mod:`~magicsoup_tpu.fleet.persist` (batch-aware guard checkpoints),
+:mod:`~magicsoup_tpu.fleet.warden` (per-world fault isolation,
+rolling checkpoint streams, and self-healing).
 """
 from magicsoup_tpu.fleet.lanes import FleetLane
 from magicsoup_tpu.fleet.persist import (
@@ -41,11 +43,19 @@ from magicsoup_tpu.fleet.persist import (
     snapshot_fleet,
 )
 from magicsoup_tpu.fleet.scheduler import FleetScheduler
+from magicsoup_tpu.fleet.warden import (
+    WARDEN_POLICIES,
+    FleetWarden,
+    WardenStatus,
+)
 
 __all__ = [
     "FLEET_FORMAT",
+    "WARDEN_POLICIES",
     "FleetLane",
     "FleetScheduler",
+    "FleetWarden",
+    "WardenStatus",
     "restore_fleet",
     "restore_world",
     "save_fleet",

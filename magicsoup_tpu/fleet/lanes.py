@@ -80,3 +80,31 @@ class FleetLane(PipelinedStepper):
                 "scheduler.step(), or retire() it for solo stepping"
             )
         super().step()
+
+    # ------------------------------------------------------------ #
+    # per-world guard routing                                      #
+    # ------------------------------------------------------------ #
+
+    def _guard_row_extra(self) -> dict:
+        if self._fleet_slot is not None:
+            group, slot = self._fleet_slot
+            return {"fleet_slot": slot, "fleet_size": len(group.slots)}
+        return {}
+
+    def _handle_sentinel(self, out) -> None:
+        # with a warden attached, a trip is a WORLD-level event: record
+        # it and let the scheduler evict/heal at the next step boundary
+        # instead of raising through the shared commit loop (which
+        # would take down the other B-1 worlds)
+        w = self._fleet._warden if self._fleet is not None else None
+        if w is not None and w.manages(self):
+            w.report(self, "sentinel", out)
+        else:
+            super()._handle_sentinel(out)
+
+    def _handle_invariant(self, out) -> None:
+        w = self._fleet._warden if self._fleet is not None else None
+        if w is not None and w.manages(self):
+            w.report(self, "invariant", out)
+        else:
+            super()._handle_invariant(out)

@@ -29,6 +29,7 @@ Stepping
 from __future__ import annotations
 
 import threading
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Any
 
 import jax
@@ -53,12 +54,24 @@ _OOB_ROW = np.iinfo(np.int32).max
 class _SharedFetch:
     """ONE physical D2H fetch of a group's batched step record, shared
     by every member lane — the whole fleet pays a single transfer per
-    megastep (the fetch-census test pins this)."""
+    megastep (the fetch-census test pins this).
 
-    def __init__(self, fut):
+    The fetch is watchdogged like the solo path (``guard.watchdog``):
+    a wedged transfer or dead fetch worker dumps diagnostics with the
+    fleet context and raises a typed
+    :class:`~magicsoup_tpu.guard.errors.WatchdogTimeout` instead of
+    hanging every member lane.  Note the 3.10 trap this guards against:
+    a bare worker Future raises ``concurrent.futures.TimeoutError``,
+    which is NOT the builtin ``TimeoutError`` there — catching only the
+    builtin would let fleet fetch timeouts sail past as untyped errors.
+    """
+
+    def __init__(self, fut, *, timeout=None, context=None):
         self._fut = fut
         self._value = None
         self._lock = threading.Lock()
+        self._timeout = timeout
+        self._context = dict(context or {})
 
     def done(self) -> bool:
         return self._value is not None or self._fut.done()
@@ -66,7 +79,30 @@ class _SharedFetch:
     def result(self, timeout=None):
         with self._lock:
             if self._value is None:
-                self._value = np.asarray(self._fut.result(timeout=timeout))
+                budget = timeout if timeout is not None else self._timeout
+                try:
+                    self._value = np.asarray(
+                        self._fut.result(timeout=budget)
+                    )
+                except (TimeoutError, _FuturesTimeout) as exc:
+                    from magicsoup_tpu.guard.errors import WatchdogTimeout
+                    from magicsoup_tpu.guard.watchdog import dump_diagnostics
+
+                    dump_diagnostics(
+                        "fleet step-record fetch timed out",
+                        {
+                            "phase": "fleet-fetch",
+                            "timeout_s": budget,
+                            **self._context,
+                        },
+                    )
+                    raise WatchdogTimeout(
+                        f"fleet step-record fetch exceeded {budget:.0f}s "
+                        "(wedged transfer or dead fetch worker); "
+                        "diagnostics dumped to stderr",
+                        phase="fleet-fetch",
+                        seconds=budget,
+                    ) from exc
                 self._fut = None  # drop the device buffer reference
             return self._value
 
@@ -160,6 +196,7 @@ class FleetScheduler:
         self.block = 1 << (int(block) - 1).bit_length()  # round up to pow2
         self.lanes: list[FleetLane] = []
         self._groups: dict[tuple, _FleetGroup] = {}
+        self._warden = None  # bound by fleet.warden.FleetWarden
 
     # ------------------------------------------------------------ #
     # membership                                                   #
@@ -175,7 +212,12 @@ class FleetScheduler:
             )
         lane = FleetLane(world, **stepper_kwargs)
         lane._fleet = self
+        # the warden re-admits healed worlds with the SAME kwargs —
+        # keep them (restore_stepper refuses config drift anyway)
+        lane._admit_kwargs = dict(stepper_kwargs)
         self.lanes.append(lane)
+        if self._warden is not None:
+            self._warden._on_admit(lane)
         return lane
 
     def retire(self, lane: FleetLane) -> FleetLane:
@@ -196,6 +238,8 @@ class FleetScheduler:
                 self._groups.pop(group.key, None)
         self.lanes.remove(lane)
         lane._fleet = None
+        if self._warden is not None:
+            self._warden._on_retire(lane)
         return lane
 
     # ------------------------------------------------------------ #
@@ -205,6 +249,11 @@ class FleetScheduler:
     def step(self) -> None:
         """One fleet megastep: every world advances ``megastep`` fused
         steps.  One dispatch + one fetch per rung group."""
+        if self._warden is not None:
+            # evict tripped worlds / heal cooled-down ones / cadence
+            # saves BEFORE any plan is prepared: membership must be
+            # settled when the groups stack
+            self._warden.before_step()
         plans = {}
         for lane in list(self.lanes):
             plans[id(lane)] = lane._prepare_dispatch()
@@ -474,7 +523,15 @@ class FleetScheduler:
             if first._fetcher is not None
             else _LazyFetch(fouts)
         )
-        shared = _SharedFetch(fut)
+        shared = _SharedFetch(
+            fut,
+            timeout=first._fetch_timeout,
+            context={
+                "B": B,
+                "k": first.megastep,
+                "slots": [slot for slot, _ in members],
+            },
+        )
         for slot, lane in members:
             lane._commit_dispatch(
                 lane_plans[slot],

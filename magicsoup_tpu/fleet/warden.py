@@ -1,0 +1,451 @@
+"""
+graftwarden: per-world fault isolation and self-healing for the fleet.
+
+graftguard's sentinel lanes and graftcheck's invariant lanes are packed
+PER WORLD SLOT in the fleet step record (the scanned solo body computes
+them per member — zero extra D2H, no device-program change), and every
+lane already decodes its own slice during replay.  What was missing is
+world-level POLICY: a solo stepper's ``sentinel_policy`` either warns
+or raises THROUGH the scheduler's shared commit loop, so one tenant's
+NaN took down all B worlds.  :class:`FleetWarden` closes that gap
+(ROADMAP item 3, "one tenant's NaN must never take down the fleet"):
+
+- ``warn`` — per-world telemetry ``sentinel``/``invariant`` rows tagged
+  ``fleet_slot``; nothing raises, trips are counted per lane.
+- ``quarantine`` — the poisoned world is EVICTED from its rung group at
+  the next ``scheduler.step()`` (its slot restacks to zeros — pure data
+  movement, no new shapes) and parked as a standalone stepper.  The
+  other B-1 worlds keep stepping, their det-mode trajectories
+  BIT-identical to an unpoisoned run of the same schedule (pinned in
+  tests/fast/test_fleet_warden.py).
+- ``heal`` — quarantine, then auto-rollback from the world's rolling
+  per-world checkpoint stream and re-admit through the existing
+  zero-compile warm-rung path, under a bounded restart budget with
+  exponential backoff that circuit-breaks to parked after
+  ``max_restarts`` trips.
+
+The stream half is ROADMAP gap 3b: each world gets its own
+:class:`~magicsoup_tpu.guard.CheckpointManager` cadence (prefix-scoped
+files sharing one directory, atomic verified MSCK writes), so data loss
+is bounded PER TENANT instead of per fleet.  A cadence save is a lane
+flush, which is itself part of the deterministic schedule — compare
+warden-armed runs against baselines running the SAME cadence.
+
+Failure-latency note: with pipeline lag L and megastep K, a poison
+lands in the record of the dispatch that integrated it and is decoded
+up to L dispatches later; eviction happens at the next ``step()`` after
+the replay that tripped.  The quarantine window is therefore
+O((L+1) * K) steps — the healthy worlds never see any of it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from magicsoup_tpu.guard.checkpoint import CheckpointManager
+from magicsoup_tpu.guard.errors import CheckpointError, GuardConfigError
+
+__all__ = ["WARDEN_POLICIES", "FleetWarden", "WardenStatus"]
+
+WARDEN_POLICIES = ("warn", "quarantine", "heal")
+
+
+@dataclass
+class WardenStatus:
+    """Typed per-world health status (:meth:`FleetWarden.statuses`).
+
+    ``status`` is one of ``active`` (stepping in the fleet), ``tripped``
+    (flagged, eviction pending at the next scheduler step), ``cooldown``
+    (evicted, heal scheduled at ``cooldown_until``), ``parked``
+    (evicted for good: quarantine policy, no loadable checkpoint, or
+    the circuit breaker — see ``reason``), ``retired`` (the caller
+    retired it manually; the warden no longer tracks it)."""
+
+    label: int
+    status: str
+    trips: int
+    restarts: int
+    last_flags: int
+    cooldown_until: int | None = None
+    reason: str | None = None
+
+
+@dataclass
+class _WorldRecord:
+    """Warden-side bookkeeping for one admitted world."""
+
+    label: int
+    lane: Any
+    kwargs: dict
+    stream: CheckpointManager | None = None
+    status: str = "active"
+    trips: int = 0
+    restarts: int = 0
+    last_flags: int = 0
+    last_kind: str = ""
+    cooldown_until: int | None = None
+    reason: str | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class FleetWarden:
+    """World-level health policy for a
+    :class:`~magicsoup_tpu.fleet.FleetScheduler`.
+
+    Attaching a warden re-routes every member lane's sentinel/invariant
+    trip handling (the per-slot flag words of the shared fleet fetch)
+    away from the solo ``sentinel_policy`` machinery — trips NEVER
+    raise through the scheduler's commit loop; they mark the single
+    affected world and the policy runs at the next ``scheduler.step()``
+    boundary.
+
+    Parameters:
+        scheduler: The fleet to guard; ``scheduler._warden`` is bound
+            here and every current and future lane is tracked.
+        policy: ``warn`` | ``quarantine`` | ``heal`` (see module docs).
+        checkpoint_dir: Directory for the per-world rolling checkpoint
+            streams (``world-<label>-<step>.msck``; several streams
+            share the directory via prefix scoping).  Required for
+            ``heal``.
+        cadence: Save each ACTIVE world's stream every ``cadence``
+            scheduler steps (a lane flush — part of the det schedule).
+            ``0`` disables cadence saves.  ``heal`` requires ``>= 1``.
+        keep: Rolling retention per world stream.
+        max_restarts: Heal budget per world; the breaker parks the
+            world when a trip arrives with the budget exhausted.
+        backoff_base: Cooldown before the n-th heal is
+            ``backoff_base * 2**n`` scheduler steps.
+        audit_on_heal: Run the graftcheck deep audit on the restored
+            world before re-admission (an audit failure walks back is
+            NOT attempted — the world parks with the typed reason).
+    """
+
+    def __init__(
+        self,
+        scheduler,
+        *,
+        policy: str = "warn",
+        checkpoint_dir=None,
+        cadence: int = 0,
+        keep: int = 3,
+        max_restarts: int = 3,
+        backoff_base: int = 1,
+        audit_on_heal: bool = False,
+    ):
+        if policy not in WARDEN_POLICIES:
+            raise GuardConfigError(
+                f"warden policy must be one of {WARDEN_POLICIES}, "
+                f"got {policy!r}",
+                variable="policy",
+                value=str(policy),
+            )
+        if cadence < 0:
+            raise GuardConfigError(
+                "cadence must be >= 0",
+                variable="cadence",
+                value=str(cadence),
+            )
+        if policy == "heal":
+            if checkpoint_dir is None:
+                raise GuardConfigError(
+                    "policy='heal' needs checkpoint_dir: healing rolls "
+                    "back from the per-world stream",
+                    variable="checkpoint_dir",
+                    value="None",
+                )
+            if cadence < 1:
+                raise GuardConfigError(
+                    "policy='heal' needs cadence >= 1: a stream nobody "
+                    "writes to cannot heal anything",
+                    variable="cadence",
+                    value=str(cadence),
+                )
+        if getattr(scheduler, "_warden", None) is not None:
+            raise GuardConfigError(
+                "scheduler already has a FleetWarden attached",
+                variable="scheduler",
+                value=repr(scheduler._warden),
+            )
+        self.scheduler = scheduler
+        self.policy = policy
+        self.cadence = int(cadence)
+        self.keep = int(keep)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = int(backoff_base)
+        self.audit_on_heal = bool(audit_on_heal)
+        self._dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
+        self._records: list[_WorldRecord] = []
+        self._by_lane: dict[int, _WorldRecord] = {}
+        self._next_label = 0
+        self._steps = 0  # scheduler.step() calls seen (cadence clock)
+        self._adopting: _WorldRecord | None = None
+        self._evicting = None
+        scheduler._warden = self
+        for lane in scheduler.lanes:
+            self._on_admit(lane)
+
+    # ------------------------------------------------------------ #
+    # membership tracking (called by the scheduler)                #
+    # ------------------------------------------------------------ #
+
+    def _on_admit(self, lane) -> None:
+        if self._adopting is not None:
+            # heal re-admission: the new lane IS the old world
+            rec = self._adopting
+            rec.lane = lane
+        else:
+            rec = _WorldRecord(
+                label=self._next_label,
+                lane=lane,
+                kwargs=dict(getattr(lane, "_admit_kwargs", {})),
+            )
+            self._next_label += 1
+            if self._dir is not None:
+                rec.stream = CheckpointManager(
+                    self._dir,
+                    keep=self.keep,
+                    prefix=f"world-{rec.label:03d}",
+                )
+            self._records.append(rec)
+        self._by_lane[id(lane)] = rec
+
+    def _on_retire(self, lane) -> None:
+        rec = self._by_lane.pop(id(lane), None)
+        if rec is None or lane is self._evicting:
+            return  # unknown lane, or our own eviction (status set there)
+        rec.status = "retired"
+        rec.lane = None
+
+    def manages(self, lane) -> bool:
+        """Whether ``lane``'s trips are routed through this warden."""
+        return id(lane) in self._by_lane
+
+    # ------------------------------------------------------------ #
+    # trip intake (called from FleetLane replay — never raises)    #
+    # ------------------------------------------------------------ #
+
+    def report(self, lane, kind: str, out) -> None:
+        """Record one tripped flag word for ``lane`` — the per-world
+        reaction to the per-slot sentinel/invariant lanes.  Emits the
+        same telemetry row the solo handler would (plus ``fleet_slot``
+        / ``world`` tags) and, under quarantine/heal, marks the world
+        for eviction at the next scheduler step.  NEVER raises: the
+        whole point is that one world's poison must not unwind the
+        shared commit loop under the other B-1 worlds."""
+        rec = self._by_lane.get(id(lane))
+        if rec is None:
+            return
+        step = lane.stats["replayed"]
+        if kind == "sentinel":
+            from magicsoup_tpu.guard.sentinel import decode_health
+
+            flags_int = int(out.health)
+            flags = decode_health(out.health)
+            lane.stats["sentinel_trips"] += 1
+            row = {
+                "type": "sentinel",
+                "step": step,
+                "flags": flags_int,
+                "n_bad_cells": (
+                    int(out.bad_cells.sum())
+                    if out.bad_cells is not None
+                    else 0
+                ),
+            }
+        else:
+            from magicsoup_tpu.check.invariants import decode_invariants
+
+            flags_int = int(out.invariants)
+            flags = decode_invariants(out.invariants)
+            lane.stats["invariant_trips"] += 1
+            row = {
+                "type": "invariant",
+                "step": step,
+                "flags": flags_int,
+                "mass_drift": float(out.mass_drift),
+            }
+        row.update(flags)
+        row["policy"] = f"warden-{self.policy}"
+        row["world"] = rec.label
+        row.update(lane._guard_row_extra())
+        if lane.telemetry.attached:
+            lane.telemetry.emit(row)
+        rec.trips += 1
+        rec.last_flags = flags_int
+        rec.last_kind = kind
+        if self.policy != "warn" and rec.status == "active":
+            rec.status = "tripped"
+
+    # ------------------------------------------------------------ #
+    # policy (called by the scheduler at the top of step())        #
+    # ------------------------------------------------------------ #
+
+    def before_step(self) -> None:
+        """One warden tick: evict tripped worlds, heal cooled-down
+        ones, run cadence saves.  Runs BEFORE the scheduler prepares
+        any dispatch, so membership is settled for this step."""
+        step = self._steps
+        for rec in self._records:
+            if rec.status == "tripped":
+                self._evict(rec, step)
+        for rec in self._records:
+            if (
+                rec.status == "cooldown"
+                and rec.cooldown_until is not None
+                and step >= rec.cooldown_until
+            ):
+                self._heal(rec, step)
+        if self.cadence:
+            from magicsoup_tpu.guard.resume import save_run
+
+            for rec in self._records:
+                if (
+                    rec.status == "active"
+                    and rec.stream is not None
+                    and step % self.cadence == 0
+                ):
+                    save_run(
+                        rec.stream,
+                        rec.lane.world,
+                        rec.lane,
+                        step=step,
+                        meta={"world": rec.label},
+                    )
+        self._steps += 1
+
+    def _evict(self, rec: _WorldRecord, step: int) -> None:
+        lane = rec.lane
+        self._evicting = lane
+        try:
+            self.scheduler.retire(lane)
+        finally:
+            self._evicting = None
+        if (
+            self.policy == "heal"
+            and rec.stream is not None
+            and rec.restarts < self.max_restarts
+        ):
+            rec.status = "cooldown"
+            rec.cooldown_until = step + self.backoff_base * (
+                1 << rec.restarts
+            )
+            self._emit(
+                rec,
+                lane,
+                "quarantine",
+                step,
+                cooldown_until=rec.cooldown_until,
+            )
+        else:
+            rec.status = "parked"
+            rec.cooldown_until = None
+            if self.policy == "heal" and rec.restarts >= self.max_restarts:
+                rec.reason = (
+                    f"circuit breaker: {rec.restarts} restarts exhausted "
+                    f"the budget of {self.max_restarts}"
+                )
+                self._emit(rec, lane, "quarantine", step)
+                self._emit(rec, lane, "circuit_break", step)
+            else:
+                rec.reason = f"quarantined on {rec.last_kind} trip"
+                self._emit(rec, lane, "quarantine", step)
+
+    def _heal(self, rec: _WorldRecord, step: int) -> None:
+        from magicsoup_tpu.check import AuditFailed
+        from magicsoup_tpu.guard.resume import restore_run, restore_stepper
+
+        old_lane = rec.lane
+        try:
+            world, aux, meta = restore_run(
+                rec.stream, audit=self.audit_on_heal
+            )
+        except (CheckpointError, AuditFailed) as exc:
+            rec.status = "parked"
+            rec.cooldown_until = None
+            rec.reason = f"heal failed: {exc}"
+            self._emit(rec, old_lane, "heal_failed", step, error=str(exc))
+            return
+        self._adopting = rec
+        try:
+            lane = self.scheduler.admit(world, **rec.kwargs)
+        finally:
+            self._adopting = None
+        restore_stepper(lane, aux)
+        rec.status = "active"
+        rec.restarts += 1
+        rec.cooldown_until = None
+        rec.reason = None
+        # the fresh lane starts with an unattached recorder; fall back
+        # to the parked lane's so the heal event lands in the same
+        # stream as the quarantine it resolves
+        emit_lane = lane if lane.telemetry.attached else old_lane
+        self._emit(
+            rec,
+            emit_lane,
+            "heal",
+            step,
+            restarts=rec.restarts,
+            checkpoint_step=meta.get("step"),
+        )
+
+    def _emit(self, rec, lane, event: str, step: int, **extra) -> None:
+        if lane is None or not lane.telemetry.attached:
+            return
+        lane.telemetry.emit(
+            {
+                "type": "warden",
+                "event": event,
+                "step": step,
+                "world": rec.label,
+                "policy": self.policy,
+                **extra,
+            }
+        )
+
+    # ------------------------------------------------------------ #
+    # inspection                                                   #
+    # ------------------------------------------------------------ #
+
+    def statuses(self) -> list[WardenStatus]:
+        """Typed status of every world the warden has ever tracked."""
+        return [
+            WardenStatus(
+                label=rec.label,
+                status=rec.status,
+                trips=rec.trips,
+                restarts=rec.restarts,
+                last_flags=rec.last_flags,
+                cooldown_until=rec.cooldown_until,
+                reason=rec.reason,
+            )
+            for rec in self._records
+        ]
+
+    def status_of(self, lane_or_label) -> WardenStatus:
+        """Status for one world, by lane object or integer label."""
+        for rec in self._records:
+            if (
+                rec.lane is lane_or_label
+                or rec.label == lane_or_label
+            ):
+                return WardenStatus(
+                    label=rec.label,
+                    status=rec.status,
+                    trips=rec.trips,
+                    restarts=rec.restarts,
+                    last_flags=rec.last_flags,
+                    cooldown_until=rec.cooldown_until,
+                    reason=rec.reason,
+                )
+        raise KeyError(f"warden does not track {lane_or_label!r}")
+
+    def parked(self) -> list:
+        """The evicted-for-good lanes (standalone steppers again, state
+        intact as of eviction) — inspect, flush, or re-``admit`` them
+        manually."""
+        return [
+            rec.lane
+            for rec in self._records
+            if rec.status == "parked" and rec.lane is not None
+        ]

@@ -52,11 +52,13 @@ from magicsoup_tpu.guard.errors import (
 )
 from magicsoup_tpu.guard.faults import (
     corrupt_params_row,
+    corrupt_world_params,
     desync_cell_map,
     flip_byte,
     inject_dead_residue,
     inject_dispatch_failures,
     inject_nan,
+    poison_world_mm,
 )
 from magicsoup_tpu.guard.io import atomic_write_bytes
 from magicsoup_tpu.guard.checkpoint import (
@@ -115,4 +117,6 @@ __all__ = [
     "desync_cell_map",
     "inject_dead_residue",
     "corrupt_params_row",
+    "poison_world_mm",
+    "corrupt_world_params",
 ]

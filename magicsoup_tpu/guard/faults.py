@@ -12,6 +12,12 @@ Every guard mechanism needs a way to make its failure happen on demand:
   :func:`corrupt_params_row` seed the three semantic corruptions the
   graftcheck deep audit (``check.audit_world``) must each reject with a
   typed violation,
+- :func:`poison_world_mm` and :func:`corrupt_world_params` are the
+  FLEET-targeted twins: they poison ONE world slot of a running
+  :class:`~magicsoup_tpu.fleet.FleetScheduler` (writing into the
+  group's stacked device arrays when the lane is resident), so the
+  warden's per-world quarantine/heal policies and the fleet-chaos
+  smoke have a single-tenant fault to isolate,
 - process-level chaos (SIGKILL mid-megastep, SIGTERM graceful drain)
   lives in ``performance/smoke.py --chaos``, which orchestrates child
   processes around these hooks.
@@ -107,31 +113,87 @@ def inject_dead_residue(world, *, mol: int = 0, value: float = 1.0) -> int:
     return row
 
 
+def _pick_translated_row(world) -> int:
+    """First audited (sampled) live row whose genome translates to at
+    least one protein — the row ``check.audit_world``'s sampled
+    re-translation cross-check will actually look at."""
+    from magicsoup_tpu.check.audit import _sample_rows
+
+    n = int(world.n_cells)
+    counts, _, _ = world.genetics.translate_genomes_flat(
+        list(world.cell_genomes)
+    )
+    row = next(
+        (i for i in _sample_rows(n, 8) if int(counts[i]) > 0), None
+    )
+    if row is None:
+        raise ValueError(
+            "no sampled cell translates to any protein; nothing for "
+            "the cross-check to catch"
+        )
+    return row
+
+
 def corrupt_params_row(world, *, row: int | None = None) -> int:
     """Overwrite a live cell's resident Vmax column WITHOUT touching its
     genome — the params/genome desync ``check.audit_world``'s sampled
     re-translation cross-check reports as ``params_genome_mismatch``.
     Picks the first audited (sampled) row whose genome translates to at
     least one protein unless ``row`` is given; returns the row."""
-    from magicsoup_tpu.check.audit import _sample_rows
-
     if row is None:
-        n = int(world.n_cells)
-        counts, _, _ = world.genetics.translate_genomes_flat(
-            list(world.cell_genomes)
-        )
-        row = next(
-            (i for i in _sample_rows(n, 8) if int(counts[i]) > 0), None
-        )
-        if row is None:
-            raise ValueError(
-                "no sampled cell translates to any protein; nothing for "
-                "the cross-check to catch"
-            )
+        row = _pick_translated_row(world)
     kin = world.kinetics
     kin.params = kin.params._replace(
         Vmax=kin.params.Vmax.at[row, 0].add(7.0)
     )
+    return row
+
+
+def poison_world_mm(scheduler, slot: int, *, mol: int = 0, pixel=(0, 0)):
+    """Poison ONE fleet world's molecule map with NaN — the
+    single-tenant fault the warden must isolate.
+
+    ``slot`` indexes ``scheduler.lanes`` (admission order).  While the
+    lane is RESIDENT its device truth lives in the group's stacked
+    arrays, so the NaN is written into that world's slice of
+    ``group.fstate`` — the other worlds' slices are untouched, which is
+    exactly the isolation the det-mode bit-identity test pins.  The
+    next fleet dispatch's health lanes flag ``mm_nonfinite`` for that
+    slot only.
+    """
+    import jax.numpy as jnp
+
+    lane = scheduler.lanes[slot]
+    r, c = pixel
+    if lane._fleet_resident:
+        group, gslot = lane._fleet_slot
+        group.fstate = group.fstate._replace(
+            mm=group.fstate.mm.at[gslot, mol, r, c].set(jnp.nan)
+        )
+    else:
+        lane._state = lane._state._replace(
+            mm=lane._state.mm.at[mol, r, c].set(jnp.nan)
+        )
+
+
+def corrupt_world_params(scheduler, slot: int, *, row: int | None = None) -> int:
+    """Fleet twin of :func:`corrupt_params_row`: desync ONE world's
+    resident kinetics params from its genomes (Vmax bump on an audited
+    row) inside the group's stacked params when resident — the
+    corruption ``restore_world(..., audit=True)`` must reject after a
+    fleet save.  Returns the corrupted row."""
+    lane = scheduler.lanes[slot]
+    if row is None:
+        row = _pick_translated_row(lane.world)
+    if lane._fleet_resident:
+        group, gslot = lane._fleet_slot
+        group.fparams = group.fparams._replace(
+            Vmax=group.fparams.Vmax.at[gslot, row, 0].add(7.0)
+        )
+    else:
+        lane.kin.params = lane.kin.params._replace(
+            Vmax=lane.kin.params.Vmax.at[row, 0].add(7.0)
+        )
     return row
 
 

@@ -89,7 +89,7 @@ def dump_diagnostics(tag: str, extra: dict | None = None) -> dict:
         faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         sys.stderr.write(json.dumps(record, default=str) + "\n")
         sys.stderr.flush()
-    except Exception:  # noqa: BLE001 - diagnostics must not mask the hang
+    except Exception:  # noqa: BLE001 - diagnostics must not mask the hang  # graftlint: disable=GL013 best-effort dump, original error already propagating
         pass
     return record
 

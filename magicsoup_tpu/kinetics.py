@@ -809,16 +809,20 @@ class Kinetics:
         self.__dict__.setdefault("cell_sharding", None)
         # cast to the canonical dtypes so worlds pickled with i32 integer
         # tensors share compiled programs with fresh ones; saturating like
-        # the assembly's narrow(), not wrapping
-        def narrow(t: jax.Array) -> jax.Array:
-            return jnp.clip(t, -32768, 32767).astype(INT_PARAM_DTYPE)
+        # the assembly's narrow(), not wrapping.  Host-side on purpose:
+        # restore must stay transfer-only (the fleet warden's heal path
+        # pins zero compiles through rollback + re-admission)
+        def narrow(t) -> jax.Array:
+            arr = np.clip(np.asarray(t), -32768, 32767)
+            return jnp.asarray(arr.astype(INT_PARAM_DTYPE))
 
-        restored = CellParams(*(jnp.asarray(t) for t in state["params"]))
+        raw = state["params"]
+        restored = CellParams(*(jnp.asarray(t) for t in raw))
         self.params = restored._replace(
-            N=narrow(restored.N),
-            Nf=narrow(restored.Nf),
-            Nb=narrow(restored.Nb),
-            A=narrow(restored.A),
+            N=narrow(raw.N),
+            Nf=narrow(raw.Nf),
+            Nb=narrow(raw.Nb),
+            A=narrow(raw.A),
         )
         self.tables = TokenTables(*(jnp.asarray(t) for t in state["tables"]))
         self._abs_temp_arr = jnp.asarray(state["_abs_temp_arr"])

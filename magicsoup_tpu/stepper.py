@@ -146,6 +146,21 @@ _BITS = 16  # bits packed per i32 word (16 keeps every value positive)
 # agree (tests/fast/test_bench_parsing.py pins the record-length
 # formula)
 _HEADER_WORDS = 11
+# flag-word positions inside that header — the graftguard health
+# sentinel word and the graftcheck invariant word
+HEALTH_WORD = 8
+INVARIANT_WORD = 9
+
+
+def record_flag_views(records) -> tuple[np.ndarray, np.ndarray]:
+    """Zero-copy ``(health, invariants)`` flag-word views of a packed
+    step-record buffer of ANY leading shape: ``(record,)`` for one
+    step, ``(k, record)`` for a megastep fetch, ``(B, k, record)`` for
+    a fleet group's shared fetch — index ``[slot]`` on the views for a
+    single world's flags WITHOUT another D2H transfer (the fleet
+    warden's per-slot consumption path)."""
+    arr = np.asarray(records)
+    return arr[..., HEALTH_WORD], arr[..., INVARIANT_WORD]
 
 
 def _pack_bits(b: jax.Array) -> jax.Array:
@@ -926,7 +941,7 @@ class _Worker:
             fn, fut = item
             try:
                 fut.set_result(fn())
-            except BaseException as exc:  # noqa: BLE001 - delivered to result()
+            except BaseException as exc:  # noqa: BLE001 - delivered to result()  # graftlint: disable=GL013 error re-surfaces from the future
                 fut.set_exception(exc)
 
     def submit(self, fn):
@@ -946,7 +961,7 @@ class _Worker:
             # must never wait on that.
             try:
                 fut.set_result(fn())
-            except BaseException as exc:  # noqa: BLE001
+            except BaseException as exc:  # noqa: BLE001  # graftlint: disable=GL013 error re-surfaces from the future
                 fut.set_exception(exc)
         return fut
 
@@ -1912,9 +1927,9 @@ class PipelinedStepper:
             mm_mass=float(masses[0]),
             cm_mass=float(masses[1]),
             tile_occupancy=tile_occ,
-            health=int(arr[8]),
+            health=int(arr[HEALTH_WORD]),
             bad_cells=bad_cells,
-            invariants=int(arr[9]),
+            invariants=int(arr[INVARIANT_WORD]),
             mass_drift=float(drift[0]),
         )
 
@@ -1985,6 +2000,12 @@ class PipelinedStepper:
             )
         self.telemetry.note("replay", _time.perf_counter() - t1)
 
+    def _guard_row_extra(self) -> dict:
+        """Extra keys merged into guard telemetry rows (sentinel /
+        invariant trips).  The fleet lane overrides this to tag rows
+        with its ``fleet_slot``/``fleet_size``."""
+        return {}
+
     def _handle_sentinel(self, out: StepOutputs) -> None:
         """Host-side policy over a tripped health flag word (the device
         lanes are unconditional; ONLY this reaction differs by policy)."""
@@ -2007,6 +2028,7 @@ class PipelinedStepper:
                     "n_bad_cells": n_bad,
                     "policy": self.sentinel_policy,
                     **flags,
+                    **self._guard_row_extra(),
                 }
             )
         if self.sentinel_policy == "rollback":
@@ -2055,6 +2077,7 @@ class PipelinedStepper:
                     "mass_drift": float(out.mass_drift),
                     "policy": self.sentinel_policy,
                     **flags,
+                    **self._guard_row_extra(),
                 }
             )
         if self.sentinel_policy == "rollback":

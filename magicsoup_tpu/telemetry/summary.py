@@ -208,6 +208,13 @@ def validate_rows(rows: list[dict]) -> list[str]:
                 problems.append(
                     f"{where}: invariant row missing 'flags'/'step'"
                 )
+        elif kind == "warden":
+            # graftwarden world-level event (quarantine / heal /
+            # heal_failed / circuit_break — fleet.warden.FleetWarden)
+            if not isinstance(row.get("event"), str) or "step" not in row:
+                problems.append(
+                    f"{where}: warden row missing 'event'/'step'"
+                )
         elif kind != "meta":
             problems.append(f"{where}: unknown row type {kind!r}")
     return problems

@@ -34,6 +34,15 @@ lanes on every dispatch row), the warm steady state must pass
 ``hot_path_guard(compile_budget=0)``, and the fetch census must show
 exactly ONE host fetch per rung group per megastep (no per-world D2H).
 
+``--fleet-chaos`` runs the graftwarden smoke (GATING): a B=3 det fleet
+under ``policy="heal"`` has world 1 NaN-poisoned mid-run — only that
+world may be evicted, it must heal from its own rolling checkpoint
+stream (``restarts == 1``), the two healthy worlds' digests must stay
+BIT-identical to an identically-cadenced unpoisoned baseline, the
+poisoned lane's telemetry must validate and carry the
+quarantine -> heal warden events, and an armed (untripped) warden must
+leave the fetch census and compile census unchanged.
+
 ``--differential`` runs the graftcheck differential smoke (GATING): one
 seeded spawn/step/mutate/kill/divide/compact schedule driven through the
 classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
@@ -90,6 +99,8 @@ def main() -> None:
     )
     # graftfleet smoke (see fleet_main below)
     ap.add_argument("--fleet", action="store_true")
+    # graftwarden fault-isolation smoke (see fleet_chaos_main below)
+    ap.add_argument("--fleet-chaos", action="store_true")
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
@@ -101,6 +112,8 @@ def main() -> None:
         return differential_main(args)
     if args.fleet:
         return fleet_main(args)
+    if args.fleet_chaos:
+        return fleet_chaos_main(args)
 
     import jax
 
@@ -801,6 +814,200 @@ def fleet_main(args) -> None:
     )
     if problems:
         raise SystemExit("fleet smoke FAILED: " + "; ".join(problems))
+
+
+def fleet_chaos_main(args) -> None:
+    """GATING graftwarden smoke: per-world fault isolation under the
+    ``heal`` policy, end to end.
+
+    Gates, in order: a B=3 det fleet with world 1 NaN-poisoned mid-run
+    must evict ONLY that world, roll it back from its own checkpoint
+    stream and re-admit it (``restarts == 1``), while the two healthy
+    worlds' final digests stay BIT-identical to an identically-cadenced
+    unpoisoned baseline; the poisoned lane's telemetry must validate
+    and tell the quarantine -> heal story; and a warden-armed fleet
+    whose cadence exceeds the census window must keep the fetch census
+    at exactly ONE host fetch per rung group per megastep and pass
+    ``hot_path_guard(compile_budget=0)`` — arming the warden costs no
+    extra D2H and no recompiles.
+    """
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import numpy as np
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+    from magicsoup_tpu.guard import poison_world_mm
+    from magicsoup_tpu.telemetry import (
+        fetch_stats,
+        read_jsonl,
+        validate_rows,
+    )
+
+    mols = [
+        ms.Molecule("flc-a", 10e3),
+        ms.Molecule("flc-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+    def _world(seed):
+        w = ms.World(chemistry=chem, map_size=args.map_size, seed=seed)
+        w.deterministic = True
+        rng = random.Random(seed)
+        w.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        return w
+
+    kw = dict(
+        mol_name="flc-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=args.megastep,
+    )
+
+    def _digest(lane):
+        return (
+            np.asarray(jax.device_get(lane.world.molecule_map)).tobytes(),
+            np.asarray(lane.world.cell_molecules)[
+                : lane.world.n_cells
+            ].tobytes(),
+        )
+
+    def _run(ckpt_dir, poison_at):
+        Path(ckpt_dir).mkdir(parents=True, exist_ok=True)
+        fleet = FleetScheduler(block=4)
+        lanes = [fleet.admit(_world(10 + i), **kw) for i in range(3)]
+        warden = FleetWarden(
+            fleet,
+            policy="heal",
+            checkpoint_dir=ckpt_dir,
+            cadence=2,
+            keep=2,
+        )
+        tel_path = None
+        if poison_at is not None:
+            tel_path = Path(ckpt_dir) / "lane1.jsonl"
+            lanes[1].telemetry.attach(tel_path)
+        total = 14
+        for i in range(total):
+            if i == poison_at:
+                poison_world_mm(fleet, 1)
+            fleet.step()
+        fleet.flush()
+        if tel_path is not None:
+            lanes[1].telemetry.flush()
+        by_label = {rec.label: rec.lane for rec in warden._records}
+        return warden, by_label, tel_path
+
+    problems = []
+    tmp = Path(tempfile.mkdtemp(prefix="msoup-fleet-chaos-"))
+
+    # -- baseline: same warden config, same cadence, no poison --------
+    # (a cadence save is a lane flush, which is part of the det
+    # schedule — the bit-identity bar only means anything if both runs
+    # flush at the same boundaries)
+    _, base_lanes, _ = _run(tmp / "base", poison_at=None)
+    base_digest = {lbl: _digest(lane) for lbl, lane in base_lanes.items()}
+
+    # -- chaos run: world 1 poisoned after a cadence boundary ---------
+    warden, healed_lanes, tel_path = _run(tmp / "chaos", poison_at=5)
+    status = {s.label: s for s in warden.statuses()}
+    if status[1].status != "active" or status[1].restarts != 1:
+        problems.append(
+            f"world 1 not healed: status={status[1].status} "
+            f"restarts={status[1].restarts}"
+        )
+    for lbl in (0, 2):
+        if status[lbl].trips != 0:
+            problems.append(f"healthy world {lbl} tripped")
+        if _digest(healed_lanes[lbl]) != base_digest[lbl]:
+            problems.append(
+                f"world {lbl} diverged from the unpoisoned baseline"
+            )
+    healed_mm = np.asarray(
+        jax.device_get(healed_lanes[1].world.molecule_map)
+    )
+    if not np.isfinite(healed_mm).all():
+        problems.append("healed world still carries the NaN poison")
+    rows = read_jsonl(tel_path)
+    problems += [f"lane1: {p}" for p in validate_rows(rows)]
+    events = [r["event"] for r in rows if r.get("type") == "warden"]
+    if events != ["quarantine", "heal"]:
+        problems.append(
+            f"warden events {events} != ['quarantine', 'heal']"
+        )
+
+    # -- census: arming the warden costs no extra D2H, no compiles ----
+    fleet = FleetScheduler(block=4)
+    for i in range(3):
+        fleet.admit(_world(10 + i), **kw)
+    FleetWarden(
+        fleet,
+        policy="heal",
+        checkpoint_dir=tmp / "census",
+        cadence=50,  # > the census window: no flush inside it
+        keep=2,
+    )
+    for _ in range(args.warmup + 1):
+        fleet.step()
+    fleet.drain()
+    n_groups = len(fleet._groups)
+    f0 = fetch_stats()["fetches"]
+    try:
+        with runtime.hot_path_guard(compile_budget=0):
+            for _ in range(args.steps):
+                fleet.step()
+            fleet.drain()
+    except runtime.CompileBudgetExceeded as e:
+        problems.append(str(e))
+    fetches = fetch_stats()["fetches"] - f0
+    if fetches != args.steps * n_groups:
+        problems.append(
+            f"fetch census with warden armed: {fetches} fetches for "
+            f"{args.steps} megasteps x {n_groups} groups"
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": "fleet chaos smoke (graftwarden heal, cpu)",
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "world1": {
+                    "status": status[1].status,
+                    "trips": status[1].trips,
+                    "restarts": status[1].restarts,
+                },
+                "warden_events": events,
+                "fetches_per_megastep": fetches / max(args.steps, 1),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("fleet chaos smoke FAILED: " + "; ".join(problems))
 
 
 def chaos_main(args) -> None:

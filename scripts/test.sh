@@ -47,6 +47,15 @@ python performance/smoke.py --chaos
 # fleet_size lanes on every dispatch row.  Exits nonzero on any
 # violation.
 python performance/smoke.py --fleet
+# graftwarden fault-isolation smoke (GATING): a B=3 det fleet under
+# policy="heal" has one world NaN-poisoned mid-run — only that world
+# may be evicted, it must heal from its own rolling checkpoint stream,
+# the two healthy worlds' digests must stay BIT-identical to an
+# identically-cadenced unpoisoned baseline, the poisoned lane's
+# telemetry must validate with the quarantine -> heal warden events,
+# and an armed (untripped) warden must leave the fetch census and
+# compile census unchanged.  Exits nonzero on any violation.
+python performance/smoke.py --fleet-chaos
 # graftcheck differential smoke (GATING): one seeded
 # spawn/step/mutate/kill/divide/compact schedule through the classic
 # driver, the stepper at K=1 and K=4, and a 2-tile mesh — all four

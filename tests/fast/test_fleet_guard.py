@@ -184,3 +184,51 @@ def test_wrong_format_rejected_both_directions(stepped_fleet, tmp_path):
     with pytest.raises(guard.CheckpointError) as e:
         restore_world(fleet_path, -4)
     assert e.value.check == "index"
+
+
+# chemistry-only twin of _KW: populations never change, so the audit's
+# row sampling at restore time sees the same census the injector saw
+_KW_CHEM = dict(
+    _KW,
+    kill_below=-1.0,
+    divide_above=1e30,
+    divide_cost=0.0,
+    target_cells=None,
+    p_mutation=0.0,
+    p_recombination=0.0,
+)
+
+
+def test_restore_audit_rejects_seeded_corruption(tmp_path):
+    """The deep-audit seam of the fleet restore: a world whose resident
+    params were desynced from its genomes BEFORE the save produces a
+    checkpoint whose byte checks all pass — ``audit=False`` restores it
+    happily, ``audit=True`` refuses it with the typed failure, and the
+    healthy neighbours in the same file pass the same audit."""
+    from magicsoup_tpu import check
+
+    fleet = FleetScheduler(block=4)
+    lanes = [fleet.admit(_world(s), **_KW_CHEM) for s in (7, 11, 17)]
+    for _ in range(2):
+        fleet.step()
+    assert lanes[1]._fleet_resident
+    row = guard.corrupt_world_params(fleet, 1)
+    path = save_fleet(tmp_path / "fleet.msck", fleet)
+
+    # the file itself is intact — digest/format checks pass
+    restore_world(path, 1, audit=False)
+    # the genome/params cross-check refuses the corrupted world
+    with pytest.raises(check.AuditFailed) as err:
+        restore_world(path, 1, audit=True)
+    hits = [
+        v
+        for v in err.value.violations
+        if v.code == "params_genome_mismatch"
+    ]
+    assert hits and row in hits[0].rows
+    # its neighbours in the SAME checkpoint pass the same audit
+    restore_world(path, 0, audit=True)
+    restore_world(path, 2, audit=True)
+    # whole-fleet restore under audit refuses too
+    with pytest.raises(check.AuditFailed):
+        restore_fleet(path, FleetScheduler(block=4), _KW_CHEM, audit=True)

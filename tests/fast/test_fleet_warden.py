@@ -1,0 +1,424 @@
+"""
+graftwarden (:mod:`magicsoup_tpu.fleet.warden`): per-world fault
+isolation and self-healing, pinned in det mode.
+
+The acceptance contracts:
+
+- **Isolation**: in a B=3 det fleet where world 1 is NaN-poisoned
+  mid-run, ONLY world 1 is evicted and the other two worlds' state
+  digests are BIT-identical to the same schedule run unpoisoned.
+- **Heal round-trip**: under ``policy="heal"`` the poisoned world rolls
+  back to its own rolling checkpoint stream and re-admits through the
+  warm rung with ZERO new compiles; after ``max_restarts`` trips the
+  circuit breaker parks it with a typed status.
+- **Streams**: N per-world :class:`~magicsoup_tpu.guard.CheckpointManager`
+  streams share one directory via prefix scoping, each with its own
+  rolling retention, and a corrupt newest file walks back per stream.
+
+A warden cadence save is a lane flush, which is itself part of the
+deterministic schedule — so heal baselines run an identically
+configured (unpoisoned) warden, while the quarantine baseline (no
+cadence) is a plain wardenless fleet.
+"""
+import json
+import random
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import guard
+from magicsoup_tpu.analysis import runtime
+from magicsoup_tpu.fleet import FleetScheduler, FleetWarden, WardenStatus
+from magicsoup_tpu.fleet.scheduler import _SharedFetch
+from magicsoup_tpu.guard import (
+    CheckpointError,
+    CheckpointManager,
+    GuardConfigError,
+    WatchdogTimeout,
+    flip_byte,
+    poison_world_mm,
+)
+from magicsoup_tpu.stepper import (
+    HEALTH_WORD,
+    INVARIANT_WORD,
+    record_flag_views,
+)
+from magicsoup_tpu.telemetry import validate_rows
+
+_MOLS = [
+    ms.Molecule("fw-a", 10e3),
+    ms.Molecule("fw-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+# chemistry-only workload: populations never change, so the det
+# schedule is easy to reason about while still exercising the full
+# fused step
+_KW = dict(
+    mol_name="fw-atp",
+    kill_below=-1.0,
+    divide_above=1e30,
+    divide_cost=0.0,
+    target_cells=None,
+    genome_size=200,
+    lag=1,
+    p_mutation=0.0,
+    p_recombination=0.0,
+    megastep=2,
+)
+
+
+def _world(seed):
+    world = ms.World(chemistry=_CHEM, map_size=16, seed=seed)
+    world.deterministic = True
+    rng = random.Random(seed)
+    world.spawn_cells([ms.random_genome(s=200, rng=rng) for _ in range(24)])
+    return world
+
+
+def _fingerprint(lane) -> dict:
+    world = lane.world
+    snap = guard.snapshot_run(world, lane)
+    aux = snap["stepper"]
+    return {
+        "mm": np.asarray(jax.device_get(world.molecule_map)),
+        "cm": np.asarray(world.cell_molecules)[: world.n_cells],
+        "key": np.asarray(aux["key"]),
+        "stepper_rng": repr(aux["rng_state"]),
+    }
+
+
+def _assert_identical(a: dict, b: dict, label=""):
+    assert a.keys() == b.keys()
+    for k in a:
+        if isinstance(a[k], np.ndarray):
+            assert a[k].tobytes() == b[k].tobytes(), f"{label}{k} differs"
+        else:
+            assert a[k] == b[k], f"{label}{k} differs"
+
+
+def _read_rows(path):
+    return [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line.strip()
+    ]
+
+
+# ------------------------------------------------- quarantine isolation
+@pytest.fixture(scope="module")
+def quarantine_run(tmp_path_factory):
+    """B=3 det fleet, world 1 poisoned at step 3 of 8, quarantine
+    policy — plus the same schedule unpoisoned and wardenless as the
+    bit-identity baseline (cadence=0, so no flushes differ)."""
+    T, poison_at = 8, 3
+
+    base = FleetScheduler(block=4)
+    base_lanes = [base.admit(_world(10 + i), **_KW) for i in range(3)]
+    for _ in range(T):
+        base.step()
+    base.flush()
+    base_fp = [_fingerprint(lane) for lane in base_lanes]
+
+    tel_path = tmp_path_factory.mktemp("warden-q") / "lane1.jsonl"
+    sch = FleetScheduler(block=4)
+    lanes = [sch.admit(_world(10 + i), **_KW) for i in range(3)]
+    warden = FleetWarden(sch, policy="quarantine")
+    lanes[1].telemetry.attach(tel_path)
+    for i in range(T):
+        if i == poison_at:
+            poison_world_mm(sch, 1)
+        sch.step()
+    sch.flush()
+    lanes[1].telemetry.flush()
+    return {
+        "warden": warden,
+        "sch": sch,
+        "lanes": lanes,
+        "base_fp": base_fp,
+        "rows": _read_rows(tel_path),
+    }
+
+
+def test_quarantine_isolates_the_poisoned_world(quarantine_run):
+    """Acceptance criterion: only the poisoned world is evicted, and
+    the two healthy worlds' digests are BIT-identical to the same
+    schedule run unpoisoned."""
+    r = quarantine_run
+    assert len(r["sch"].lanes) == 2
+    _assert_identical(_fingerprint(r["lanes"][0]), r["base_fp"][0], "w0 ")
+    _assert_identical(_fingerprint(r["lanes"][2]), r["base_fp"][2], "w2 ")
+
+
+def test_quarantine_status_is_typed(quarantine_run):
+    w = quarantine_run["warden"]
+    by_label = {s.label: s for s in w.statuses()}
+    assert isinstance(by_label[1], WardenStatus)
+    assert by_label[1].status == "parked"
+    assert by_label[1].trips >= 1
+    assert "sentinel" in by_label[1].reason
+    assert by_label[0].status == "active"
+    assert by_label[2].status == "active"
+    assert w.status_of(1).status == "parked"
+    with pytest.raises(KeyError):
+        w.status_of(99)
+
+
+def test_quarantine_parks_a_standalone_lane(quarantine_run):
+    """The evicted lane is a standalone stepper again — state intact
+    (NaN and all), no longer fleet-resident, still flushable."""
+    r = quarantine_run
+    parked = r["warden"].parked()
+    assert parked == [r["lanes"][1]]
+    lane = parked[0]
+    assert lane._fleet_slot is None
+    lane.flush()
+    mm = np.asarray(jax.device_get(lane.world.molecule_map))
+    assert not np.isfinite(mm).all(), "the poison should still be there"
+
+
+def test_warden_telemetry_rows_validate(quarantine_run):
+    """Warden-routed sentinel rows and warden event rows pass the
+    telemetry schema gate and carry the per-world tags."""
+    rows = quarantine_run["rows"]
+    assert validate_rows(rows) == []
+    sentinel = [r for r in rows if r["type"] == "sentinel"]
+    assert sentinel, "no sentinel rows routed through the warden"
+    for row in sentinel:
+        assert row["policy"] == "warden-quarantine"
+        assert row["world"] == 1
+        assert "fleet_slot" in row
+    events = [r for r in rows if r["type"] == "warden"]
+    assert [r["event"] for r in events] == ["quarantine"]
+    assert events[0]["world"] == 1
+
+
+def test_warn_policy_only_counts(tmp_path):
+    """Under ``warn`` nothing is evicted: trips are tallied per world
+    and the fleet keeps stepping all B members."""
+    sch = FleetScheduler(block=4)
+    lanes = [sch.admit(_world(10 + i), **_KW) for i in range(3)]
+    warden = FleetWarden(sch, policy="warn")
+    for i in range(6):
+        if i == 2:
+            poison_world_mm(sch, 1)
+        sch.step()
+    sch.flush()
+    assert len(sch.lanes) == 3
+    by_label = {s.label: s for s in warden.statuses()}
+    assert by_label[1].status == "active"
+    assert by_label[1].trips >= 1
+    assert by_label[1].last_flags != 0
+    assert by_label[0].trips == 0
+    assert lanes[1].stats["sentinel_trips"] >= 1
+
+
+# ------------------------------------------------------ heal round-trip
+@pytest.fixture(scope="module")
+def heal_run(tmp_path_factory):
+    """B=3 det fleet under ``heal`` (cadence=2, keep=2), world 1
+    poisoned at step 5 of 14 — and the identically configured
+    unpoisoned baseline (cadence flushes are part of the schedule)."""
+    T, poison_at = 14, 5
+    base_dir = tmp_path_factory.mktemp("warden-heal-base")
+    run_dir = tmp_path_factory.mktemp("warden-heal-run")
+
+    base = FleetScheduler(block=4)
+    base_lanes = [base.admit(_world(10 + i), **_KW) for i in range(3)]
+    FleetWarden(
+        base, policy="heal", checkpoint_dir=base_dir, cadence=2, keep=2
+    )
+    for _ in range(T):
+        base.step()
+    base.flush()
+    base_fp = [_fingerprint(lane) for lane in base_lanes]
+
+    tel_path = run_dir / "lane1.jsonl"
+    sch = FleetScheduler(block=4)
+    lanes = [sch.admit(_world(10 + i), **_KW) for i in range(3)]
+    warden = FleetWarden(
+        sch,
+        policy="heal",
+        checkpoint_dir=run_dir / "ckpt",
+        cadence=2,
+        keep=2,
+        max_restarts=3,
+        backoff_base=1,
+    )
+    lanes[1].telemetry.attach(tel_path)
+    compile_before = None
+    for i in range(T):
+        if i == poison_at:
+            poison_world_mm(sch, 1)
+        if i == poison_at + 1:
+            # everything past the poison scatter itself — the trip
+            # replay, the eviction restack, the heal re-admission and
+            # the cadence saves — must reuse warm programs
+            compile_before = runtime.compile_count()
+        sch.step()
+    compile_delta = runtime.compile_count() - compile_before
+    sch.flush()
+    lanes[1].telemetry.flush()
+    return {
+        "warden": warden,
+        "sch": sch,
+        "base_fp": base_fp,
+        "compile_delta": compile_delta,
+        "ckpt_dir": run_dir / "ckpt",
+        "keep": 2,
+        "rows": _read_rows(tel_path),
+    }
+
+
+def test_heal_restores_and_readmits(heal_run):
+    """The poisoned world rolls back to its own stream and rejoins the
+    fleet; the healthy worlds never notice (BIT-identical to the
+    warden-armed unpoisoned baseline)."""
+    r = heal_run
+    w = r["warden"]
+    by_label = {s.label: s for s in w.statuses()}
+    assert by_label[1].status == "active"
+    assert by_label[1].restarts == 1
+    assert by_label[1].trips >= 1
+    assert len(r["sch"].lanes) == 3
+    rec_by_label = {rec.label: rec.lane for rec in w._records}
+    _assert_identical(_fingerprint(rec_by_label[0]), r["base_fp"][0], "w0 ")
+    _assert_identical(_fingerprint(rec_by_label[2]), r["base_fp"][2], "w2 ")
+    # the healed world resumed a VALID trajectory: poison gone
+    healed = rec_by_label[1]
+    mm = np.asarray(jax.device_get(healed.world.molecule_map))
+    assert np.isfinite(mm).all()
+
+
+def test_heal_compiles_nothing_at_the_warm_rung(heal_run):
+    """Acceptance criterion: eviction + rollback + re-admission run
+    entirely through warm compiled programs — zero new compiles from
+    the step after the poison to the end of the run."""
+    assert heal_run["compile_delta"] == 0
+
+
+def test_heal_telemetry_tells_the_story(heal_run):
+    """quarantine -> heal, in order, on the poisoned world's stream."""
+    rows = heal_run["rows"]
+    assert validate_rows(rows) == []
+    events = [r for r in rows if r["type"] == "warden"]
+    assert [r["event"] for r in events] == ["quarantine", "heal"]
+    heal = events[1]
+    assert heal["restarts"] == 1
+    assert heal["checkpoint_step"] is not None
+
+
+def test_per_world_streams_share_the_directory(heal_run):
+    """Satellite: each world owns a prefix-scoped rolling stream in the
+    ONE warden directory, each pruned to ``keep`` independently."""
+    files = sorted(p.name for p in heal_run["ckpt_dir"].glob("*.msck"))
+    by_world = {}
+    for name in files:
+        by_world.setdefault(name.rsplit("-", 1)[0], []).append(name)
+    assert set(by_world) == {"world-000", "world-001", "world-002"}
+    for world, names in by_world.items():
+        assert 1 <= len(names) <= heal_run["keep"], (world, names)
+
+
+def test_circuit_breaker_parks_after_budget(tmp_path):
+    """A world that keeps tripping is healed ``max_restarts`` times,
+    then parked with the typed circuit-breaker reason — while the rest
+    of the fleet keeps stepping."""
+    sch = FleetScheduler(block=4)
+    [sch.admit(_world(10 + i), **_KW) for i in range(3)]
+    warden = FleetWarden(
+        sch,
+        policy="heal",
+        checkpoint_dir=tmp_path,
+        cadence=2,
+        keep=2,
+        max_restarts=1,
+        backoff_base=1,
+    )
+    world1 = {rec.label: rec for rec in warden._records}
+    for i in range(18):
+        if i in (3, 10):
+            # the healed world's slot in scheduler.lanes moves after the
+            # evict/re-admit churn — resolve it through the warden
+            slot = sch.lanes.index(world1[1].lane)
+            poison_world_mm(sch, slot)
+        sch.step()
+    sch.flush()
+    status = warden.status_of(1)
+    assert status.status == "parked"
+    assert status.restarts == 1
+    assert "circuit breaker" in status.reason
+    assert len(sch.lanes) == 2
+    by_label = {s.label: s for s in warden.statuses()}
+    assert by_label[0].status == "active"
+    assert by_label[2].status == "active"
+
+
+# -------------------------------------------- stream corruption walk-back
+def test_streams_walk_back_independently(tmp_path):
+    """Satellite: corrupting the newest file of ONE world's stream
+    makes only that stream walk back (with a warning); the sibling
+    streams in the same directory still load their newest."""
+    mgrs = [
+        CheckpointManager(tmp_path, keep=2, prefix=f"world-{i:03d}")
+        for i in range(3)
+    ]
+    for step in (0, 2, 4):
+        for i, mgr in enumerate(mgrs):
+            mgr.save({"world": i, "step": step}, step=step)
+    # retention is per stream, inside the shared directory
+    assert len(list(tmp_path.glob("*.msck"))) == 6
+    flip_byte(mgrs[1].checkpoints()[-1][1], offset=-1)
+    with pytest.warns(UserWarning, match="falling back"):
+        payload, meta, _path = mgrs[1].load_latest()
+    assert payload == {"world": 1, "step": 2}
+    for i in (0, 2):
+        payload, meta, _path = mgrs[i].load_latest()
+        assert payload == {"world": i, "step": 4}
+    # a stream with nothing loadable raises the typed error
+    with pytest.raises(CheckpointError):
+        CheckpointManager(tmp_path, keep=2, prefix="world-009").load_latest()
+
+
+# ------------------------------------------------- flag views + watchdog
+def test_record_flag_views_are_zero_copy():
+    """The per-slot health/invariant words come straight out of the
+    already-fetched record — views, not copies, for any leading shape."""
+    for shape in ((11,), (4, 11), (3, 4, 11)):
+        arr = np.arange(int(np.prod(shape))).reshape(shape)
+        health, invariants = record_flag_views(arr)
+        assert np.array_equal(health, arr[..., HEALTH_WORD])
+        assert np.array_equal(invariants, arr[..., INVARIANT_WORD])
+        assert np.shares_memory(health, arr)
+        assert np.shares_memory(invariants, arr)
+
+
+def test_shared_fetch_timeout_is_typed():
+    """Satellite: a wedged fleet fetch raises WatchdogTimeout tagged
+    with the fleet phase (not a bare concurrent.futures timeout)."""
+    fetch = _SharedFetch(
+        Future(), timeout=0.05, context={"B": 3, "k": 2, "slots": [0, 1, 2]}
+    )
+    with pytest.raises(WatchdogTimeout) as err:
+        fetch.result()
+    assert err.value.phase == "fleet-fetch"
+    assert not isinstance(err.value, TimeoutError)
+
+
+# ------------------------------------------------------- config refusals
+def test_warden_config_refusals(tmp_path):
+    sch = FleetScheduler(block=4)
+    with pytest.raises(GuardConfigError, match="policy"):
+        FleetWarden(sch, policy="smite")
+    with pytest.raises(GuardConfigError, match="cadence"):
+        FleetWarden(sch, policy="warn", cadence=-1)
+    with pytest.raises(GuardConfigError, match="checkpoint_dir"):
+        FleetWarden(sch, policy="heal")
+    with pytest.raises(GuardConfigError, match="cadence"):
+        FleetWarden(sch, policy="heal", checkpoint_dir=tmp_path, cadence=0)
+    FleetWarden(sch, policy="warn")
+    with pytest.raises(GuardConfigError, match="already"):
+        FleetWarden(sch, policy="warn")

@@ -56,6 +56,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl010_unsafe_save.py", "GL010"),
         ("gl011_traced_assert.py", "GL011"),
         ("gl012_shared_key.py", "GL012"),
+        ("gl013_swallowed_guard.py", "GL013"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -180,6 +181,60 @@ def test_gl012_scoped_to_fleet_modules(tmp_path):
     p = tmp_path / "gl012_not_fleet.py"
     p.write_text(stripped)
     assert analyze([p], rules=["GL012"]) == []
+
+
+def test_gl013_waivable_like_the_other_rules(tmp_path):
+    # a handler that deliberately delivers the error elsewhere (the
+    # fetch worker's future.set_exception) waives with the standard
+    # inline annotation; pin that the machinery covers GL013
+    src = (FIXTURES / "gl013_swallowed_guard.py").read_text()
+    waived = src.replace(
+        "# GL013: swallows the typed guard errors",
+        "# graftlint: disable=GL013 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl013_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl013_scoped_to_guard_modules(tmp_path):
+    # the SAME broad handler is silent once the module stops being
+    # guard-scoped: outside the guard/fleet stack there are no typed
+    # guard errors in flight to swallow
+    src = (FIXTURES / "gl013_swallowed_guard.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu.guard.errors import CheckpointError"
+        "  # noqa: F401  (marks the module guard-scoped)",
+        "CheckpointError = RuntimeError",
+    )
+    assert stripped != src
+    p = tmp_path / "gl013_not_guard.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL013"]) == []
+
+
+def test_gl013_reraise_and_specific_catch_pass(tmp_path):
+    # a bare `except:` with no re-raise is the same swallow spelled
+    # differently; a handler that re-raises after cleanup passes
+    p = tmp_path / "gl013_forms.py"
+    p.write_text(
+        "from magicsoup_tpu import guard  # noqa: F401\n"
+        "def bad(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except:  # noqa: E722\n"
+        "        return None\n"
+        "def good(fn, log):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except BaseException:\n"
+        "        log()\n"
+        "        raise\n"
+    )
+    findings = analyze([p], rules=["GL013"])
+    assert [f.rule for f in findings] == ["GL013"]
+    assert findings[0].line == 5
 
 
 def test_gl010_write_form_detected(tmp_path):
