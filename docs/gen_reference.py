@@ -92,6 +92,20 @@ MODULES = {
         " fetch, rolling per-world checkpoint streams, and a bounded"
         " restart budget with circuit breaking."
     ),
+    "magicsoup_tpu.serve": (
+        "graftserve multi-tenant fleet serving: stdlib HTTP/JSON"
+        " front-end, single-writer scheduler loop, compile-budget"
+        " admission control, per-tenant accounting, crash-safe tenant"
+        " registry (`python -m magicsoup_tpu.serve`)."
+    ),
+    "magicsoup_tpu.serve.api": (
+        "graftserve wire format: tenant spec validation, admission"
+        " signatures, HTTP routing."
+    ),
+    "magicsoup_tpu.serve.accounting": (
+        "Per-tenant usage ledger: steps, dispatches, fetch bytes and"
+        " trip counters, conserved exactly against process totals."
+    ),
     "magicsoup_tpu.fleet.sharding": (
         "World-axis data parallelism: shard the fleet's leading axis"
         " over a `P(\"world\")` device mesh (no collectives — worlds are"

@@ -21,6 +21,8 @@ Findings; registration at the bottom.
 | GL013 | swallowed-guard-error| typed guard errors reach their policy layer|
 |       |                      | (no broad `except` without re-raise in     |
 |       |                      | guard/fleet-scoped modules)                |
+| GL014 | blocking-call-in-    | serve-loop liveness (no unbounded sleeps / |
+|       | serve-loop           | waits inside serve-scoped scheduler loops) |
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -160,6 +162,14 @@ RULE_INFO = {
         "exist so the policy layer can react; a blanket handler that "
         "logs-and-continues turns a refused checkpoint or a tripped "
         "sentinel into silent corruption",
+    ),
+    "GL014": (
+        "blocking-call-in-serve-loop",
+        "an unbounded blocking call (`time.sleep`, `.result()` with no "
+        "timeout, `.get()` with no timeout) inside a loop in a "
+        "serve-scoped module — the serving loop is the single writer "
+        "for every tenant, so one unbounded wait stalls all of them "
+        "and turns a transient hiccup into a fleet-wide outage",
     ),
 }
 
@@ -1240,6 +1250,104 @@ def check_gl013(ctx: Context):
             )
 
 
+def _is_serve_scoped(f) -> bool:
+    """A file is serve-scoped when it lives under a ``serve`` package
+    or imports one — the modules that run (or ride on) the service's
+    single-writer scheduler loop, where an unbounded wait blocks every
+    tenant at once."""
+    if "serve" in f.path.parts:
+        return True
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "serve" in node.module.split("."):
+                return True
+            if any(a.name == "serve" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("serve" in a.name.split(".") for a in node.names):
+                return True
+    return False
+
+
+def check_gl014(ctx: Context):
+    """Serve loops must stay live.  The serving layer runs ONE
+    scheduler thread for every tenant; any unbounded blocking call
+    inside one of its loops — ``time.sleep`` pacing, a ``.result()``
+    with no timeout on a future, a ``queue.get()`` with no timeout —
+    parks the whole fleet behind a single wait that nothing can
+    interrupt (a wedged transfer then looks identical to a busy
+    service, and SIGTERM drain deadlines silently slip).  The
+    sanctioned shapes are the non-blocking drain
+    (``get_nowait()``/``Event.wait(timeout=...)``) and
+    timeout-bounded waits whose expiry surfaces as a typed error.
+    Scope: ``while`` loops in serve-scoped modules — request handlers
+    and one-shot commands may block (their caller holds the timeout).
+    """
+    fix = (
+        "bound the wait (`q.get(timeout=...)`, `fut.result(timeout=...)`)"
+        " or drain without blocking (`get_nowait()` + `Event.wait(t)`); "
+        "waive a deliberately blocking wait with "
+        "`# graftlint: disable=GL014`"
+    )
+    for f in ctx.files:
+        if not _is_serve_scoped(f):
+            continue
+        for loop in ast.walk(f.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                kwargs = {k.arg for k in node.keywords}
+                chain = _attr_chain(node.func)
+                if chain == "time.sleep":
+                    yield _finding(
+                        "GL014",
+                        f,
+                        node,
+                        "`time.sleep()` inside a serve-loop `while` — "
+                        "sleep pacing blocks every tenant and ignores "
+                        "wake/stop events",
+                        fix,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and not node.args
+                    and "timeout" not in kwargs
+                ):
+                    yield _finding(
+                        "GL014",
+                        f,
+                        node,
+                        f"`{chain}()` without a timeout inside a "
+                        "serve-loop `while` — an unfinished future "
+                        "wedges the single scheduler thread forever",
+                        fix,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and not node.args
+                    and "timeout" not in kwargs
+                    and not any(
+                        k.arg == "block"
+                        and isinstance(k.value, ast.Constant)
+                        and k.value.value is False
+                        for k in node.keywords
+                    )
+                ):
+                    yield _finding(
+                        "GL014",
+                        f,
+                        node,
+                        f"`{chain}()` without a timeout inside a "
+                        "serve-loop `while` — an empty queue blocks "
+                        "the loop with no way to observe stop/wake",
+                        fix,
+                    )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1254,6 +1362,7 @@ CHECKERS = {
     "GL011": check_gl011,
     "GL012": check_gl012,
     "GL013": check_gl013,
+    "GL014": check_gl014,
 }
 
 

@@ -184,6 +184,48 @@ def phenotype_cache_stats() -> dict[str, int]:
 
 
 # ----------------------------------------------------------------- #
+# fleet restack / reattach counters                                 #
+# ----------------------------------------------------------------- #
+# process-wide accumulators fed by the FleetScheduler's restack paths
+# and the stepper's flush->reattach boundary — the observability hook
+# the serve accounting layer bills restack work through, and the pin
+# that the incremental paths actually skip work
+_restack_full = 0
+_restack_inserts = 0
+_restack_skipped = 0
+_attach_full = 0
+_attach_skipped = 0
+
+
+def note_restack(
+    full: int = 0, inserts: int = 0, skipped: int = 0
+) -> None:
+    """Accumulate fleet restack work (called by the scheduler).
+
+    ``full`` counts whole-group ``stack_worlds`` rebuilds (shape change
+    or first stack), ``inserts`` counts single-slot incremental moves
+    (re-insert / zero of one changed slot), ``skipped`` counts resident
+    worlds an incremental restack left in place untouched."""
+    global _restack_full, _restack_inserts, _restack_skipped
+    with _lock:
+        _restack_full += full
+        _restack_inserts += inserts
+        _restack_skipped += skipped
+
+
+def note_attach(full: int = 0, skipped: int = 0) -> None:
+    """Accumulate flush->reattach outcomes (called by the stepper).
+
+    ``full`` counts full host-replay rebuilds, ``skipped`` counts fast
+    reattaches that proved the world untouched since its flush and kept
+    the host replay state (and warm-variant bookkeeping) as-is."""
+    global _attach_full, _attach_skipped
+    with _lock:
+        _attach_full += full
+        _attach_skipped += skipped
+
+
+# ----------------------------------------------------------------- #
 # unified counter API (telemetry / tests)                           #
 # ----------------------------------------------------------------- #
 def snapshot() -> dict[str, int]:
@@ -194,7 +236,9 @@ def snapshot() -> dict[str, int]:
     accessor calls that could interleave with concurrent compiles.
     Keys: ``compiles``, ``persistent_cache_hits``,
     ``persistent_cache_misses``, ``phenotype_hits``,
-    ``phenotype_misses``, ``phenotype_evictions``.
+    ``phenotype_misses``, ``phenotype_evictions``, ``restack_full``,
+    ``restack_inserts``, ``restack_skipped``, ``attach_full``,
+    ``attach_skipped``.
     """
     install()
     with _lock:
@@ -205,6 +249,11 @@ def snapshot() -> dict[str, int]:
             "phenotype_hits": _pheno_hits,
             "phenotype_misses": _pheno_misses,
             "phenotype_evictions": _pheno_evictions,
+            "restack_full": _restack_full,
+            "restack_inserts": _restack_inserts,
+            "restack_skipped": _restack_skipped,
+            "attach_full": _attach_full,
+            "attach_skipped": _attach_skipped,
         }
 
 
@@ -219,6 +268,8 @@ def reset_counters() -> None:
     """
     global _count, _cache_hits, _cache_misses
     global _pheno_hits, _pheno_misses, _pheno_evictions
+    global _restack_full, _restack_inserts, _restack_skipped
+    global _attach_full, _attach_skipped
     with _lock:
         _count = 0
         _cache_hits = 0
@@ -226,3 +277,8 @@ def reset_counters() -> None:
         _pheno_hits = 0
         _pheno_misses = 0
         _pheno_evictions = 0
+        _restack_full = 0
+        _restack_inserts = 0
+        _restack_skipped = 0
+        _attach_full = 0
+        _attach_skipped = 0

@@ -7,13 +7,22 @@ Grouping
     Worlds are bucketed by **capacity rung** — the tuple of every
     shape/static that feeds the compiled fleet program (state and
     constant leaf shapes, spawn/push blocks, megastep ``k``, division
-    budget cap, det/pallas flags).  Each rung owns one group with a
-    power-of-two number of slots; admitting a world into a rung whose
-    group has a free slot changes NO program shape, so a warm rung
+    budget cap, det/pallas flags).  Each rung owns a list of sibling
+    groups with a FIXED power-of-two slot count; admitting a world into
+    a rung with a free slot changes NO program shape, so a warm rung
     admits with **zero new compiles** (pinned via ``analysis.runtime``
-    compile counters in tests/fast/test_fleet.py).  A full group
-    doubles its slot count — that is a new shape and recompiles, the
-    one documented admission cliff.
+    compile counters in tests/fast/test_fleet.py).
+
+    Padded-slot admission (default, ``grow="pad"``): when every sibling
+    group is full, the rung opens ANOTHER block-sized group whose
+    pre-padded dead slots hold zero worlds.  Token capacities are
+    unified per RUNG (grow-only), so the new group's program shapes
+    equal its siblings' — its stack/step/extract/insert dispatches all
+    hit the already-compiled programs and admission past a full group
+    stays pure data movement.  The legacy ``grow="double"`` mode keeps
+    the old behavior (a full group doubles its slot count — a new shape,
+    one recompile for the whole rung) as the reference path the
+    padded-admission bit-identity pin compares against.
 
 Stepping
     ``step()`` runs every lane's solo ``_prepare_dispatch`` (all host
@@ -43,6 +52,7 @@ from magicsoup_tpu.fleet.batch import (
     stack_worlds,
     zeros_world_like,
 )
+from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.fleet.lanes import FleetLane
 from magicsoup_tpu.stepper import _LazyFetch
 
@@ -165,7 +175,14 @@ class _FleetGroup:
         self.consts_ids: tuple | None = None
         self.maxp = 0
         self.maxd = 0
-        self.dirty = True  # full restack needed before next dispatch
+        self.dirty = True  # restack needed before next dispatch
+        # shape the current fstate/fparams were stacked at — while it
+        # matches, a dirty group restacks INCREMENTALLY (only changed
+        # slots move) instead of rebuilding the whole stack
+        self.stacked_shape: tuple | None = None
+        # freshly vacated slots whose stack slices still hold live data
+        # (zeroed by the next restack)
+        self.stale: set[int] = set()
         self.warm: set[tuple] = set()
         self.empty_spawn: dict[tuple, Any] = {}
         self.empty_push: dict[tuple, Any] = {}
@@ -185,17 +202,29 @@ class FleetScheduler:
     ``megastep`` with one dispatch and one fetch per group.
 
     Parameters:
-        block: Initial slot count of a new group (power of two).  Spare
-            slots are what make admission free — a group only recompiles
-            when it outgrows its block and doubles.
+        block: Slot count of a group (power of two).  Spare slots are
+            what make admission free — pre-padded dead slots admit with
+            pure data movement.
+        grow: ``"pad"`` (default) opens a sibling block-sized group when
+            a rung is full (same program shapes, zero new compiles);
+            ``"double"`` keeps the legacy behavior of doubling the one
+            group's slot count (a new shape — recompiles the rung).
     """
 
-    def __init__(self, *, block: int = 4):
+    def __init__(self, *, block: int = 4, grow: str = "pad"):
         if block < 1:
             raise ValueError("block must be >= 1")
+        if grow not in ("pad", "double"):
+            raise ValueError('grow must be "pad" or "double"')
         self.block = 1 << (int(block) - 1).bit_length()  # round up to pow2
+        self.grow = grow
         self.lanes: list[FleetLane] = []
-        self._groups: dict[tuple, _FleetGroup] = {}
+        # rung key -> sibling groups (one per key in "double" mode)
+        self._groups: dict[tuple, list[_FleetGroup]] = {}
+        # rung key -> grow-only (max_proteins, max_doms) unified across
+        # sibling groups so they share program shapes; remembered past
+        # group teardown so a re-created rung re-hits warm programs
+        self._rung_caps: dict[tuple, tuple[int, int]] = {}
         self._warden = None  # bound by fleet.warden.FleetWarden
 
     # ------------------------------------------------------------ #
@@ -231,16 +260,40 @@ class FleetScheduler:
         if lane._fleet_slot is not None:
             group, slot = lane._fleet_slot
             group.slots[slot] = None
+            group.stale.add(slot)  # slice still holds the lane's data
             group.dirty = True
             group.consts_ids = None
             lane._fleet_slot = None
             if not group.members():
-                self._groups.pop(group.key, None)
+                self._drop_group(group)
         self.lanes.remove(lane)
         lane._fleet = None
         if self._warden is not None:
             self._warden._on_retire(lane)
         return lane
+
+    def readmit(self, lane: FleetLane) -> FleetLane:
+        """Re-join a previously :meth:`retire`-d lane WITHOUT rebuilding
+        it: the lane object keeps all of its pipeline state (host replay
+        lists, RNG schedule, telemetry, stats), so a retire/readmit round
+        trip — the serve layer's budget pause — is invisible to the
+        world's trajectory.  Placement happens at the next ``step()``."""
+        if not isinstance(lane, FleetLane):
+            raise TypeError("readmit() takes the FleetLane retire() returned")
+        if lane._fleet is not None:
+            raise ValueError("lane is already managed by a scheduler")
+        lane._fleet = self
+        self.lanes.append(lane)
+        if self._warden is not None:
+            self._warden._on_admit(lane)
+        return lane
+
+    def _drop_group(self, group: _FleetGroup) -> None:
+        siblings = self._groups.get(group.key)
+        if siblings and group in siblings:
+            siblings.remove(group)
+            if not siblings:
+                self._groups.pop(group.key, None)
 
     # ------------------------------------------------------------ #
     # stepping                                                     #
@@ -258,9 +311,10 @@ class FleetScheduler:
         for lane in list(self.lanes):
             plans[id(lane)] = lane._prepare_dispatch()
         self._place()
-        for group in list(self._groups.values()):
-            if group.members():
-                self._dispatch_group(group, plans)
+        for siblings in list(self._groups.values()):
+            for group in list(siblings):
+                if group.members():
+                    self._dispatch_group(group, plans)
 
     def drain(self) -> None:
         """Block until every lane's dispatched steps are replayed."""
@@ -289,30 +343,47 @@ class FleetScheduler:
                 if lane._fleet_resident:
                     self._checkout(lane)
                 group.slots[slot] = None
+                group.stale.add(slot)
                 group.dirty = True
                 group.consts_ids = None
                 lane._fleet_slot = None
                 if not group.members():
-                    self._groups.pop(group.key, None)
+                    self._drop_group(group)
             self._assign(lane, key)
 
     def _assign(self, lane: FleetLane, key: tuple) -> None:
-        group = self._groups.get(key)
+        siblings = self._groups.setdefault(key, [])
+        group = next((g for g in siblings if None in g.slots), None)
         if group is None:
-            group = _FleetGroup(key, self.block)
-            self._groups[key] = group
-        if None not in group.slots:
-            # the documented admission cliff: a full group doubles its
-            # slot count — new shapes, one recompile for the whole rung
-            group.slots.extend([None] * len(group.slots))
-            group.dirty = True
-            group.warm.clear()
-            group.empty_spawn.clear()
-            group.empty_push.clear()
-            group.budget_cache.clear()
-            group.compact_cache.clear()
+            if self.grow == "pad" or not siblings:
+                # padded-slot admission: the rung opens ANOTHER
+                # block-sized group.  Its shapes equal its siblings'
+                # (token caps are rung-unified, grow-only), so every
+                # program it needs is already compiled — admission past
+                # a full group stays pure data movement
+                group = _FleetGroup(key, self.block)
+                if siblings:
+                    # the sibling already ran these variants — the new
+                    # group's dispatches are warm, not cold
+                    group.warm |= siblings[0].warm
+                rp, rd = self._rung_caps.get(key, (0, 0))
+                group.maxp, group.maxd = rp, rd
+                siblings.append(group)
+            else:
+                # the legacy admission cliff (grow="double"): the rung's
+                # one group doubles its slot count — new shapes, one
+                # recompile for the whole rung
+                group = siblings[0]
+                group.slots.extend([None] * len(group.slots))
+                group.dirty = True
+                group.warm.clear()
+                group.empty_spawn.clear()
+                group.empty_push.clear()
+                group.budget_cache.clear()
+                group.compact_cache.clear()
         slot = group.slots.index(None)
         group.slots[slot] = lane
+        group.stale.discard(slot)  # occupied again; insert overwrites it
         lane._fleet_slot = (group, slot)
         lane._fleet_resident = False
         group.consts_ids = None  # membership changed -> restack consts
@@ -328,12 +399,52 @@ class FleetScheduler:
         lane._fleet_resident = False
 
     def _restack(self, group: _FleetGroup) -> None:
-        """Rebuild the group's stacked state/params from its member
-        lanes (zeros in empty slots).  Used for every membership or
-        shape change — ONE program regardless of which slot changed, so
-        a warm rung's restack never compiles."""
+        """Rebuild or patch the group's stacked state/params.
+
+        While the stacked SHAPE is unchanged (slot count and token caps),
+        a dirty group restacks incrementally: resident lanes' slices in
+        the old stack are still the truth and are skipped outright; only
+        changed slots move (non-resident members are inserted, freshly
+        vacated slots are zeroed).  A membership change therefore costs
+        one ``insert_world`` per CHANGED slot instead of a serial
+        checkout + full ``stack_worlds`` over every member — the skip is
+        counted in the ``analysis.runtime`` restack counters so serve
+        accounting sees restack work.  A shape change (token-cap growth,
+        legacy slot doubling) or the first stack takes the full-rebuild
+        path.  Either way every program involved is shape-stable, so a
+        warm rung's restack never compiles."""
         members = group.members()
-        # residents' truth lives in the old stack — pull it back first
+        shape = (len(group.slots), group.maxp, group.maxd)
+        if group.fstate is not None and group.stacked_shape == shape:
+            zs = zp = None
+            inserts = skipped = 0
+            for slot, lane in members:
+                if lane._fleet_resident:
+                    skipped += 1
+                    continue
+                lane.kin.ensure_token_limits(group.maxp, group.maxd)
+                group.fstate = insert_world(group.fstate, slot, lane._state)
+                group.fparams = insert_world(
+                    group.fparams, slot, lane.kin.params
+                )
+                lane._fleet_resident = True
+                inserts += 1
+            for slot in sorted(group.stale):
+                if group.slots[slot] is not None:
+                    continue
+                if zs is None:
+                    _, first = members[0]
+                    zs = zeros_world_like(first._state)
+                    zp = zeros_world_like(first.kin.params)
+                group.fstate = insert_world(group.fstate, slot, zs)
+                group.fparams = insert_world(group.fparams, slot, zp)
+                inserts += 1
+            group.stale.clear()
+            group.dirty = False
+            _runtime.note_restack(inserts=inserts, skipped=skipped)
+            return
+        # full rebuild: residents' truth lives in the old stack — pull
+        # it back first
         for _, lane in members:
             if lane._fleet_resident:
                 self._checkout(lane)
@@ -350,7 +461,10 @@ class FleetScheduler:
         )
         for _, lane in members:
             lane._fleet_resident = True
+        group.stale.clear()
         group.dirty = False
+        group.stacked_shape = shape
+        _runtime.note_restack(full=1)
         # warm the checkout AND re-admit programs for this shape NOW:
         # a later admission/checkout must not be the first extract or
         # insert at these shapes (results discarded — pure programs)
@@ -361,6 +475,13 @@ class FleetScheduler:
         members = group.members()
         maxp = max(l.kin.max_proteins for _, l in members)
         maxd = max(l.kin.max_doms for _, l in members)
+        # unify token caps across the whole RUNG, not just this group:
+        # sibling groups must share program shapes so a padded admission
+        # into a fresh block stays zero-compile
+        rp, rd = self._rung_caps.get(group.key, (0, 0))
+        maxp, maxd = max(maxp, rp), max(maxd, rd)
+        if (maxp, maxd) != (rp, rd):
+            self._rung_caps[group.key] = (maxp, maxd)
         if maxp > group.maxp or maxd > group.maxd:
             # token capacities are grow-only and growth is trajectory
             # invariant; the params shapes change, so restack

@@ -59,7 +59,9 @@ class WardenStatus:
     (flagged, eviction pending at the next scheduler step), ``cooldown``
     (evicted, heal scheduled at ``cooldown_until``), ``parked``
     (evicted for good: quarantine policy, no loadable checkpoint, or
-    the circuit breaker — see ``reason``), ``retired`` (the caller
+    the circuit breaker — see ``reason``), ``suspended`` (checked out by
+    the serve layer's budget pause — :meth:`FleetWarden.suspend` — and
+    re-joinable via :meth:`FleetWarden.resume`), ``retired`` (the caller
     retired it manually; the warden no longer tracks it)."""
 
     label: int
@@ -220,6 +222,92 @@ class FleetWarden:
     def manages(self, lane) -> bool:
         """Whether ``lane``'s trips are routed through this warden."""
         return id(lane) in self._by_lane
+
+    # ------------------------------------------------------------ #
+    # serve support (tenant lifecycle — magicsoup_tpu.serve)       #
+    # ------------------------------------------------------------ #
+
+    def suspend(self, lane):
+        """Retire ``lane`` from the scheduler while KEEPING its warden
+        record (label, rolling stream, trip/restart counts) — the serve
+        layer's budget pause.  Returns the lane (a standalone stepper
+        again); :meth:`resume` re-joins the SAME lane object, so the
+        round trip is invisible to the world's trajectory."""
+        rec = self._by_lane.get(id(lane))
+        if rec is None:
+            raise KeyError("warden does not track this lane")
+        self._evicting = lane
+        try:
+            self.scheduler.retire(lane)
+        finally:
+            self._evicting = None
+        rec.status = "suspended"
+        return lane
+
+    def resume(self, lane):
+        """Re-join a lane parked by :meth:`suspend` — same object, no
+        state rebuild (``scheduler.readmit``)."""
+        rec = next(
+            (
+                r
+                for r in self._records
+                if r.lane is lane and r.status == "suspended"
+            ),
+            None,
+        )
+        if rec is None:
+            raise KeyError("lane is not suspended by this warden")
+        self._adopting = rec
+        try:
+            self.scheduler.readmit(lane)
+        finally:
+            self._adopting = None
+        rec.status = "active"
+        return lane
+
+    def adopt(self, world, *, label: int, **stepper_kwargs):
+        """Admit ``world`` under a FORCED label — service restart
+        recovery: a tenant restored from its rolling stream must keep
+        appending to the same ``world-<label>`` prefix.  Creates (or
+        reuses) the record for ``label`` and bumps the label allocator
+        past it so later admissions never collide."""
+        label = int(label)
+        rec = next((r for r in self._records if r.label == label), None)
+        if rec is None:
+            rec = _WorldRecord(
+                label=label, lane=None, kwargs=dict(stepper_kwargs)
+            )
+            if self._dir is not None:
+                rec.stream = CheckpointManager(
+                    self._dir,
+                    keep=self.keep,
+                    prefix=f"world-{rec.label:03d}",
+                )
+            self._records.append(rec)
+        self._next_label = max(self._next_label, label + 1)
+        rec.kwargs = dict(stepper_kwargs)
+        self._adopting = rec
+        try:
+            lane = self.scheduler.admit(world, **stepper_kwargs)
+        finally:
+            self._adopting = None
+        rec.status = "active"
+        return lane
+
+    def label_of(self, lane) -> int:
+        """The stable world label behind ``lane`` (stream prefix id)."""
+        rec = self._by_lane.get(id(lane))
+        if rec is None:
+            raise KeyError("warden does not track this lane")
+        return rec.label
+
+    def stream_of(self, lane_or_label):
+        """The per-world rolling checkpoint stream (by lane object or
+        integer label); ``None`` when the warden has no checkpoint_dir."""
+        for rec in self._records:
+            if rec.lane is lane_or_label or rec.label == lane_or_label:
+                return rec.stream
+        raise KeyError(f"warden does not track {lane_or_label!r}")
 
     # ------------------------------------------------------------ #
     # trip intake (called from FleetLane replay — never raises)    #

@@ -1,0 +1,56 @@
+"""
+graftserve — multi-tenant fleet serving with admission control,
+per-tenant accounting, and a crash-safe tenant lifecycle.
+
+A :class:`FleetService` turns one
+:class:`~magicsoup_tpu.fleet.FleetScheduler` /
+:class:`~magicsoup_tpu.fleet.FleetWarden` pair into a long-lived
+service: independent *tenants* each own one simulated world, admitted
+into shared capacity rungs, stepped together by one scheduler loop,
+checkpointed to per-tenant rolling streams, and billed from counters
+the loop already holds.  The front-end is a stdlib ``http.server``
+JSON API (no new dependencies) — see :mod:`.api` for the routes and
+the tenant spec format.
+
+The four modules:
+
+- :mod:`.service` — :class:`FleetService`: single-writer scheduler
+  loop, bounded command queue, budgeted stepping with
+  trajectory-invisible budget pauses, tenant registry + restart
+  recovery, SIGTERM drain-and-checkpoint.
+- :mod:`.api` — spec validation, world/stepper construction, HTTP
+  routing (handler threads never touch fleet state).
+- :mod:`.admission` — :class:`AdmissionController`: warm rungs admit
+  free (padded-slot admission is pure data movement); cold rungs spend
+  a measured compile budget or queue.
+- :mod:`.accounting` — :class:`AccountingLedger`: per-tenant steps,
+  dispatches, fetch bytes and health trips, exact at drain boundaries
+  and persisted through checkpoint meta.
+
+Determinism contract: a tenant's trajectory is a function of its spec
+and the megasteps served to it — not of co-tenants, request timing, or
+service restarts.  Flush points (checkpoint cadence, explicit
+checkpoint/digest requests) ARE part of the schedule, keyed to tenant
+megasteps; runs compared for bit-identity must flush at the same
+tenant steps.  ``performance/smoke.py --serve`` pins the end-to-end
+contract: zero-compile warm admission over HTTP, one physical fetch
+per group megastep, accounting rows that sum to steps served, and
+SIGKILL + restart with bit-identical resumed digests.
+
+Run a service::
+
+    python -m magicsoup_tpu.serve --dir /var/lib/soup --port 8640
+"""
+from magicsoup_tpu.serve.accounting import AccountingLedger, TenantAccount
+from magicsoup_tpu.serve.admission import AdmissionController
+from magicsoup_tpu.serve.api import ServeError
+from magicsoup_tpu.serve.service import FleetService, tenant_digest
+
+__all__ = [
+    "AccountingLedger",
+    "AdmissionController",
+    "FleetService",
+    "ServeError",
+    "TenantAccount",
+    "tenant_digest",
+]

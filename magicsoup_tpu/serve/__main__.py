@@ -1,0 +1,65 @@
+"""CLI entry: ``python -m magicsoup_tpu.serve --dir DIR [--port P]``.
+
+Binds the HTTP front-end, prints ONE machine-readable ready line
+(``{"serve": "ready", "port": ..., "tenants": ...}``) to stdout, then
+runs the scheduler loop on the main thread so SIGTERM/SIGINT get the
+graceful drain-checkpoint-exit path.  A directory holding a previous
+life's registry is recovered before the ready line prints — the ready
+line's ``tenants`` count is the number of re-adopted worlds.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from magicsoup_tpu.serve.service import FleetService
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m magicsoup_tpu.serve")
+    parser.add_argument("--dir", required=True, help="service directory")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--block", type=int, default=4)
+    parser.add_argument(
+        "--policy", default="warn", help="warden policy for tenant trips"
+    )
+    parser.add_argument("--keep", type=int, default=3)
+    parser.add_argument(
+        "--compile-budget",
+        type=int,
+        default=None,
+        help="admission compile allowance (default: unlimited)",
+    )
+    args = parser.parse_args(argv)
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+    service = FleetService(
+        args.dir,
+        host=args.host,
+        port=args.port,
+        block=args.block,
+        policy=args.policy,
+        keep=args.keep,
+        compile_budget=args.compile_budget,
+    )
+    service.serve_http()
+    print(
+        json.dumps(
+            {
+                "serve": "ready",
+                "port": service.port,
+                "tenants": len(service._tenants),
+            }
+        ),
+        flush=True,
+    )
+    service.run()
+    print(json.dumps({"serve": "stopped"}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""
+graftserve admission control: compile budgets over capacity rungs.
+
+The scheduler's padded-slot admission (``grow="pad"``) makes joining a
+WARM capacity rung pure data movement — the rung's program shapes never
+change, so an admission compiles nothing (pinned by the fleet tests and
+the serve smoke).  What still costs compiles is a COLD rung: the first
+world of a new shape traces the whole fleet step ladder.  On a shared
+service that cost lands on every tenant (XLA compilation serializes on
+the dispatch thread), so it must be budgeted, not ambient.
+
+:class:`AdmissionController` holds one number — the remaining compile
+allowance — and answers one question per create: *is this spec's rung
+warm?*  Warm rungs always admit.  Cold rungs admit only while budget
+remains; otherwise the create is rejected (HTTP 429) or parked on the
+service's bounded queue (``"queue": true`` in the spec) and re-assessed
+every scheduler tick — a queued create admits the moment a sibling
+warms its rung.
+
+The spend side is MEASURED, not estimated: the service brackets world
+construction, admission, and every ``scheduler.step()`` with
+:func:`magicsoup_tpu.analysis.runtime.compile_count` deltas and charges
+the observed compiles.  ``compile_budget=0`` therefore means "serve
+only shapes that are already compiled" — the steady-state posture the
+serve smoke pins after warmup.
+"""
+from __future__ import annotations
+
+__all__ = ["AdmissionController"]
+
+
+class AdmissionController:
+    """Compile-budget gate for tenant creation.
+
+    Parameters:
+        compile_budget: Remaining compile allowance for COLD-rung
+            admissions; ``None`` is unlimited.  Reconfigurable at
+            runtime (``POST /admission``).
+    """
+
+    def __init__(self, *, compile_budget: int | None = None):
+        self.remaining = (
+            None if compile_budget is None else int(compile_budget)
+        )
+        self.spent = 0  # total compiles observed since start/reset
+        self.rejected = 0
+
+    def configure(self, compile_budget: int | None) -> None:
+        """Replace the remaining allowance (``None`` = unlimited)."""
+        self.remaining = (
+            None if compile_budget is None else int(compile_budget)
+        )
+
+    def assess(self, *, warm: bool) -> bool:
+        """Whether a create may proceed: warm rungs always admit, cold
+        rungs need budget headroom."""
+        if warm:
+            return True
+        return self.remaining is None or self.remaining > 0
+
+    def charge(self, compiles: int) -> None:
+        """Record ``compiles`` observed compiles (a measured
+        ``compile_count`` delta) against the budget."""
+        compiles = int(compiles)
+        if compiles <= 0:
+            return
+        self.spent += compiles
+        if self.remaining is not None:
+            self.remaining = max(0, self.remaining - compiles)
+
+    def snapshot(self) -> dict:
+        """JSON view for ``/counters`` and ``/admission`` responses."""
+        return {
+            "compile_budget": self.remaining,
+            "compiles_spent": self.spent,
+            "rejected": self.rejected,
+        }

@@ -1,0 +1,803 @@
+"""
+graftserve service core: one scheduler loop, many tenants, HTTP edges.
+
+:class:`FleetService` is the long-lived owner of a
+:class:`~magicsoup_tpu.fleet.FleetScheduler` /
+:class:`~magicsoup_tpu.fleet.FleetWarden` pair.  Its concurrency model
+is deliberately boring:
+
+- **Single writer.**  All fleet state is touched by exactly one thread
+  — the scheduler loop (:meth:`run`).  HTTP handler threads never call
+  into the fleet; they enqueue commands on a BOUNDED queue and block on
+  a per-command completion event (with a timeout, so a wedged loop
+  surfaces as a 504).  ``GET /healthz`` is the one exception: it reads
+  the loop's last published snapshot, because liveness probes must not
+  queue behind work.
+- **Budgeted stepping.**  ``POST /tenants/<id>/step`` only ADDS to the
+  tenant's megastep budget; the loop drains budgets one group megastep
+  per tick for every runnable tenant, so tenants advance in lockstep —
+  round-robin fairness at megastep boundaries by construction, no
+  tenant can starve another by asking for more.
+- **Budget pause is trajectory-invisible.**  A tenant whose budget hits
+  zero is suspended via :meth:`FleetWarden.suspend` (a scheduler
+  ``retire`` that KEEPS the lane object — no flush, no state rebuild);
+  the next budget resumes the SAME lane.  A world stepped ``2N`` times
+  in one request is bit-identical to one stepped ``N`` twice.
+
+Crash safety: every tenant has its own rolling checkpoint stream
+(``world-<label>-*.msck`` under the service directory), written every
+``checkpoint_cadence`` TENANT megasteps — a tenant-step-keyed flush, so
+the cadence is part of the deterministic schedule and independent of
+wall clock or co-tenants.  The static registry (``tenants.json``,
+atomic rewrite) maps tenant ids to labels/specs; all dynamic state
+(budget, served counters, accounting) rides in checkpoint meta.  On
+SIGTERM the loop drains, checkpoints every tenant, and exits 0; after
+SIGKILL a restarted service on the same directory re-adopts every
+tenant from its stream — det-mode digests bit-identical to a run that
+was never killed (pinned by ``performance/smoke.py --serve``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+from magicsoup_tpu.analysis import runtime as _runtime
+from magicsoup_tpu.serve import api
+from magicsoup_tpu.serve.accounting import AccountingLedger
+from magicsoup_tpu.serve.admission import AdmissionController
+
+__all__ = ["FleetService", "tenant_digest"]
+
+REGISTRY_FORMAT = "magicsoup_tpu.serve.registry/1"
+
+
+def tenant_digest(lane) -> str:
+    """sha256 over a lane's full resume-relevant state (flushes first).
+
+    Field-per-field hashing in sorted key order, mirroring the chaos
+    smoke's digest: pickling the fields together would let pickle's
+    memo turn cross-field aliasing (live run) vs equal-but-distinct
+    copies (restored run) into different bytes for identical values.
+    A digest request is a flush, which is part of the deterministic
+    schedule — compare runs that digest at the same tenant steps.
+    """
+    import hashlib
+    import pickle
+
+    import numpy as np
+
+    from magicsoup_tpu import guard
+
+    world = lane.world
+    snap = guard.snapshot_run(world, lane)
+    aux = snap["stepper"]
+    state = dict(
+        n_cells=world.n_cells,
+        genomes=list(world.cell_genomes),
+        labels=list(world.cell_labels),
+        mm=np.asarray(world.molecule_map),
+        cm=np.asarray(world.cell_molecules),
+        positions=np.asarray(world.cell_positions),
+        lifetimes=np.asarray(world.cell_lifetimes),
+        divisions=np.asarray(world.cell_divisions),
+        world_rng=snap["world_rng_state"],
+        world_nprng=snap["world_nprng_state"],
+        key=np.asarray(aux["key"]),
+        stepper_rng=aux["rng_state"],
+        spawn_queue=aux["spawn_queue"],
+        growth_hist=aux["growth_hist"],
+        change_seq=aux["change_seq"],
+        dispatched_seq=aux["dispatched_seq"],
+    )
+    digest = hashlib.sha256()
+    for name in sorted(state):
+        digest.update(name.encode())
+        digest.update(hashlib.sha256(pickle.dumps(state[name])).digest())
+    return digest.hexdigest()
+
+
+@dataclass
+class _Command:
+    """One queued request: the loop fills result/error and sets done."""
+
+    name: str
+    payload: dict
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict | None = None
+    error: Exception | None = None
+
+
+@dataclass
+class _Tenant:
+    """Service-side record of one admitted world."""
+
+    tenant: str
+    label: int
+    spec: dict
+    sig: str = ""  # spec_signature, cached (admission bookkeeping)
+    lane: object | None = None
+    budget: int = 0  # megasteps requested but not yet served
+    megasteps: int = 0  # tenant megasteps served (the cadence clock)
+    cadence: int = 0  # checkpoint every N tenant megasteps (0 = manual)
+
+
+class FleetService:
+    """Multi-tenant serving front-end over one fleet.
+
+    Parameters:
+        directory: Service home — per-world checkpoint streams live in
+            ``<directory>/worlds``, the tenant registry at
+            ``<directory>/tenants.json``.  A directory with a registry
+            is RECOVERED: every registered tenant is re-adopted from
+            its stream before the service accepts requests.
+        host/port: HTTP bind address (``port=0`` picks a free port;
+            read it back from ``.port`` after :meth:`serve_http`).
+        block: Fleet group slot count (see :class:`FleetScheduler`).
+        policy: Warden policy for tenant health trips.
+        keep: Rolling retention per tenant checkpoint stream.
+        compile_budget: Initial admission compile allowance
+            (``None`` = unlimited; reconfigurable via
+            ``POST /admission``).
+        queue_limit: Max parked creates (``"queue": true`` specs).
+        command_timeout: Seconds a handler thread waits for the loop
+            to execute its command before giving up with a 504.
+    """
+
+    def __init__(
+        self,
+        directory,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        block: int = 4,
+        policy: str = "warn",
+        keep: int = 3,
+        compile_budget: int | None = None,
+        queue_limit: int = 16,
+        command_timeout: float = 600.0,
+        idle_wait: float = 0.05,
+    ):
+        from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+
+        self.dir = Path(directory)
+        (self.dir / "worlds").mkdir(parents=True, exist_ok=True)
+        self.scheduler = FleetScheduler(block=block, grow="pad")
+        self.warden = FleetWarden(
+            self.scheduler,
+            policy=policy,
+            checkpoint_dir=self.dir / "worlds",
+            keep=keep,
+        )
+        self.admission = AdmissionController(compile_budget=compile_budget)
+        self.ledger = AccountingLedger()
+        self.keep = int(keep)
+        self.queue_limit = int(queue_limit)
+        self.command_timeout = float(command_timeout)
+        self.idle_wait = float(idle_wait)
+        self.host = host
+        self.port = int(port)
+
+        self._tenants: dict[str, _Tenant] = {}
+        self._pending: dict[str, dict] = {}  # queued creates, in order
+        self._lost: dict[str, dict] = {}  # registered but unrecoverable
+        self._seq = 0
+        #: spec signature -> rung key, and the rung keys that have
+        #: completed a step in this process (= compiled programs exist)
+        self._spec_rungs: dict[str, tuple] = {}
+        self._warm_rungs: set[tuple] = set()
+        self._last_stepped: list[str] = []
+        from magicsoup_tpu.telemetry import fetch_stats
+
+        self._fetch_seen = int(fetch_stats()["fetch_bytes"])
+        self._fetch_carry = 0
+
+        self._commands: queue.Queue[_Command] = queue.Queue(maxsize=64)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        self._health_lock = threading.Lock()
+        self._health: dict = {"status": "starting"}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._loop_thread: threading.Thread | None = None
+
+        self._recover()
+        self._publish_health()
+
+    # ------------------------------------------------------------ #
+    # lifecycle                                                    #
+    # ------------------------------------------------------------ #
+
+    def serve_http(self) -> int:
+        """Bind the HTTP front-end (idempotent); returns the port."""
+        if self._httpd is None:
+            self._httpd = ThreadingHTTPServer(
+                (self.host, self.port), api.make_handler(self)
+            )
+            self._httpd.daemon_threads = True
+            self.port = self._httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="graftserve-http",
+                daemon=True,
+            )
+            self._http_thread.start()
+        return self.port
+
+    def run(self) -> None:
+        """The scheduler loop (blocking).  On the main thread, SIGTERM/
+        SIGINT latch a graceful stop: drain, checkpoint every tenant,
+        write the registry, exit cleanly."""
+        from magicsoup_tpu.guard.signals import GracefulShutdown
+
+        self.serve_http()
+        try:
+            with GracefulShutdown() as stop:
+                while not (stop or self._stop.is_set()):
+                    self._tick()
+        finally:
+            self._shutdown()
+
+    def start(self) -> "FleetService":
+        """Run the loop on a background thread (in-process tests); the
+        HTTP port is bound synchronously before this returns."""
+        self.serve_http()
+        self._loop_thread = threading.Thread(
+            target=self.run, name="graftserve-loop", daemon=True
+        )
+        self._loop_thread.start()
+        return self
+
+    def stop(self, timeout: float = 120.0) -> None:
+        """Request a graceful stop and wait for the loop epilogue
+        (drain + final checkpoints + registry) to finish."""
+        self._stop.set()
+        self._wake.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=timeout)
+        else:
+            self._stopped.wait(timeout=timeout)
+
+    def _shutdown(self) -> None:
+        self.scheduler.drain()
+        for t in sorted(self._tenants.values(), key=lambda t: t.label):
+            if t.lane is not None:
+                self._checkpoint_tenant(t)
+        self._settle_fetch()
+        self._write_registry()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._fail_queued_commands()
+        with self._health_lock:
+            self._health = dict(self._health, status="stopped")
+        self._stopped.set()
+
+    def _fail_queued_commands(self) -> None:
+        while True:
+            try:
+                cmd = self._commands.get_nowait()
+            except queue.Empty:
+                break
+            cmd.error = api.ServeError(503, "service stopped")
+            cmd.done.set()
+
+    # ------------------------------------------------------------ #
+    # request edge (handler threads)                               #
+    # ------------------------------------------------------------ #
+
+    def submit(self, name: str, payload: dict) -> dict:
+        """Enqueue one command for the loop and wait for its result —
+        the ONLY path by which handler threads reach fleet state."""
+        if self._stop.is_set() or self._stopped.is_set():
+            raise api.ServeError(503, "service is stopping")
+        cmd = _Command(name, dict(payload or {}))
+        try:
+            self._commands.put(cmd, timeout=2.0)
+        except queue.Full:
+            raise api.ServeError(503, "command queue is full")
+        self._wake.set()
+        if not cmd.done.wait(timeout=self.command_timeout):
+            raise api.ServeError(
+                504,
+                f"scheduler loop did not finish {name!r} within "
+                f"{self.command_timeout:.0f}s",
+            )
+        if cmd.error is not None:
+            raise cmd.error
+        return cmd.result
+
+    def health(self) -> dict:
+        """The loop's last published snapshot (never blocks on work)."""
+        with self._health_lock:
+            return dict(self._health)
+
+    # ------------------------------------------------------------ #
+    # the scheduler loop (single writer)                           #
+    # ------------------------------------------------------------ #
+
+    def _tick(self) -> None:
+        self._drain_commands()
+        self._admit_pending()
+        self._reconcile()
+        runnable = self._runnable()
+        if not runnable:
+            self._publish_health()
+            self._wake.wait(timeout=self.idle_wait)
+            self._wake.clear()
+            return
+        c0 = _runtime.compile_count()
+        self.scheduler.step()
+        self.admission.charge(_runtime.compile_count() - c0)
+        self._warm_rungs.update(self.scheduler._groups)
+        stepped = []
+        for t in runnable:
+            # map the spec signature to the rung the lane actually
+            # occupies NOW (a lane's first dispatch can still grow its
+            # capacity, so the admit-time key is not the steady one)
+            if t.lane._fleet_slot is not None:
+                self._spec_rungs[t.sig] = t.lane._fleet_slot[0].key
+            t.budget -= 1
+            t.megasteps += 1
+            self.ledger.charge_megastep(t.tenant, t.lane.megastep)
+            self.ledger.sync_trips(
+                t.tenant,
+                t.lane.stats["sentinel_trips"],
+                t.lane.stats["invariant_trips"],
+            )
+            stepped.append(t.tenant)
+        self._last_stepped = stepped
+        self._settle_fetch()
+        for t in runnable:
+            if t.cadence and t.megasteps % t.cadence == 0:
+                self._checkpoint_tenant(t)
+        self._publish_health()
+
+    def _runnable(self) -> list[_Tenant]:
+        """Tenants that will advance this tick: budget left and active
+        in the warden (suspended/quarantined worlds do not step)."""
+        out = []
+        for t in self._tenants.values():
+            if t.lane is None or t.budget <= 0:
+                continue
+            if self.warden.status_of(t.label).status == "active":
+                out.append(t)
+        return out
+
+    def _reconcile(self) -> None:
+        """Suspend exhausted tenants, resume re-budgeted ones — the
+        retire/readmit round trip keeps the SAME lane object, so budget
+        pauses never perturb the trajectory."""
+        for t in self._tenants.values():
+            if t.lane is None:
+                continue
+            status = self.warden.status_of(t.label).status
+            if t.budget <= 0 and status == "active":
+                self.warden.suspend(t.lane)
+            elif t.budget > 0 and status == "suspended":
+                self.warden.resume(t.lane)
+
+    def _drain_commands(self) -> None:
+        while True:
+            try:
+                cmd = self._commands.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                cmd.result = self._execute(cmd.name, cmd.payload)
+            except Exception as exc:  # graftlint: disable=GL013 delivered to the requesting client, loop must survive
+                cmd.error = exc
+            cmd.done.set()
+
+    def _admit_pending(self) -> None:
+        """Re-assess parked creates: a queued spec admits the moment
+        its rung warms (or budget is reconfigured)."""
+        for tid in list(self._pending):
+            spec = self._pending[tid]
+            key = self._spec_rungs.get(api.spec_signature(spec))
+            warm = key is not None and key in self._warm_rungs
+            if self.admission.assess(warm=warm):
+                del self._pending[tid]
+                self._admit(tid, spec)
+
+    def _settle_fetch(self) -> None:
+        """Distribute newly observed fetch bytes over the tenants that
+        stepped most recently (carried until someone has stepped)."""
+        from magicsoup_tpu.telemetry import fetch_stats
+
+        total = int(fetch_stats()["fetch_bytes"])
+        self._fetch_carry += max(0, total - self._fetch_seen)
+        self._fetch_seen = total
+        if self._fetch_carry and self._last_stepped:
+            self.ledger.charge_fetch(self._last_stepped, self._fetch_carry)
+            self._fetch_carry = 0
+
+    def _publish_health(self) -> None:
+        statuses = {}
+        for t in self._tenants.values():
+            if t.lane is not None:
+                statuses[t.tenant] = self.warden.status_of(t.label).status
+        snap = {
+            "status": "stopping" if self._stop.is_set() else "serving",
+            "tenants": len(self._tenants),
+            "queued": len(self._pending),
+            "lost": sorted(self._lost),
+            "megasteps": sum(t.megasteps for t in self._tenants.values()),
+            "backlog": sum(t.budget for t in self._tenants.values()),
+            "worlds": statuses,
+        }
+        with self._health_lock:
+            self._health = snap
+
+    # ------------------------------------------------------------ #
+    # commands                                                     #
+    # ------------------------------------------------------------ #
+
+    def _execute(self, name: str, payload: dict) -> dict:
+        handler = getattr(self, f"_cmd_{name}", None)
+        if handler is None:
+            raise api.ServeError(404, f"unknown command {name!r}")
+        return handler(payload)
+
+    def _get_tenant(self, payload: dict) -> _Tenant:
+        tid = payload.get("tenant")
+        t = self._tenants.get(tid)
+        if t is None:
+            raise api.ServeError(404, f"no tenant {tid!r}")
+        return t
+
+    def _new_tid(self) -> str:
+        while True:
+            self._seq += 1
+            tid = f"tenant-{self._seq:03d}"
+            if tid not in self._tenants and tid not in self._pending:
+                return tid
+
+    def _cmd_create(self, payload: dict) -> dict:
+        spec = api.validate_spec(payload)
+        tid = spec.get("tenant") or self._new_tid()
+        spec["tenant"] = tid
+        if tid in self._tenants or tid in self._pending:
+            raise api.ServeError(409, f"tenant {tid!r} already exists")
+        key = self._spec_rungs.get(api.spec_signature(spec))
+        warm = key is not None and key in self._warm_rungs
+        if not self.admission.assess(warm=warm):
+            if spec["queue"]:
+                if len(self._pending) >= self.queue_limit:
+                    self.admission.rejected += 1
+                    raise api.ServeError(429, "admission queue is full")
+                self._pending[tid] = spec
+                return {"tenant": tid, "status": "queued"}
+            self.admission.rejected += 1
+            raise api.ServeError(
+                429,
+                "admission rejected: compile budget exhausted and the "
+                "spec's capacity rung is cold (retry with queue=true, "
+                "or raise the budget via POST /admission)",
+            )
+        t = self._admit(tid, spec)
+        return self._observe(t)
+
+    def _admit(self, tid: str, spec: dict, *, label: int | None = None) -> _Tenant:
+        c0 = _runtime.compile_count()
+        world = api.build_world(spec)
+        kwargs = api.stepper_kwargs(spec)
+        if label is None:
+            lane = self.scheduler.admit(world, **kwargs)
+            label = self.warden.label_of(lane)
+        else:
+            lane = self.warden.adopt(world, label=label, **kwargs)
+        self.admission.charge(_runtime.compile_count() - c0)
+        t = _Tenant(
+            tenant=tid,
+            label=label,
+            spec=spec,
+            sig=api.spec_signature(spec),
+            lane=lane,
+            cadence=spec["checkpoint_cadence"],
+        )
+        self._tenants[tid] = t
+        self.ledger.open(tid, label)
+        self.ledger.rebase_trips(
+            tid,
+            lane.stats["sentinel_trips"],
+            lane.stats["invariant_trips"],
+        )
+        self._write_registry()
+        return t
+
+    def _cmd_list(self, payload: dict) -> dict:
+        rows = [self._observe(t) for t in self._tenants.values()]
+        rows += [
+            {"tenant": tid, "status": "queued"} for tid in self._pending
+        ]
+        rows += [{"tenant": tid, "status": "lost"} for tid in self._lost]
+        return {"tenants": rows}
+
+    def _cmd_observe(self, payload: dict) -> dict:
+        return self._observe(self._get_tenant(payload))
+
+    def _observe(self, t: _Tenant) -> dict:
+        """Telemetry/health summary from host-side state only — the
+        zero-sync lanes the replay already decoded (no extra D2H)."""
+        acct = self.ledger.get(t.tenant)
+        out = {
+            "tenant": t.tenant,
+            "world": t.label,
+            "budget": t.budget,
+            "megasteps": t.megasteps,
+            "steps": acct.steps,
+            "accounting": acct.row(),
+        }
+        if t.lane is not None:
+            ws = self.warden.status_of(t.label)
+            stats = t.lane.stats
+            out["status"] = ws.status
+            out["warden"] = {
+                "status": ws.status,
+                "trips": ws.trips,
+                "restarts": ws.restarts,
+                "last_flags": ws.last_flags,
+                "reason": ws.reason,
+            }
+            out["n_cells"] = t.lane.world.n_cells
+            out["stats"] = {
+                k: stats[k]
+                for k in (
+                    "steps",
+                    "replayed",
+                    "kills",
+                    "divisions",
+                    "spawned",
+                    "sentinel_trips",
+                    "invariant_trips",
+                )
+            }
+        else:
+            out["status"] = "detached"
+        return out
+
+    def _cmd_step(self, payload: dict) -> dict:
+        t = self._get_tenant(payload)
+        if t.lane is None:
+            raise api.ServeError(409, f"tenant {t.tenant!r} is detached")
+        megasteps = int(payload.get("megasteps", 1))
+        if megasteps < 1:
+            raise api.ServeError(400, "megasteps must be >= 1")
+        t.budget += megasteps
+        return {
+            "tenant": t.tenant,
+            "budget": t.budget,
+            "megasteps": t.megasteps,
+        }
+
+    def _cmd_checkpoint(self, payload: dict) -> dict:
+        t = self._get_tenant(payload)
+        if t.lane is None:
+            raise api.ServeError(409, f"tenant {t.tenant!r} is detached")
+        path = self._checkpoint_tenant(t)
+        return {
+            "tenant": t.tenant,
+            "megasteps": t.megasteps,
+            "path": str(path),
+        }
+
+    def _checkpoint_tenant(self, t: _Tenant):
+        """One rolling save to the tenant's stream.  ``step`` is the
+        TENANT megastep count, so the stream ordering (and the flush
+        the save implies) is keyed to the tenant's own schedule — a
+        restart resumes at the same point regardless of co-tenants."""
+        from magicsoup_tpu.guard.resume import save_run
+
+        return save_run(
+            self.warden.stream_of(t.label),
+            t.lane.world,
+            t.lane,
+            step=t.megasteps,
+            meta={
+                "tenant": t.tenant,
+                "world": t.label,
+                "megasteps": t.megasteps,
+                "budget": t.budget,
+                "accounting": self.ledger.snapshot_one(t.tenant),
+            },
+        )
+
+    def _cmd_restore(self, payload: dict) -> dict:
+        """Roll a tenant back to its newest stream checkpoint (same
+        restore path a crashed service takes on restart)."""
+        from magicsoup_tpu.guard.resume import restore_run, restore_stepper
+
+        t = self._get_tenant(payload)
+        stream = self.warden.stream_of(t.label)
+        if stream is None or not stream.checkpoints():
+            raise api.ServeError(
+                409, f"tenant {t.tenant!r} has no checkpoints"
+            )
+        if (
+            t.lane is not None
+            and self.warden.status_of(t.label).status == "active"
+        ):
+            self.warden.suspend(t.lane)
+        c0 = _runtime.compile_count()
+        world, aux, meta = restore_run(stream)
+        lane = self.warden.adopt(
+            world, label=t.label, **api.stepper_kwargs(t.spec)
+        )
+        restore_stepper(lane, aux)
+        self.admission.charge(_runtime.compile_count() - c0)
+        t.lane = lane
+        t.budget = int(meta.get("budget", 0))
+        t.megasteps = int(meta.get("megasteps", 0))
+        self.ledger.restore_one(t.tenant, t.label, meta.get("accounting", {}))
+        self.ledger.rebase_trips(
+            t.tenant,
+            lane.stats["sentinel_trips"],
+            lane.stats["invariant_trips"],
+        )
+        return self._observe(t)
+
+    def _cmd_digest(self, payload: dict) -> dict:
+        t = self._get_tenant(payload)
+        if t.lane is None:
+            raise api.ServeError(409, f"tenant {t.tenant!r} is detached")
+        return {
+            "tenant": t.tenant,
+            "megasteps": t.megasteps,
+            "digest": tenant_digest(t.lane),
+        }
+
+    def _cmd_detach(self, payload: dict) -> dict:
+        """Final checkpoint, then release the tenant (its stream files
+        stay on disk — re-creatable by a fresh service, not by this
+        one; detach is the tenant's exit)."""
+        t = self._get_tenant(payload)
+        out = {"tenant": t.tenant, "status": "detached"}
+        if t.lane is not None:
+            if self.warden.status_of(t.label).status == "active":
+                self.warden.suspend(t.lane)
+            path = self._checkpoint_tenant(t)
+            out["checkpoint"] = str(path)
+        out["accounting"] = self.ledger.get(t.tenant).row()
+        t.lane = None
+        del self._tenants[t.tenant]
+        self._write_registry()
+        return out
+
+    def _cmd_accounting(self, payload: dict) -> dict:
+        """The full ledger.  Drains first so every dispatched megastep
+        has replayed and its fetch traffic is attributable — the rows
+        are exact at this boundary (steps sum to steps served, fetch
+        bytes sum to the process's physical fetch total)."""
+        self.scheduler.drain()
+        self._settle_fetch()
+        return {
+            "rows": self.ledger.rows(),
+            "total_steps": self.ledger.total_steps(),
+            "total_fetch_bytes": self.ledger.total_fetch_bytes(),
+        }
+
+    def _cmd_counters(self, payload: dict) -> dict:
+        from magicsoup_tpu.telemetry import runtime_counters
+
+        return {
+            "counters": runtime_counters(),
+            "admission": self.admission.snapshot(),
+        }
+
+    def _cmd_admission(self, payload: dict) -> dict:
+        if "compile_budget" in payload:
+            budget = payload["compile_budget"]
+            self.admission.configure(
+                None if budget is None else int(budget)
+            )
+        return self.admission.snapshot()
+
+    def _cmd_shutdown(self, payload: dict) -> dict:
+        self._stop.set()
+        self._wake.set()
+        return {"status": "stopping"}
+
+    # ------------------------------------------------------------ #
+    # registry + recovery                                          #
+    # ------------------------------------------------------------ #
+
+    @property
+    def _registry_path(self) -> Path:
+        return self.dir / "tenants.json"
+
+    def _write_registry(self) -> None:
+        """Atomic rewrite of the static tenant registry.  Only facts
+        needed to FIND a tenant's stream go here (label, spec); all
+        dynamic state rides in checkpoint meta, so a torn write window
+        cannot lose progress — only a just-created tenant."""
+        doc = {
+            "format": REGISTRY_FORMAT,
+            "tenants": {
+                t.tenant: {"label": t.label, "spec": t.spec}
+                for t in self._tenants.values()
+            },
+        }
+        fd, tmp = tempfile.mkstemp(
+            dir=self.dir, prefix=".tenants-", suffix=".json"
+        )
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self._registry_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _recover(self) -> None:
+        """Re-adopt every registered tenant from its rolling stream
+        (label order, so stream prefixes and the label allocator line
+        up with the previous life).  A registered tenant with no
+        loadable checkpoint is reported as ``lost``, not guessed at."""
+        from magicsoup_tpu.guard.checkpoint import CheckpointManager
+        from magicsoup_tpu.guard.errors import CheckpointError
+        from magicsoup_tpu.guard.resume import restore_run, restore_stepper
+
+        if not self._registry_path.exists():
+            return
+        doc = json.loads(self._registry_path.read_text())
+        if doc.get("format") != REGISTRY_FORMAT:
+            raise api.ServeError(
+                500, f"unknown registry format {doc.get('format')!r}"
+            )
+        entries = sorted(
+            doc.get("tenants", {}).items(), key=lambda kv: kv[1]["label"]
+        )
+        for tid, info in entries:
+            label = int(info["label"])
+            spec = info["spec"]
+            stream = CheckpointManager(
+                self.dir / "worlds",
+                keep=self.keep,
+                prefix=f"world-{label:03d}",
+            )
+            try:
+                if not stream.checkpoints():
+                    raise CheckpointError(
+                        "no checkpoints in stream", check="missing"
+                    )
+                c0 = _runtime.compile_count()
+                world, aux, meta = restore_run(stream)
+                lane = self.warden.adopt(
+                    world, label=label, **api.stepper_kwargs(spec)
+                )
+                restore_stepper(lane, aux)
+                self.admission.charge(_runtime.compile_count() - c0)
+            except CheckpointError as exc:
+                self._lost[tid] = {"label": label, "error": str(exc)}
+                continue
+            t = _Tenant(
+                tenant=tid,
+                label=label,
+                spec=spec,
+                sig=api.spec_signature(spec),
+                lane=lane,
+                cadence=int(spec.get("checkpoint_cadence", 0)),
+                budget=int(meta.get("budget", 0)),
+                megasteps=int(meta.get("megasteps", 0)),
+            )
+            self._tenants[tid] = t
+            self.ledger.restore_one(tid, label, meta.get("accounting", {}))
+            self.ledger.rebase_trips(
+                tid,
+                lane.stats["sentinel_trips"],
+                lane.stats["invariant_trips"],
+            )

@@ -1360,6 +1360,10 @@ class PipelinedStepper:
         # the program shapes depend on) + cached empty spawn/push buffers
         self._warm_sched = WarmScheduler()
         self._empty_cache: dict = {}
+        # identity fingerprint of the World as OUR last flush left it
+        # (None = no flush yet / invalidated); lets the next re-attach
+        # prove the World untouched and skip the host replay rebuild
+        self._flush_token: tuple | None = None
         self._attach(jax.random.PRNGKey(world._rng.randrange(2**31)))
         self._needs_attach = False
 
@@ -1386,9 +1390,41 @@ class PipelinedStepper:
             )
         return self._tables_cache[1]
 
+    def _world_token(self) -> tuple:
+        """Identity fingerprint of the attached World's mutable state.
+
+        Stamped by :meth:`flush` (after it syncs the World) and compared
+        at the next re-attach: equal tokens prove no classic-API
+        mutation touched the World in between, so the serial host-replay
+        rebuild can be skipped.  Every functional mutator replaces one
+        of these array/list objects (the ids change); the few pure
+        in-place mutators bump ``World._host_epoch`` instead.  Direct
+        in-place edits of ``cell_genomes``/``cell_labels`` ENTRIES are
+        not observable here — but those already desync kinetics params
+        and were never a supported mutation path (``update_cells`` is).
+        """
+        w = self.world
+        return (
+            w._host_epoch,
+            w.n_cells,
+            w._capacity,
+            id(w._molecule_map),
+            id(w._cell_molecules),
+            id(w._positions_dev),
+            id(w.kinetics),
+            id(w.kinetics.params),
+            id(w.cell_genomes),
+            id(w.cell_labels),
+            id(w._np_positions),
+            id(w._np_lifetimes),
+            id(w._np_divisions),
+            id(w._np_cell_map),
+        )
+
     def _attach(self, key: jax.Array) -> None:
         """(Re)build device + replay state from the attached world —
         used at construction and after a capacity growth."""
+        self._flush_token = None
         w = self.world
         self._cap = w._capacity
         # capacity growth changes every program's shapes: compiled-variant
@@ -1596,8 +1632,31 @@ class PipelinedStepper:
             # the classic API; re-pulling its state here (cheap: the
             # arrays are already on device) is what makes pipelined and
             # classic phases compose without silent divergence
-            self.world._ensure_capacity(self.world.n_cells + 1)
-            self._attach(self._state.key)
+            from magicsoup_tpu.analysis import runtime as _rt
+
+            if (
+                self._flush_token is not None
+                and self._flush_token == self._world_token()
+                and self.world._capacity >= self.world.n_cells + 1
+            ):
+                # fast re-attach: nothing touched the World since our own
+                # flush wrote it, so the host replay lists are already
+                # exact — skip the serial per-world rebuild and KEEP the
+                # warm-variant bookkeeping and cached empty buffers.
+                # Only the device leaves the flush aliased into the World
+                # need fresh copies: the next dispatch donates
+                # self._state, and donating the World's own buffers would
+                # delete what the classic API still reads.
+                self._state = self._state._replace(
+                    mm=jnp.copy(self.world._molecule_map),
+                    cm=jnp.copy(self.world._cell_molecules),
+                    pos=jnp.copy(self.world._positions_dev),
+                )
+                _rt.note_attach(skipped=1)
+            else:
+                self.world._ensure_capacity(self.world.n_cells + 1)
+                self._attach(self._state.key)
+                _rt.note_attach(full=1)
             self._needs_attach = False
         self._drain(block=False)
 
@@ -2680,8 +2739,11 @@ class PipelinedStepper:
         w._mm_cache = None
         w._cm_cache = None
         # the World is now the source of truth; the next step() re-pulls
-        # it so classic-API mutations in between are picked up
+        # it so classic-API mutations in between are picked up.  Stamp
+        # the World's identity as we leave it: if nothing mutates it
+        # before the re-attach, the rebuild is skipped (fast re-attach)
         self._needs_attach = True
+        self._flush_token = self._world_token()
         # a flush is a natural reporting boundary: land a counters row
         # (gives the summarizer a fresh "last" for deltas) and push the
         # buffered JSONL through to disk

@@ -20,6 +20,9 @@ Row schema (one JSON object per line; ``type`` discriminates):
 - ``dispatch`` — one per host dispatch: ``k`` (megastep), queue depth,
   cold/compact flags, and ``phases`` mapping phase name -> milliseconds
   spent since the previous dispatch row.
+- ``accounting`` — one per tenant from the graftserve ledger
+  (``serve.accounting.TenantAccount.row``): ``tenant``, ``world``, and
+  the six non-negative usage counters in ``ACCOUNTING_COUNTER_KEYS``.
 
 Mesh-placed runs add optional keys: step rows carry ``tile_occupancy``
 (per-map-row-tile occupied pixel counts, one int per mesh tile, summing
@@ -49,6 +52,17 @@ MONOTONE_STEP_KEYS = (
     "total_divisions",
     "total_spawned",
     "total_mutations",
+)
+# per-tenant usage counters every accounting row must carry
+# (serve.accounting._COUNTER_FIELDS — pinned here so the stdlib-pure
+# validator and the ledger cannot drift without a test noticing)
+ACCOUNTING_COUNTER_KEYS = (
+    "steps",
+    "megasteps",
+    "dispatches",
+    "fetch_bytes",
+    "sentinel_trips",
+    "invariant_trips",
 )
 
 
@@ -208,6 +222,20 @@ def validate_rows(rows: list[dict]) -> list[str]:
                 problems.append(
                     f"{where}: invariant row missing 'flags'/'step'"
                 )
+        elif kind == "accounting":
+            # graftserve per-tenant usage ledger (serve.accounting)
+            if not isinstance(row.get("tenant"), str):
+                problems.append(f"{where}: accounting row missing 'tenant'")
+                continue
+            if not isinstance(row.get("world"), int):
+                problems.append(f"{where}: accounting row missing 'world'")
+            for key in ACCOUNTING_COUNTER_KEYS:
+                val = row.get(key)
+                if not isinstance(val, int) or val < 0:
+                    problems.append(
+                        f"{where}: accounting counter {key!r} must be a"
+                        f" non-negative int, got {val!r}"
+                    )
         elif kind == "warden":
             # graftwarden world-level event (quarantine / heal /
             # heal_failed / circuit_break — fleet.warden.FleetWarden)

@@ -565,6 +565,13 @@ class World:
         self._np_positions = np.zeros((0, 2), dtype=np.int32)
         self._np_lifetimes = np.zeros(0, dtype=np.int32)
         self._np_divisions = np.zeros(0, dtype=np.int32)
+        # mutation marker for the few IN-PLACE host mutators (lifetimes /
+        # divisions writes that replace no array object): every other
+        # mutator replaces an array or list, which the stepper's
+        # flush-token identity check already observes.  Together they let
+        # a re-attach after flush prove "nothing touched this World" and
+        # skip the serial per-world host replay rebuild.
+        self._host_epoch = 0
 
         # device-side state (+ identity-keyed host snapshot caches)
         self._cell_molecules = jnp.zeros((0, self.n_molecules), dtype=jnp.float32)
@@ -727,6 +734,7 @@ class World:
 
     @cell_lifetimes.setter
     def cell_lifetimes(self, value):
+        self._host_epoch += 1
         self._np_lifetimes[: self.n_cells] = np.asarray(value, dtype=np.int32)
 
     @property
@@ -736,6 +744,7 @@ class World:
 
     @cell_divisions.setter
     def cell_divisions(self, value):
+        self._host_epoch += 1
         self._np_divisions[: self.n_cells] = np.asarray(value, dtype=np.int32)
 
     # ------------------------------------------------------------------ #
@@ -1456,6 +1465,7 @@ class World:
 
     def increment_cell_lifetimes(self):
         """Increment ``cell_lifetimes`` by 1"""
+        self._host_epoch += 1
         self._np_lifetimes[: self.n_cells] += 1
 
     # ------------------------------------------------------------------ #
@@ -1586,6 +1596,7 @@ class World:
         # compat defaults for pickles from before these attributes existed
         self.__dict__.setdefault("use_pallas", False)
         self.__dict__.setdefault("deterministic", default_deterministic())
+        self.__dict__.setdefault("_host_epoch", 0)
         if self.use_pallas and self.deterministic:
             # same incompatibility __init__ rejects; a restored world must
             # not silently break the bit-reproducibility contract, and the

@@ -43,6 +43,20 @@ poisoned lane's telemetry must validate and carry the
 quarantine -> heal warden events, and an armed (untripped) warden must
 leave the fetch census and compile census unchanged.
 
+``--serve`` runs the graftserve smoke (GATING): loopback
+``python -m magicsoup_tpu.serve`` children are driven over HTTP with
+three det-mode tenants across two capacity rungs.  Gates: warm-rung
+admission must create AND serve a fourth tenant under
+``compile_budget=0`` (a cold spec must be rejected with a 429) with
+zero new compiles once admitted — the warm rung's stacked programs
+are reused outright; the fetch census must show exactly ONE physical
+fetch per rung-group step (nothing per-tenant), the accounting rows must sum
+exactly to the steps served and the fetch bytes observed, SIGTERM must
+drain into final checkpoints + a registry and exit 0, and a SIGKILLed
+service restarted on the same directory must re-adopt every tenant and
+finish the SAME request schedule with digests BIT-identical to the
+uninterrupted baseline's.
+
 ``--differential`` runs the graftcheck differential smoke (GATING): one
 seeded spawn/step/mutate/kill/divide/compact schedule driven through the
 classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
@@ -101,6 +115,8 @@ def main() -> None:
     ap.add_argument("--fleet", action="store_true")
     # graftwarden fault-isolation smoke (see fleet_chaos_main below)
     ap.add_argument("--fleet-chaos", action="store_true")
+    # graftserve multi-tenant serving smoke (see serve_main below)
+    ap.add_argument("--serve", action="store_true")
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
@@ -114,6 +130,8 @@ def main() -> None:
         return fleet_main(args)
     if args.fleet_chaos:
         return fleet_chaos_main(args)
+    if args.serve:
+        return serve_main(args)
 
     import jax
 
@@ -1324,6 +1342,331 @@ def chaos_main(args) -> None:
     )
     if problems:
         raise SystemExit("chaos smoke FAILED: " + "; ".join(problems))
+
+
+def serve_main(args) -> None:
+    """Orchestrate loopback graftserve children over HTTP and GATE on
+    the serving contracts (see the module docstring's ``--serve``
+    paragraph).  The parent stays stdlib-pure — every fleet touch
+    happens inside ``python -m magicsoup_tpu.serve`` children."""
+    import importlib.util
+    import os
+    import signal
+    import urllib.error
+    import urllib.request
+
+    base = Path(tempfile.mkdtemp(prefix="msoup-serve-"))
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # one SHARED persistent compile cache, warmed by a throwaway child
+    # first: a cache-loaded XLA:CPU executable can differ numerically
+    # from a freshly-compiled one (see tests/conftest.py), so the
+    # digest-bearing children must all LOAD the same warm entries
+    env["MAGICSOUP_COMPILE_CACHE_DIR"] = str(base / "xla-cache")
+    problems: list[str] = []
+    procs: list[subprocess.Popen] = []
+    k = args.megastep
+    tenants = (
+        ("t1", 7, args.map_size),
+        ("t2", 11, args.map_size),
+        ("t3", 17, max(4, args.map_size // 2)),  # its own capacity rung
+    )
+
+    def _spec(tenant, seed, map_size, **over):
+        spec = {
+            "tenant": tenant,
+            "seed": seed,
+            "map_size": map_size,
+            "n_cells": args.n_cells,
+            "genome_size": args.genome_size,
+            "chemistry": {
+                "molecules": [
+                    {"name": "sv-a", "energy": 10000.0},
+                    {"name": "sv-atp", "energy": 8000.0,
+                     "half_life": 100000},
+                ],
+                "reactions": [[["sv-a"], ["sv-atp"]]],
+            },
+            "stepper": {"mol_name": "sv-atp", "megastep": k},
+        }
+        spec.update(over)
+        return spec
+
+    def _req(port, method, path, body=None, timeout=600):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def _spawn(subdir):
+        """Start a service child; returns (proc, port) once ready."""
+        log = open(base / f"{subdir}.log", "w")
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "magicsoup_tpu.serve",
+                "--dir",
+                str(base / subdir),
+                "--port",
+                "0",
+            ],
+            env=env,
+            cwd=str(repo),
+            stdout=subprocess.PIPE,
+            stderr=log,
+            text=True,
+        )
+        procs.append(proc)
+        ready = None
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("{") and '"ready"' in line:
+                ready = json.loads(line)
+                break
+        if ready is None:
+            proc.kill()
+            raise SystemExit(
+                f"serve smoke FAILED: {subdir} child exited before its "
+                f"ready line (see {base}/{subdir}.log)"
+            )
+        return proc, ready
+
+    def _wait_megasteps(port, who, tid, target, timeout_s=600):
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            _s, obs = _req(port, "GET", f"/tenants/{tid}")
+            if obs.get("megasteps", -1) >= target:
+                return obs
+            time.sleep(0.1)
+        problems.append(f"{who}: {tid} never reached {target} megasteps")
+        return None
+
+    def _phase1(port, who):
+        """The shared pre-kill schedule: create the three tenants, serve
+        2 megasteps each, checkpoint each at that boundary."""
+        for tid, seed, msz in tenants:
+            status, out = _req(port, "POST", "/tenants",
+                               _spec(tid, seed, msz))
+            if status != 200 or out.get("status") != "active":
+                problems.append(f"{who}: create {tid} -> {status} {out}")
+        for tid, _seed, _msz in tenants:
+            _req(port, "POST", f"/tenants/{tid}/step", {"megasteps": 2})
+        for tid, _seed, _msz in tenants:
+            _wait_megasteps(port, who, tid, 2)
+        for tid, _seed, _msz in tenants:
+            status, _out = _req(port, "POST", f"/tenants/{tid}/checkpoint")
+            if status != 200:
+                problems.append(f"{who}: checkpoint {tid} -> {status}")
+
+    def _phase2_steps(port, who):
+        """One more megastep each (separate from the digests below so
+        the baseline's fetch-census window stays flush-free)."""
+        for tid, _seed, _msz in tenants:
+            _req(port, "POST", f"/tenants/{tid}/step", {"megasteps": 1})
+        for tid, _seed, _msz in tenants:
+            _wait_megasteps(port, who, tid, 3)
+
+    def _digests(port, who):
+        digests = {}
+        for tid, _seed, _msz in tenants:
+            status, out = _req(port, "GET", f"/tenants/{tid}/digest")
+            if status != 200:
+                problems.append(f"{who}: digest {tid} -> {status}")
+            else:
+                digests[tid] = out["digest"]
+        return digests
+
+    try:
+        # -- warm the shared compile cache (results discarded)
+        wproc, _ready = _spawn("warmup")
+        wport = _ready["port"]
+        _phase1(wport, "warmup")
+        _phase2_steps(wport, "warmup")
+        _digests(wport, "warmup")
+        wproc.send_signal(signal.SIGTERM)
+        wproc.wait(timeout=300)
+
+        # -- baseline service: uninterrupted schedule + the admission,
+        # census and accounting gates
+        aproc, _ready = _spawn("a")
+        aport = _ready["port"]
+        _phase1(aport, "baseline")
+
+        # fetch census: drain (accounting drains), then exactly one
+        # megastep for each tenant -> one physical fetch per rung group
+        # (t1+t2 share one group, t3 owns the other) and nothing else
+        _req(aport, "GET", "/accounting")
+        _s, c1 = _req(aport, "GET", "/counters")
+        _phase2_steps(aport, "baseline")
+        _req(aport, "GET", "/accounting")
+        _s, c2 = _req(aport, "GET", "/counters")
+        digests_a = _digests(aport, "baseline")
+        # each HTTP grant completes before the next is sent, so the
+        # three megasteps land in three ticks: the t1+t2 rung group
+        # physically steps once per grant (2 fetches) and t3's group
+        # once — 3 group-steps, 3 fetches, nothing per-tenant
+        fetch_delta = c2["counters"]["fetches"] - c1["counters"]["fetches"]
+        if fetch_delta != 3:
+            problems.append(
+                f"fetch census: {fetch_delta} fetches for 3 sequential "
+                "single-megastep grants (want exactly 3: one per "
+                "physical rung-group step)"
+            )
+
+        # admission: zero compile budget -> cold spec refused, warm spec
+        # admitted AND served with zero new compiles
+        _req(aport, "POST", "/admission", {"compile_budget": 0})
+        status, out = _req(
+            aport, "POST", "/tenants",
+            _spec("cold", 5, args.map_size * 2),
+        )
+        if status != 429:
+            problems.append(f"cold create under budget 0 -> {status} {out}")
+        status, out = _req(
+            aport, "POST", "/tenants", _spec("t4", 23, args.map_size)
+        )
+        if status != 200 or out.get("status") != "active":
+            problems.append(f"warm create under budget 0 -> {status} {out}")
+        else:
+            # the bracket starts AFTER the create: building t4's world
+            # traces genome-DATA-dependent translation programs (new
+            # phenotype shape buckets for the new seed's genomes) which
+            # no warmup can pre-trace.  The padded-slot admission
+            # contract is about the fleet path — serving the admitted
+            # tenant reuses the warm rung's stacked programs outright
+            _s, cpre = _req(aport, "GET", "/counters")
+            c_before = cpre["counters"]["compiles"]
+            _req(aport, "POST", "/tenants/t4/step", {"megasteps": 1})
+            _wait_megasteps(aport, "baseline", "t4", 1)
+            _req(aport, "GET", "/accounting")
+            _s, c3 = _req(aport, "GET", "/counters")
+            if c3["counters"]["compiles"] != c_before:
+                problems.append(
+                    "serving the warm-admitted tenant compiled "
+                    f"{c3['counters']['compiles'] - c_before} new "
+                    "program(s); the warm rung's stacked step should "
+                    "be reused outright"
+                )
+
+        # accounting: rows sum exactly to the steps served and the
+        # fetch bytes observed, and pass the telemetry schema gate
+        _s, acct = _req(aport, "GET", "/accounting")
+        rows = acct["rows"]
+        served = {r["tenant"]: r["steps"] for r in rows}
+        want = {"t1": 3 * k, "t2": 3 * k, "t3": 3 * k, "t4": k}
+        if served != want:
+            problems.append(f"accounting steps {served} != served {want}")
+        if acct["total_steps"] != sum(r["steps"] for r in rows):
+            problems.append("accounting total_steps != sum of rows")
+        if acct["total_fetch_bytes"] != sum(
+            r["fetch_bytes"] for r in rows
+        ):
+            problems.append("accounting fetch bytes not conserved")
+        spec = importlib.util.spec_from_file_location(
+            "_tsummary", repo / "magicsoup_tpu" / "telemetry" / "summary.py"
+        )
+        tsummary = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tsummary)
+        problems += [
+            f"accounting row schema: {p}"
+            for p in tsummary.validate_rows(rows)
+        ]
+
+        # SIGTERM: graceful drain -> final checkpoints + registry, rc 0
+        aproc.send_signal(signal.SIGTERM)
+        try:
+            aproc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            aproc.kill()
+            problems.append("baseline child ignored SIGTERM")
+        if aproc.returncode != 0:
+            problems.append(f"baseline SIGTERM rc={aproc.returncode}")
+        if not (base / "a" / "tenants.json").exists():
+            problems.append("graceful stop left no tenant registry")
+        if not list((base / "a" / "worlds").glob("world-003-*.msck")):
+            problems.append(
+                "graceful stop left no final checkpoint for tenant t4"
+            )
+
+        # -- victim service: same schedule up to the phase-1 boundary,
+        # then SIGKILL (no warning, no drain)
+        bproc, _ready = _spawn("b")
+        _phase1(_ready["port"], "victim")
+        bproc.send_signal(signal.SIGKILL)
+        rc = bproc.wait(timeout=60)
+        if rc != -signal.SIGKILL:
+            problems.append(f"victim rc={rc}, expected -SIGKILL")
+        bproc.stdout.close()
+
+        # -- restart on the same directory: every tenant re-adopted at
+        # its checkpointed megastep, and the FINISHED schedule's digests
+        # equal the uninterrupted baseline's bit-for-bit
+        rproc, ready = _spawn("b")
+        rport = ready["port"]
+        if ready.get("tenants") != 3:
+            problems.append(
+                f"recovery re-adopted {ready.get('tenants')} tenants, not 3"
+            )
+        for tid, _seed, _msz in tenants:
+            _s, obs = _req(rport, "GET", f"/tenants/{tid}")
+            if obs.get("megasteps") != 2:
+                problems.append(
+                    f"recovered {tid} at megasteps={obs.get('megasteps')},"
+                    " checkpointed at 2"
+                )
+        _phase2_steps(rport, "recovery")
+        digests_b = _digests(rport, "recovery")
+        for tid, _seed, _msz in tenants:
+            if digests_a.get(tid) != digests_b.get(tid):
+                problems.append(
+                    f"kill/restart digest mismatch for {tid}: "
+                    f"{str(digests_a.get(tid))[:16]} != "
+                    f"{str(digests_b.get(tid))[:16]}"
+                )
+        _s, acct = _req(rport, "GET", "/accounting")
+        resumed = {r["tenant"]: r["steps"] for r in acct["rows"]}
+        if resumed != {"t1": 3 * k, "t2": 3 * k, "t3": 3 * k}:
+            problems.append(
+                f"accounting did not survive the restart: {resumed}"
+            )
+        rproc.send_signal(signal.SIGTERM)
+        try:
+            rproc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            rproc.kill()
+            problems.append("recovery child ignored SIGTERM")
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+    print(
+        json.dumps(
+            {
+                "metric": "serve smoke (graftserve multi-tenant, cpu)",
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "digests": sorted(digests_a.values())
+                if "digests_a" in locals()
+                else None,
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("serve smoke FAILED: " + "; ".join(problems))
 
 
 if __name__ == "__main__":

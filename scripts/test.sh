@@ -63,3 +63,14 @@ python performance/smoke.py --fleet-chaos
 # digests (magicsoup_tpu/check/differential.py).  Exits nonzero on any
 # divergence.
 python performance/smoke.py --differential
+# graftserve multi-tenant smoke (GATING): loopback `python -m
+# magicsoup_tpu.serve` children driven over HTTP — warm-rung admission
+# must serve a fourth tenant under compile_budget=0 with ZERO new
+# compiles (cold spec -> 429), the fetch census must show exactly one
+# physical fetch per rung-group step, the accounting rows must sum
+# exactly to the steps served and fetch bytes observed, SIGTERM must
+# drain into final checkpoints + a registry and exit 0, and a SIGKILLed
+# service restarted on the same directory must re-adopt every tenant
+# and finish the schedule with digests BIT-identical to the
+# uninterrupted baseline's.  Exits nonzero on any violation.
+python performance/smoke.py --serve

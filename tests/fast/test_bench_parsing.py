@@ -24,6 +24,9 @@ def _load(name: str, rel: str):
 bench = _load("bench", "bench.py")
 check = _load("check", "performance/check.py")
 summarize_capture = _load("summarize_capture", "scripts/summarize_capture.py")
+# both stdlib-pure by contract (loaded standalone, no jax/numpy):
+tsummary = _load("tsummary", "magicsoup_tpu/telemetry/summary.py")
+saccounting = _load("saccounting", "magicsoup_tpu/serve/accounting.py")
 
 
 def test_result_line_detection():
@@ -433,6 +436,39 @@ def test_publish_telemetry_refuses_invalid_stream(tmp_path, monkeypatch):
     published = pub(_telemetry_lines([5.0, 6.0, 7.0]), "cap-later")
     assert published["telemetry"]["phases"]["dispatch"]["n"] == 3
     assert published["telemetry"]["capture_dir"].endswith("cap-later")
+
+
+def test_accounting_row_schema_pinned():
+    # the serve ledger and the stdlib-pure validator each carry a copy
+    # of the counter-field tuple (summary.py must stay importable
+    # without the serve package); pin that the two cannot drift
+    assert tsummary.ACCOUNTING_COUNTER_KEYS == saccounting._COUNTER_FIELDS
+    # a ledger-produced row passes the validator as-is
+    ledger = saccounting.AccountingLedger()
+    ledger.open("alpha", 0)
+    ledger.charge_megastep("alpha", 4)
+    ledger.charge_fetch(["alpha"], 1024)
+    rows = ledger.rows()
+    assert [r["type"] for r in rows] == ["accounting"]
+    assert tsummary.validate_rows(rows) == []
+
+
+def test_accounting_row_validation_rejects_malformed():
+    good = {
+        "type": "accounting", "tenant": "alpha", "world": 0,
+        "steps": 8, "megasteps": 2, "dispatches": 2, "fetch_bytes": 1024,
+        "sentinel_trips": 0, "invariant_trips": 0,
+    }
+    assert tsummary.validate_rows([good]) == []
+    for broken, needle in [
+        ({**good, "tenant": 7}, "tenant"),
+        ({**good, "world": "zero"}, "world"),
+        ({k: v for k, v in good.items() if k != "steps"}, "steps"),
+        ({**good, "fetch_bytes": -1}, "fetch_bytes"),
+        ({**good, "dispatches": 1.5}, "dispatches"),
+    ]:
+        problems = tsummary.validate_rows([broken])
+        assert problems and needle in problems[0]
 
 
 def test_step_record_length_formula():

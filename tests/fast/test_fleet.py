@@ -245,6 +245,96 @@ def test_fleet_telemetry_rows_validate(chem_fleet, tmp_path):
         assert row["fleet_size"] == len(group.slots)
 
 
+# ------------------------------------------- padded-slot rung growth
+def test_pad_overflow_admission_compiles_nothing():
+    """ROADMAP 3(a): admitting past a FULL group opens a sibling
+    block-sized group whose pre-padded dead slots share the rung's
+    program shapes — overflow admission is pure data movement, zero
+    new compiles (the legacy grow="double" path recompiled here)."""
+    fleet = FleetScheduler(block=2)  # grow="pad" is the default
+    for s in (7, 11):
+        fleet.admit(_world(seed=s, genome_rng=99), **_KW_CHEM)
+    for _ in range(4):
+        fleet.step()
+    fleet.drain()
+
+    before = runtime.compile_count()
+    lane = fleet.admit(_world(seed=17, genome_rng=99), **_KW_CHEM)
+    fleet.step()
+    fleet.step()
+    fleet.drain()
+    assert runtime.compile_count() - before == 0
+    # same RUNG, second sibling group, still block-sized (padded slot
+    # left open for the next admission)
+    assert len(fleet._groups) == 1
+    siblings = next(iter(fleet._groups.values()))
+    assert len(siblings) == 2
+    group, _slot = lane._fleet_slot
+    assert group is siblings[1]
+    assert len(group.slots) == 2
+
+
+def test_pad_and_double_growth_bit_identical():
+    """The padded-admission path and the legacy doubling path are the
+    same trajectory: every world's resume-relevant state matches
+    byte-for-byte under the full selection workload."""
+    seeds = (7, 11, 17)
+    prints = {}
+    for grow in ("double", "pad"):
+        fleet = FleetScheduler(block=2, grow=grow)
+        lanes = [fleet.admit(_world(seed=s), **_KW_EVO) for s in seeds]
+        for _ in range(2):
+            fleet.step()
+        prints[grow] = [_fingerprint(l.world, l) for l in lanes]
+    for i, (pad, dbl) in enumerate(zip(prints["pad"], prints["double"])):
+        _assert_identical(dbl, pad, label=f"world {i} pad-vs-double: ")
+
+
+def test_restack_and_attach_counters():
+    """The runtime counters that bill fleet host work: a steady-state
+    step restacks nothing, a retire/readmit round trip costs ONE
+    incremental insert (residents skipped, no full rebuild), and a
+    flush -> step boundary re-attaches via the fast path (worlds
+    untouched since their flush)."""
+    fleet = FleetScheduler(block=4)
+    lanes = [
+        fleet.admit(_world(seed=s, genome_rng=99), **_KW_CHEM)
+        for s in (7, 11, 17)
+    ]
+    for _ in range(2):
+        fleet.step()
+    fleet.drain()
+
+    # steady state: groups stay clean — no restack work at all
+    base = runtime.snapshot()
+    fleet.step()
+    fleet.drain()
+    snap = runtime.snapshot()
+    assert snap["restack_full"] == base["restack_full"]
+    assert snap["restack_inserts"] == base["restack_inserts"]
+
+    # retire/readmit (the serve budget pause): incremental restack —
+    # one insert for the returning lane, the residents skipped in place
+    solo = fleet.retire(lanes[0])
+    fleet.readmit(solo)
+    base = runtime.snapshot()
+    fleet.step()
+    fleet.drain()
+    snap = runtime.snapshot()
+    assert snap["restack_full"] == base["restack_full"]
+    assert snap["restack_inserts"] == base["restack_inserts"] + 1
+    assert snap["restack_skipped"] == base["restack_skipped"] + 2
+
+    # flush -> step: every world proved untouched, fast re-attach
+    fleet.flush()
+    base = runtime.snapshot()
+    fleet.step()
+    fleet.drain()
+    snap = runtime.snapshot()
+    assert snap["attach_full"] == base["attach_full"]
+    assert snap["attach_skipped"] == base["attach_skipped"] + 3
+
+
 # --------------------------------------------------- world-axis mesh
 @pytest.mark.slow
 def test_sharded_fleet_step_matches_unsharded():

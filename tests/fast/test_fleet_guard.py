@@ -199,6 +199,54 @@ _KW_CHEM = dict(
 )
 
 
+def test_restore_world_readmits_into_live_scheduler(tmp_path):
+    """The serve restore path: a world pulled out of a fleet checkpoint
+    re-admits into an ALREADY-RUNNING scheduler's warm rung with zero
+    new compiles, and its trajectory from there is bit-identical to
+    restoring the same world solo and stepping it alone."""
+    from magicsoup_tpu.analysis import runtime
+
+    fleet = FleetScheduler(block=4)
+    for s in (7, 11, 17):
+        fleet.admit(_world(s), **_KW_CHEM)
+    for _ in range(2):
+        fleet.step()
+    path = save_fleet(tmp_path / "fleet.msck", fleet)
+    # the scheduler keeps serving its other tenants meanwhile
+    fleet.step()
+    fleet.drain()
+
+    # solo reference continuation (compiles whatever the solo path
+    # needs — deliberately OUTSIDE the zero-compile bracket below)
+    world_a, aux_a, _meta = restore_world(path, 1)
+    solo = PipelinedStepper(world_a, **_KW_CHEM)
+    guard.restore_stepper(solo, aux_a)
+    for _ in range(2):
+        solo.step()
+    solo.flush()
+
+    # live re-admission: the rung is warm and has a free padded slot,
+    # so restore + admit + the next fleet steps compile NOTHING
+    before = runtime.compile_count()
+    world_b, aux_b, _meta = restore_world(path, 1)
+    lane = fleet.admit(world_b, **_KW_CHEM)
+    guard.restore_stepper(lane, aux_b)
+    for _ in range(2):
+        fleet.step()
+    fleet.drain()
+    assert runtime.compile_count() - before == 0
+    # it joined the live group, not a private one
+    group, _slot = lane._fleet_slot
+    assert len(group.members()) == 4
+
+    lane.flush()
+    _assert_identical(
+        _fingerprint(solo.world, solo),
+        _fingerprint(lane.world, lane),
+        label="live-readmit vs solo continuation: ",
+    )
+
+
 def test_restore_audit_rejects_seeded_corruption(tmp_path):
     """The deep-audit seam of the fleet restore: a world whose resident
     params were desynced from its genomes BEFORE the save produces a

@@ -57,6 +57,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl011_traced_assert.py", "GL011"),
         ("gl012_shared_key.py", "GL012"),
         ("gl013_swallowed_guard.py", "GL013"),
+        ("gl014_blocking_serve.py", "GL014"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -235,6 +236,65 @@ def test_gl013_reraise_and_specific_catch_pass(tmp_path):
     findings = analyze([p], rules=["GL013"])
     assert [f.rule for f in findings] == ["GL013"]
     assert findings[0].line == 5
+
+
+def test_gl014_waivable_like_the_other_rules(tmp_path):
+    # a deliberately blocking wait (e.g. a dedicated worker thread that
+    # exists to block) waives with the standard inline annotation; pin
+    # that the machinery covers GL014
+    src = (FIXTURES / "gl014_blocking_serve.py").read_text()
+    waived = src.replace(
+        "# GL014: unbounded wait wedges the loop",
+        "# graftlint: disable=GL014 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl014_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl014_scoped_to_serve_modules(tmp_path):
+    # the SAME blocking drain is silent once the module stops being
+    # serve-scoped: outside the serving layer a blocking consumer loop
+    # is a legitimate worker-thread shape
+    src = (FIXTURES / "gl014_blocking_serve.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import serve"
+        "  # noqa: F401  (marks the module serve-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl014_not_serve.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL014"]) == []
+
+
+def test_gl014_sleep_and_bare_result_forms(tmp_path):
+    # sleep pacing and timeout-less future waits inside a serve loop
+    # are the same stall spelled differently; the bounded forms and
+    # blocking calls OUTSIDE loops (one-shot commands, whose caller
+    # holds the timeout) stay silent
+    p = tmp_path / "gl014_forms.py"
+    p.write_text(
+        "import time\n"
+        "from magicsoup_tpu import serve  # noqa: F401\n"
+        "def loop_sleep(stop):\n"
+        "    while not stop.is_set():\n"
+        "        time.sleep(0.1)\n"
+        "def loop_result(stop, futures):\n"
+        "    while futures:\n"
+        "        futures.pop().result()\n"
+        "def loop_bounded(stop, futures):\n"
+        "    while futures:\n"
+        "        futures.pop().result(timeout=30.0)\n"
+        "def one_shot(fut):\n"
+        "    return fut.result()\n"
+    )
+    findings = analyze([p], rules=["GL014"])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("GL014", 5),
+        ("GL014", 8),
+    ]
 
 
 def test_gl010_write_form_detected(tmp_path):

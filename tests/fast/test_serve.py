@@ -1,0 +1,354 @@
+"""
+graftserve tests (:mod:`magicsoup_tpu.serve`): the serving contracts,
+pinned in-process (the cross-process SIGKILL leg lives in
+``performance/smoke.py --serve``):
+
+- spec validation / routing are total functions with typed 4xx errors;
+- the service lifecycle: create -> budgeted step -> observe ->
+  accounting (rows exact at the drain boundary and schema-valid) ->
+  checkpoint/restore (digest round trip) -> detach;
+- budget pauses are trajectory-invisible (N megasteps in one request
+  == the same N spread over three);
+- admission control: cold specs are rejected or queued under a zero
+  compile budget, a WARM rung admits and serves with zero new
+  compiles;
+- crash-safe recovery: a new service on the same directory re-adopts
+  every tenant with megasteps/accounting intact and a bit-identical
+  digest.
+
+The scheduler loop is driven manually (``_tick``) except in the HTTP
+test, so the tests are deterministic and single-threaded.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from magicsoup_tpu.analysis import runtime
+from magicsoup_tpu.serve import FleetService, ServeError, tenant_digest
+from magicsoup_tpu.serve import api
+from magicsoup_tpu.telemetry import validate_rows
+
+
+def _spec(tenant=None, *, seed=7, **over):
+    spec = {
+        "seed": seed,
+        "map_size": 16,
+        "n_cells": 8,
+        "genome_size": 200,
+        "chemistry": {
+            "molecules": [
+                {"name": "sv-a", "energy": 10000.0},
+                {"name": "sv-atp", "energy": 8000.0, "half_life": 100000},
+            ],
+            "reactions": [[["sv-a"], ["sv-atp"]]],
+        },
+        "stepper": {"mol_name": "sv-atp", "megastep": 2},
+    }
+    if tenant is not None:
+        spec["tenant"] = tenant
+    spec.update(over)
+    return spec
+
+
+def _drain(svc, max_ticks=200):
+    """Tick until every budget is served, plus one reconcile tick."""
+    for _ in range(max_ticks):
+        if not any(t.budget > 0 for t in svc._tenants.values()):
+            svc._tick()
+            return
+        svc._tick()
+    raise AssertionError("budgets did not drain")
+
+
+def _service(path, **kw):
+    kw.setdefault("block", 2)
+    kw.setdefault("idle_wait", 0.001)
+    return FleetService(path, **kw)
+
+
+# --------------------------------------------------- pure wire format
+def test_validate_spec_defaults_and_errors():
+    spec = api.validate_spec(_spec("acme"))
+    assert spec["seed"] == 7
+    assert spec["deterministic"] is True
+    assert spec["checkpoint_cadence"] == 0
+    assert spec["queue"] is False
+
+    for broken, needle in [
+        ([], "JSON object"),
+        (_spec(tenant=""), "tenant"),
+        ({**_spec(), "chemistry": {"molecules": []}}, "molecules"),
+        ({**_spec(), "genome_size": 10}, "genome_size"),
+        (
+            {
+                **_spec(),
+                "chemistry": {
+                    "molecules": [{"name": "sv-a", "energy": 1.0}],
+                    "reactions": [[["sv-a"], ["ghost"]]],
+                },
+            },
+            "declared molecules",
+        ),
+        (
+            {**_spec(), "stepper": {"mol_name": "sv-atp", "warp": 9}},
+            "unknown stepper knobs",
+        ),
+    ]:
+        with pytest.raises(ServeError) as err:
+            api.validate_spec(broken)
+        assert err.value.status == 400
+        assert needle in str(err.value)
+    # mol_name must be declared
+    with pytest.raises(ServeError):
+        api.validate_spec(
+            {**_spec(), "stepper": {"mol_name": "ghost"}}
+        )
+
+
+def test_spec_signature_ignores_identity_fields():
+    a = api.validate_spec(_spec("alpha", seed=7, checkpoint_cadence=2))
+    b = api.validate_spec(_spec("beta", seed=11, queue=True))
+    c = api.validate_spec(_spec("gamma", n_cells=16))
+    assert api.spec_signature(a) == api.spec_signature(b)
+    assert api.spec_signature(a) != api.spec_signature(c)
+
+
+def test_routes():
+    assert api._route("GET", "/healthz", {}) == ("health", {})
+    assert api._route("GET", "/counters", {}) == ("counters", {})
+    assert api._route("GET", "/accounting", {}) == ("accounting", {})
+    assert api._route("POST", "/admission", {"compile_budget": 0}) == (
+        "admission",
+        {"compile_budget": 0},
+    )
+    assert api._route("POST", "/shutdown", {}) == ("shutdown", {})
+    assert api._route("GET", "/tenants", {}) == ("list", {})
+    assert api._route("POST", "/tenants", {"seed": 1}) == (
+        "create",
+        {"seed": 1},
+    )
+    assert api._route("GET", "/tenants/acme", {}) == (
+        "observe",
+        {"tenant": "acme"},
+    )
+    assert api._route("DELETE", "/tenants/acme", {}) == (
+        "detach",
+        {"tenant": "acme"},
+    )
+    assert api._route("POST", "/tenants/acme/step", {"megasteps": 3}) == (
+        "step",
+        {"megasteps": 3, "tenant": "acme"},
+    )
+    assert api._route("GET", "/tenants/acme/digest", {}) == (
+        "digest",
+        {"tenant": "acme"},
+    )
+    for method, path, status in [
+        ("GET", "/nope", 404),
+        ("PUT", "/tenants", 405),
+        ("PUT", "/tenants/acme", 405),
+        ("POST", "/tenants/acme/warp", 404),
+    ]:
+        with pytest.raises(ServeError) as err:
+            api._route(method, path, {})
+        assert err.value.status == status
+
+
+# ------------------------------------------------- service lifecycle
+def test_lifecycle_accounting_checkpoint_restore(tmp_path):
+    svc = _service(tmp_path / "srv")
+    alpha = svc._execute("create", _spec("alpha", seed=7))
+    assert alpha["tenant"] == "alpha" and alpha["status"] == "active"
+    beta = svc._execute("create", _spec("beta", seed=11))
+    assert beta["world"] != alpha["world"]
+    with pytest.raises(ServeError) as err:
+        svc._execute("create", _spec("alpha"))
+    assert err.value.status == 409
+
+    svc._execute("step", {"tenant": "alpha", "megasteps": 2})
+    svc._execute("step", {"tenant": "beta", "megasteps": 1})
+    _drain(svc)
+
+    obs = svc._execute("observe", {"tenant": "alpha"})
+    assert obs["megasteps"] == 2
+    assert obs["steps"] == 4  # megastep=2
+    assert obs["status"] == "suspended"  # budget exhausted -> paused
+    assert obs["stats"]["steps"] == 4
+
+    # accounting is exact at the drain boundary and schema-valid
+    acct = svc._execute("accounting", {})
+    rows = acct["rows"]
+    assert validate_rows(rows) == []
+    assert [r["tenant"] for r in rows] == ["alpha", "beta"]
+    assert acct["total_steps"] == 6 == sum(r["steps"] for r in rows)
+    assert acct["total_fetch_bytes"] == sum(
+        r["fetch_bytes"] for r in rows
+    )
+    assert rows[0]["dispatches"] == 2 and rows[1]["dispatches"] == 1
+
+    # checkpoint -> digest -> diverge -> restore == rollback
+    ck = svc._execute("checkpoint", {"tenant": "alpha"})
+    assert f"world-{alpha['world']:03d}" in ck["path"]
+    d1 = svc._execute("digest", {"tenant": "alpha"})["digest"]
+    svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+    _drain(svc)
+    assert svc._execute("digest", {"tenant": "alpha"})["digest"] != d1
+    restored = svc._execute("restore", {"tenant": "alpha"})
+    assert restored["megasteps"] == 2
+    assert svc._execute("digest", {"tenant": "alpha"})["digest"] == d1
+
+    # detach returns the final accounting row and frees the id
+    out = svc._execute("detach", {"tenant": "beta"})
+    assert out["accounting"]["steps"] == 2
+    with pytest.raises(ServeError) as err:
+        svc._execute("observe", {"tenant": "beta"})
+    assert err.value.status == 404
+    listed = svc._execute("list", {})
+    assert [r["tenant"] for r in listed["tenants"]] == ["alpha"]
+
+
+def test_budget_pause_is_trajectory_invisible(tmp_path):
+    """N megasteps granted at once == the same N spread over three
+    requests with suspend/resume pauses in between — bit-identical."""
+    one = _service(tmp_path / "one")
+    one._execute("create", _spec("alpha", seed=13))
+    one._execute("step", {"tenant": "alpha", "megasteps": 3})
+    _drain(one)
+
+    split = _service(tmp_path / "split")
+    split._execute("create", _spec("alpha", seed=13))
+    for _ in range(3):
+        split._execute("step", {"tenant": "alpha", "megasteps": 1})
+        _drain(split)  # budget hits zero -> warden suspend between grants
+
+    assert (
+        one._execute("digest", {"tenant": "alpha"})["digest"]
+        == split._execute("digest", {"tenant": "alpha"})["digest"]
+    )
+
+
+# --------------------------------------------------------- admission
+def test_admission_budget_queue_and_warm_rung(tmp_path):
+    svc = _service(tmp_path / "srv", compile_budget=0)
+
+    # cold spec, no queue: typed 429, counted as rejected
+    with pytest.raises(ServeError) as err:
+        svc._execute("create", _spec("alpha"))
+    assert err.value.status == 429
+    assert svc._execute("counters", {})["admission"]["rejected"] == 1
+
+    # cold spec, queue=true: parked, admitted once the budget opens
+    out = svc._execute("create", _spec("alpha", queue=True))
+    assert out["status"] == "queued"
+    svc._tick()
+    assert "alpha" not in svc._tenants  # still cold, still parked
+    svc._execute("admission", {"compile_budget": None})
+    svc._tick()
+    assert svc._execute("observe", {"tenant": "alpha"})["status"] in (
+        "active",
+        "suspended",
+    )
+
+    # warm the rung (first steps compile; the sig->rung map fills in)
+    svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+    _drain(svc)
+
+    # zero-compile warm admission: same-shape spec admits AND serves
+    # under a zero budget without a single new compile
+    svc._execute("admission", {"compile_budget": 0})
+    c0 = runtime.compile_count()
+    beta = svc._execute("create", _spec("beta", seed=11))
+    assert beta["status"] == "active"
+    svc._execute("step", {"tenant": "beta", "megasteps": 1})
+    _drain(svc)
+    assert runtime.compile_count() - c0 == 0
+    assert svc._execute("observe", {"tenant": "beta"})["megasteps"] == 1
+
+    # a different-shape spec is still cold -> rejected before building
+    with pytest.raises(ServeError) as err:
+        svc._execute("create", _spec("gamma", n_cells=16))
+    assert err.value.status == 429
+
+
+# ------------------------------------------------ HTTP + recovery
+def _req(port, method, path, body=None):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=data,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def test_http_roundtrip_and_crash_recovery(tmp_path):
+    home = tmp_path / "srv"
+    svc = _service(home, idle_wait=0.01).start()
+    try:
+        port = svc.port
+        status, health = _req(port, "GET", "/healthz")
+        assert status == 200 and health["status"] == "serving"
+
+        status, out = _req(port, "POST", "/tenants", _spec("alpha"))
+        assert status == 200 and out["status"] == "active"
+        status, _ = _req(
+            port, "POST", "/tenants/alpha/step", {"megasteps": 2}
+        )
+        assert status == 200
+
+        import time
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            status, obs = _req(port, "GET", "/tenants/alpha")
+            assert status == 200
+            if obs["megasteps"] == 2:
+                break
+            time.sleep(0.05)
+        assert obs["megasteps"] == 2
+
+        status, dig = _req(port, "GET", "/tenants/alpha/digest")
+        assert status == 200
+        status, counters = _req(port, "GET", "/counters")
+        assert status == 200
+        assert "compiles" in counters["counters"]
+        assert "compile_budget" in counters["admission"]
+
+        # typed errors cross the wire as JSON, not stack traces
+        status, err = _req(port, "POST", "/tenants/ghost/step", {})
+        assert status == 404 and "ghost" in err["error"]
+        status, err = _req(port, "POST", "/tenants", [1, 2])
+        assert status == 400
+
+        status, out = _req(port, "POST", "/shutdown")
+        assert status == 200 and out["status"] == "stopping"
+    finally:
+        svc.stop()
+
+    # the graceful epilogue left a registry + a checkpoint stream
+    assert (home / "tenants.json").exists()
+    assert list((home / "worlds").glob("world-000-*.msck"))
+
+    # a new service on the same directory re-adopts the tenant with
+    # progress and digest intact (the SIGKILL variant of this is the
+    # serve smoke's job)
+    svc2 = _service(home)
+    t = svc2._tenants["alpha"]
+    assert t.megasteps == 2
+    acct = svc2._execute("accounting", {})
+    assert acct["rows"][0]["steps"] == 4
+    assert (
+        svc2._execute("digest", {"tenant": "alpha"})["digest"]
+        == dig["digest"]
+    )
+    # and it keeps serving
+    svc2._execute("step", {"tenant": "alpha", "megasteps": 1})
+    _drain(svc2)
+    assert svc2._execute("observe", {"tenant": "alpha"})["megasteps"] == 3

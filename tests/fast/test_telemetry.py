@@ -29,6 +29,11 @@ _SNAPSHOT_KEYS = {
     "phenotype_hits",
     "phenotype_misses",
     "phenotype_evictions",
+    "restack_full",
+    "restack_inserts",
+    "restack_skipped",
+    "attach_full",
+    "attach_skipped",
 }
 
 
