@@ -294,6 +294,16 @@ class FleetWarden:
         rec.status = "active"
         return lane
 
+    def reserve_label(self, label: int) -> None:
+        """Keep ``label`` (and everything below it) out of the
+        allocator's future assignments WITHOUT creating a record —
+        service recovery reserves the labels of registered tenants it
+        could NOT restore, so a later admission never reuses a lost
+        tenant's ``world-<label>`` stream prefix (rolling retention on
+        a reused prefix would rotate the lost tenant's surviving
+        checkpoints out of existence)."""
+        self._next_label = max(self._next_label, int(label) + 1)
+
     def label_of(self, lane) -> int:
         """The stable world label behind ``lane`` (stream prefix id)."""
         rec = self._by_lane.get(id(lane))
@@ -368,6 +378,17 @@ class FleetWarden:
     # ------------------------------------------------------------ #
     # policy (called by the scheduler at the top of step())        #
     # ------------------------------------------------------------ #
+
+    def pending_policy(self) -> bool:
+        """Whether a policy action (eviction of a tripped world, heal of
+        a cooled-down one) is waiting for the next step boundary.  The
+        serve loop checks this when NO tenant is runnable: a sole
+        tripped tenant must still reach its terminal state even though
+        ``scheduler.step()`` (the usual :meth:`before_step` driver)
+        never runs."""
+        return any(
+            rec.status in ("tripped", "cooldown") for rec in self._records
+        )
 
     def before_step(self) -> None:
         """One warden tick: evict tripped worlds, heal cooled-down

@@ -23,7 +23,11 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--block", type=int, default=4)
     parser.add_argument(
-        "--policy", default="warn", help="warden policy for tenant trips"
+        "--policy",
+        default="warn",
+        choices=("warn", "quarantine"),
+        help="warden policy for tenant trips ('heal' is not served: "
+        "roll tenants back via POST /tenants/<id>/restore)",
     )
     parser.add_argument("--keep", type=int, default=3)
     parser.add_argument(

@@ -164,7 +164,22 @@ class FleetService:
         idle_wait: float = 0.05,
     ):
         from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+        from magicsoup_tpu.guard.errors import GuardConfigError
 
+        if policy not in ("warn", "quarantine"):
+            # 'heal' rolls back on the warden's SCHEDULER-step cadence,
+            # which the serve layer never runs (tenant streams are
+            # written on the per-tenant checkpoint_cadence instead) —
+            # passing it through would just crash in FleetWarden with a
+            # cadence error that names no serve-level remedy
+            raise GuardConfigError(
+                "serve supports warden policy 'warn' or 'quarantine'; "
+                "for rollback, checkpoint tenants on a cadence "
+                "(spec checkpoint_cadence) and roll back explicitly "
+                "via POST /tenants/<id>/restore",
+                variable="policy",
+                value=str(policy),
+            )
         self.dir = Path(directory)
         (self.dir / "worlds").mkdir(parents=True, exist_ok=True)
         self.scheduler = FleetScheduler(block=block, grow="pad")
@@ -330,6 +345,12 @@ class FleetService:
         self._reconcile()
         runnable = self._runnable()
         if not runnable:
+            if self.warden.pending_policy():
+                # the policy normally runs inside scheduler.step(), but
+                # nothing is stepping — a sole tripped tenant must still
+                # be evicted to its terminal 'parked' state instead of
+                # idling as 'tripped' forever
+                self.warden.before_step()
             self._publish_health()
             self._wake.wait(timeout=self.idle_wait)
             self._wake.clear()
@@ -458,7 +479,11 @@ class FleetService:
         while True:
             self._seq += 1
             tid = f"tenant-{self._seq:03d}"
-            if tid not in self._tenants and tid not in self._pending:
+            if (
+                tid not in self._tenants
+                and tid not in self._pending
+                and tid not in self._lost
+            ):
                 return tid
 
     def _cmd_create(self, payload: dict) -> dict:
@@ -467,6 +492,14 @@ class FleetService:
         spec["tenant"] = tid
         if tid in self._tenants or tid in self._pending:
             raise api.ServeError(409, f"tenant {tid!r} already exists")
+        if tid in self._lost:
+            raise api.ServeError(
+                409,
+                f"tenant {tid!r} is lost (registered but unrecoverable: "
+                f"{self._lost[tid].get('error')}) — its id and stream "
+                "stay reserved; restart the service once the stream is "
+                "readable again",
+            )
         key = self._spec_rungs.get(api.spec_signature(spec))
         warm = key is not None and key in self._warm_rungs
         if not self.admission.assess(warm=warm):
@@ -569,6 +602,15 @@ class FleetService:
         t = self._get_tenant(payload)
         if t.lane is None:
             raise api.ServeError(409, f"tenant {t.tenant!r} is detached")
+        ws = self.warden.status_of(t.label)
+        if ws.status == "parked":
+            # terminal: budget would accrue forever with no progress
+            raise api.ServeError(
+                409,
+                f"tenant {t.tenant!r} is parked"
+                + (f" ({ws.reason})" if ws.reason else "")
+                + " — roll it back via POST /tenants/<id>/restore",
+            )
         megasteps = int(payload.get("megasteps", 1))
         if megasteps < 1:
             raise api.ServeError(400, "megasteps must be >= 1")
@@ -718,13 +760,18 @@ class FleetService:
         """Atomic rewrite of the static tenant registry.  Only facts
         needed to FIND a tenant's stream go here (label, spec); all
         dynamic state rides in checkpoint meta, so a torn write window
-        cannot lose progress — only a just-created tenant."""
+        cannot lose progress — only a just-created tenant.  Lost
+        tenants (registered but unrecoverable at the last restart) are
+        persisted too: their ids and stream labels stay reserved, and a
+        later restart retries them — a transient read failure must not
+        orphan a tenant's surviving checkpoints."""
         doc = {
             "format": REGISTRY_FORMAT,
             "tenants": {
                 t.tenant: {"label": t.label, "spec": t.spec}
                 for t in self._tenants.values()
             },
+            "lost": dict(self._lost),
         }
         fd, tmp = tempfile.mkstemp(
             dir=self.dir, prefix=".tenants-", suffix=".json"
@@ -746,7 +793,12 @@ class FleetService:
         """Re-adopt every registered tenant from its rolling stream
         (label order, so stream prefixes and the label allocator line
         up with the previous life).  A registered tenant with no
-        loadable checkpoint is reported as ``lost``, not guessed at."""
+        loadable checkpoint is reported as ``lost``, not guessed at —
+        but its label is still RESERVED in the warden's allocator (a
+        fresh admission reusing the prefix would rotate the lost
+        tenant's surviving checkpoints out of the rolling stream), and
+        tenants the previous life already held as lost are retried:
+        the read failure may have been transient."""
         from magicsoup_tpu.guard.checkpoint import CheckpointManager
         from magicsoup_tpu.guard.errors import CheckpointError
         from magicsoup_tpu.guard.resume import restore_run, restore_stepper
@@ -758,12 +810,18 @@ class FleetService:
             raise api.ServeError(
                 500, f"unknown registry format {doc.get('format')!r}"
             )
+        candidates = dict(doc.get("tenants", {}))
+        for tid, info in doc.get("lost", {}).items():
+            candidates.setdefault(tid, info)
         entries = sorted(
-            doc.get("tenants", {}).items(), key=lambda kv: kv[1]["label"]
+            candidates.items(), key=lambda kv: kv[1]["label"]
         )
         for tid, info in entries:
             label = int(info["label"])
             spec = info["spec"]
+            # reserve FIRST, unconditionally: whatever the restore
+            # outcome, this label's stream prefix is taken
+            self.warden.reserve_label(label)
             stream = CheckpointManager(
                 self.dir / "worlds",
                 keep=self.keep,
@@ -782,7 +840,11 @@ class FleetService:
                 restore_stepper(lane, aux)
                 self.admission.charge(_runtime.compile_count() - c0)
             except CheckpointError as exc:
-                self._lost[tid] = {"label": label, "error": str(exc)}
+                self._lost[tid] = {
+                    "label": label,
+                    "spec": spec,
+                    "error": str(exc),
+                }
                 continue
             t = _Tenant(
                 tenant=tid,
@@ -801,3 +863,7 @@ class FleetService:
                 lane.stats["sentinel_trips"],
                 lane.stats["invariant_trips"],
             )
+        if entries:
+            # normalize on disk: entries may have moved between the
+            # 'tenants' and 'lost' sections during this recovery
+            self._write_registry()

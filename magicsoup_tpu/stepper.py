@@ -1397,28 +1397,47 @@ class PipelinedStepper:
         at the next re-attach: equal tokens prove no classic-API
         mutation touched the World in between, so the serial host-replay
         rebuild can be skipped.  Every functional mutator replaces one
-        of these array/list objects (the ids change); the few pure
-        in-place mutators bump ``World._host_epoch`` instead.  Direct
-        in-place edits of ``cell_genomes``/``cell_labels`` ENTRIES are
-        not observable here — but those already desync kinetics params
-        and were never a supported mutation path (``update_cells`` is).
+        of these array/list objects; the few pure in-place mutators bump
+        ``World._host_epoch`` instead.  The token holds STRONG
+        references to the objects themselves (compared with ``is`` by
+        :meth:`_token_unchanged`, never ``id()``): a stored raw id could
+        compare equal after the original object is freed and a
+        same-sized replacement lands at the recycled address, silently
+        skipping a rebuild the replacement requires.  The references
+        cost nothing extra — at stamp time they alias the World's own
+        live arrays, and the token is dropped at the next attach.
+        Direct in-place edits of ``cell_genomes``/``cell_labels``
+        ENTRIES are not observable here — but those already desync
+        kinetics params and were never a supported mutation path
+        (``update_cells`` is).
         """
         w = self.world
         return (
             w._host_epoch,
             w.n_cells,
             w._capacity,
-            id(w._molecule_map),
-            id(w._cell_molecules),
-            id(w._positions_dev),
-            id(w.kinetics),
-            id(w.kinetics.params),
-            id(w.cell_genomes),
-            id(w.cell_labels),
-            id(w._np_positions),
-            id(w._np_lifetimes),
-            id(w._np_divisions),
-            id(w._np_cell_map),
+            w._molecule_map,
+            w._cell_molecules,
+            w._positions_dev,
+            w.kinetics,
+            w.kinetics.params,
+            w.cell_genomes,
+            w.cell_labels,
+            w._np_positions,
+            w._np_lifetimes,
+            w._np_divisions,
+            w._np_cell_map,
+        )
+
+    @staticmethod
+    def _token_unchanged(stamped: tuple | None, current: tuple) -> bool:
+        """Whether two :meth:`_world_token` fingerprints prove the World
+        untouched: scalar slots by value, object slots by IDENTITY (an
+        equal-valued copy is still a mutation — its rows may be stale)."""
+        if stamped is None:
+            return False
+        return stamped[:3] == current[:3] and all(
+            a is b for a, b in zip(stamped[3:], current[3:])
         )
 
     def _attach(self, key: jax.Array) -> None:
@@ -1635,8 +1654,7 @@ class PipelinedStepper:
             from magicsoup_tpu.analysis import runtime as _rt
 
             if (
-                self._flush_token is not None
-                and self._flush_token == self._world_token()
+                self._token_unchanged(self._flush_token, self._world_token())
                 and self.world._capacity >= self.world.n_cells + 1
             ):
                 # fast re-attach: nothing touched the World since our own

@@ -352,3 +352,108 @@ def test_http_roundtrip_and_crash_recovery(tmp_path):
     svc2._execute("step", {"tenant": "alpha", "megasteps": 1})
     _drain(svc2)
     assert svc2._execute("observe", {"tenant": "alpha"})["megasteps"] == 3
+
+
+def test_heal_policy_is_rejected_up_front(tmp_path):
+    """'heal' needs a scheduler-step cadence the serve loop never runs;
+    the service must refuse it at construction with a clear remedy, not
+    crash inside FleetWarden with a cadence error."""
+    from magicsoup_tpu.guard.errors import GuardConfigError
+
+    with pytest.raises(GuardConfigError) as err:
+        _service(tmp_path / "srv", policy="heal")
+    assert "restore" in str(err.value)
+
+
+def test_quarantine_sole_tenant_parks_while_idle(tmp_path):
+    """A tripped sole tenant is not runnable, so scheduler.step() (the
+    usual warden-policy driver) never fires — the idle tick must still
+    run the eviction so the tenant reaches its terminal 'parked' state
+    instead of idling as 'tripped' forever; further budget grants are a
+    typed 409, and an explicit restore brings it back."""
+    svc = _service(tmp_path / "srv", policy="quarantine")
+    out = svc._execute("create", _spec("alpha", checkpoint_cadence=1))
+    svc._execute("step", {"tenant": "alpha", "megasteps": 2})
+    svc._tick()  # serves megastep 1; cadence=1 wrote a rollback point
+
+    # trip the sole tenant mid-budget (the warden's report() path sets
+    # exactly this state when a sentinel/invariant lane fires)
+    rec = next(
+        r for r in svc.warden._records if r.label == out["world"]
+    )
+    rec.status = "tripped"
+    rec.last_kind = "sentinel"
+    svc._tick()  # no runnable tenant — the idle path must still evict
+    obs = svc._execute("observe", {"tenant": "alpha"})
+    assert obs["status"] == "parked"
+    assert "sentinel" in obs["warden"]["reason"]
+
+    with pytest.raises(ServeError) as err:
+        svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+    assert err.value.status == 409
+    assert "parked" in str(err.value)
+
+    restored = svc._execute("restore", {"tenant": "alpha"})
+    assert restored["status"] == "active"
+    _drain(svc)  # the budget restored from checkpoint meta drains
+    assert svc._execute("observe", {"tenant": "alpha"})["megasteps"] == 2
+
+
+def test_lost_tenant_reserves_label_and_is_retried(tmp_path):
+    """A registered tenant whose stream cannot be read at restart is
+    held as 'lost': its label stays OUT of the allocator (a new tenant
+    reusing the prefix would rotate the lost tenant's surviving
+    checkpoints out of the rolling stream), its id cannot be taken, it
+    survives registry rewrites, and a later restart that CAN read the
+    stream gets the tenant back intact."""
+    home = tmp_path / "srv"
+    svc = _service(home)
+    svc._execute("create", _spec("alpha"))
+    beta = svc._execute("create", _spec("beta", seed=11))
+    svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+    svc._execute("step", {"tenant": "beta", "megasteps": 2})
+    _drain(svc)
+    dig = svc._execute("digest", {"tenant": "beta"})["digest"]
+    svc._shutdown()
+
+    # hide beta's stream (beta holds the HIGHEST label — the exact
+    # shape where a non-reserved label would be reallocated next)
+    hidden = []
+    for path in sorted((home / "worlds").glob("world-001-*.msck")):
+        hidden.append((path, path.with_suffix(".hidden")))
+        path.rename(path.with_suffix(".hidden"))
+    assert hidden
+
+    svc2 = _service(home)
+    assert "alpha" in svc2._tenants and "beta" not in svc2._tenants
+    assert svc2._lost["beta"]["label"] == beta["world"] == 1
+    listed = svc2._execute("list", {})
+    assert {"tenant": "beta", "status": "lost"} in listed["tenants"]
+
+    # the lost id is not admissible, and the lost label is reserved:
+    # a fresh create allocates PAST it
+    with pytest.raises(ServeError) as err:
+        svc2._execute("create", _spec("beta", seed=11))
+    assert err.value.status == 409 and "lost" in str(err.value)
+    gamma = svc2._execute("create", _spec("gamma", seed=13))
+    assert gamma["world"] == 2
+    # gamma's stream must not have touched beta's prefix
+    assert not list((home / "worlds").glob("world-001-*.msck"))
+    svc2._shutdown()
+
+    # registry rewrites (gamma's create, the shutdown) kept the lost
+    # entry on disk
+    doc = json.loads((home / "tenants.json").read_text())
+    assert doc["lost"]["beta"]["label"] == 1
+    assert "spec" in doc["lost"]["beta"]
+
+    # stream back -> the next restart retries and recovers beta whole
+    for path, hid in hidden:
+        hid.rename(path)
+    svc3 = _service(home)
+    assert not svc3._lost
+    assert svc3._tenants["beta"].label == 1
+    assert svc3._tenants["beta"].megasteps == 2
+    assert svc3._execute("digest", {"tenant": "beta"})["digest"] == dig
+    doc = json.loads((home / "tenants.json").read_text())
+    assert doc["lost"] == {} and "beta" in doc["tenants"]

@@ -292,6 +292,34 @@ def test_pipelined_and_classic_phases_compose():
     assert np.isfinite(mm).all() and (mm >= 0).all()
 
 
+def test_world_token_holds_objects_not_ids():
+    """The fast re-attach fingerprint must hold the fingerprinted
+    OBJECTS and compare them by identity: a classic-API mutation can
+    free the original array/list and CPython's free-lists can hand a
+    same-sized replacement the recycled address, so a stored raw
+    ``id()`` could compare equal for a DIFFERENT object and silently
+    skip a required host-replay rebuild."""
+    world = _world(seed=11, n_cells=24)
+    st = PipelinedStepper(world, mol_name="stp-atp", lag=1)
+    st.step()
+    st.flush()
+    token = st._flush_token
+    assert token is not None
+    # the token aliases the World's live objects — strong references,
+    # not id snapshots that dangle once the object is freed
+    assert any(part is world.cell_genomes for part in token)
+    assert st._token_unchanged(token, st._world_token())
+    # an equal-valued REPLACEMENT object is a mutation: the comparison
+    # must fail on identity even though the contents match (the exact
+    # situation id() recycling could falsely bless)
+    world.cell_genomes = list(world.cell_genomes)
+    assert not st._token_unchanged(token, st._world_token())
+    # the full rebuild path re-attaches correctly afterwards
+    st.step()
+    st.flush()
+    st.check_consistency()
+
+
 def test_pipelined_accepts_mesh_world():
     """A mesh-placed world drives the SHARDED fused step (previous
     releases raised here; deep coverage — det bit-identity, collective
