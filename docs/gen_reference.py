@@ -106,6 +106,16 @@ MODULES = {
         "Per-tenant usage ledger: steps, dispatches, fetch bytes and"
         " trip counters, conserved exactly against process totals."
     ),
+    "magicsoup_tpu.analysis.concurrency": (
+        "graftrace static thread-ownership analysis: the thread-role"
+        " model behind graftlint rules GL015 (cross-thread-write),"
+        " GL016 (lock-order-inversion), and GL017 (queue-bypass)."
+    ),
+    "magicsoup_tpu.analysis.ownership": (
+        "graftrace runtime ownership assertions: `@owned_by(role)` /"
+        " `assert_owner()` raising typed `OwnershipViolation`s, armed"
+        " by `MAGICSOUP_DEBUG_OWNERSHIP=1` and zero-cost otherwise."
+    ),
     "magicsoup_tpu.fleet.sharding": (
         "World-axis data parallelism: shard the fleet's leading axis"
         " over a `P(\"world\")` device mesh (no collectives — worlds are"

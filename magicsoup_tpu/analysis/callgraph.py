@@ -112,6 +112,59 @@ class CallGraph:
                 tgt = self.resolve(rec.file, cls, node.func)
                 if tgt is not None:
                     rec.calls.add(tgt)
+                rec.calls.update(self._getattr_dispatch(rec.file, cls, node))
+
+    def _getattr_dispatch(self, f, cls: str | None, call: ast.Call) -> set[FuncKey]:
+        """Edges for ``getattr(self, f"_cmd_{name}")``-style dispatch.
+
+        A constant prefix in the f-string pins the callee set to every
+        same-class method sharing that prefix — without this, dynamically
+        dispatched handlers have no static callers and the concurrency
+        model would misclassify them as unreachable/ambient.
+        """
+        if not (isinstance(call.func, ast.Name) and call.func.id == "getattr"):
+            return set()
+        if len(call.args) < 2 or cls is None:
+            return set()
+        obj, name_expr = call.args[0], call.args[1]
+        if not (isinstance(obj, ast.Name) and obj.id in ("self", "cls")):
+            return set()
+        if not (
+            isinstance(name_expr, ast.JoinedStr)
+            and name_expr.values
+            and isinstance(name_expr.values[0], ast.Constant)
+            and isinstance(name_expr.values[0].value, str)
+        ):
+            return set()
+        prefix = f"{cls}." + name_expr.values[0].value
+        return {
+            key
+            for key in self.functions
+            if key[0] == f.rel and key[1].startswith(prefix)
+        }
+
+    def resolve_ref(self, f, cls: str | None, expr: ast.expr) -> FuncKey | None:
+        """Resolve a function *reference* (not a call) to a linted function.
+
+        Handles the forms thread/finalizer registration actually uses:
+        ``self._run`` / bare names / imported names, plus
+        ``functools.partial(fn, ...)`` which unwraps to its first
+        positional argument.
+        """
+        if isinstance(expr, ast.Call):
+            callee = expr.func
+            is_partial = (
+                isinstance(callee, ast.Name) and callee.id == "partial"
+            ) or (
+                isinstance(callee, ast.Attribute)
+                and callee.attr == "partial"
+                and isinstance(callee.value, ast.Name)
+                and callee.value.id == "functools"
+            )
+            if is_partial and expr.args:
+                return self.resolve_ref(f, cls, expr.args[0])
+            return None
+        return self.resolve(f, cls, expr)
 
     def resolve(self, f, cls: str | None, func: ast.expr) -> FuncKey | None:
         """Resolve a call target expression to a linted function, or None."""

@@ -41,7 +41,11 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated rule codes to run (default: all)",
     )
     parser.add_argument(
-        "--json", action="store_true", help="machine-readable findings"
+        "--json",
+        action="store_true",
+        help="machine-readable report (stable `graftlint/1` schema: "
+        "per-rule counts incl. zeros, fresh/baselined totals, finding "
+        "rows) — what CI archives",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
@@ -62,7 +66,18 @@ def main(argv: list[str] | None = None) -> int:
     fresh = engine.apply_baseline(findings, baseline)
 
     if args.json:
-        print(json.dumps([asdict(f) for f in fresh], indent=2))
+        counts = {code: 0 for code in sorted(RULE_INFO)}
+        for f in fresh:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        report = {
+            "schema": "graftlint/1",
+            "counts": counts,
+            "fresh": len(fresh),
+            "baselined": len(findings) - len(fresh),
+            "files": len({f.path for f in fresh}),
+            "findings": [asdict(f) for f in fresh],
+        }
+        print(json.dumps(report, indent=2))
     else:
         for f in fresh:
             print(f.format())

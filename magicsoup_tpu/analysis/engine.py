@@ -21,6 +21,11 @@ SUPPRESS_RE = re.compile(
     r"graftlint:\s*disable=((?:[A-Za-z]+\d*)(?:\s*,\s*[A-Za-z]+\d*)*)"
 )
 HOT_RE = re.compile(r"graftlint:\s*hot\b")
+# thread-ownership declaration for the graftrace concurrency rules:
+# `# graftlint: owner=scheduler-loop` on a `def` declares the function a
+# role entry point; on an attribute assignment it declares the
+# attribute's sanctioned single writer (see analysis/concurrency.py)
+OWNER_RE = re.compile(r"graftlint:\s*owner=([A-Za-z0-9_\-]+)")
 
 
 @dataclass(frozen=True, order=True)
@@ -56,6 +61,7 @@ class SourceFile:
         self.module = self.rel[:-3].replace("/", ".")
         self.suppressions: dict[int, set[str]] = {}
         self.hot_marks: set[int] = set()
+        self.owners: dict[int, str] = {}
         self._parents: dict[ast.AST, ast.AST] | None = None
         lines = self.text.splitlines()
         for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
@@ -74,8 +80,42 @@ class SourceFile:
                 # (trailing comments don't fit next to long expressions)
                 if lines[line - 1].lstrip().startswith("#"):
                     self.suppressions.setdefault(line + 1, set()).update(codes)
+            m = OWNER_RE.search(tok.string)
+            if m:
+                line = tok.start[0]
+                self.owners[line] = m.group(1)
+                if lines[line - 1].lstrip().startswith("#"):
+                    self.owners.setdefault(line + 1, m.group(1))
             if HOT_RE.search(tok.string):
                 self.hot_marks.add(tok.start[0])
+        self._share_across_decorated_headers()
+
+    def _share_across_decorated_headers(self) -> None:
+        """Findings on decorated defs are reported at the decorator line;
+        waivers and owner= declarations written on the `def` line must
+        still match.  Union both maps across each decorated definition's
+        header lines (every decorator line plus the def line)."""
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if not node.decorator_list:
+                continue
+            header = {d.lineno for d in node.decorator_list} | {node.lineno}
+            codes: set[str] = set()
+            for ln in header:
+                codes |= self.suppressions.get(ln, set())
+            if codes:
+                for ln in header:
+                    self.suppressions.setdefault(ln, set()).update(codes)
+            owner = next(
+                (self.owners[ln] for ln in sorted(header) if ln in self.owners),
+                None,
+            )
+            if owner is not None:
+                for ln in header:
+                    self.owners.setdefault(ln, owner)
 
     def suppressed(self, line: int, rule: str) -> bool:
         codes = self.suppressions.get(line, ())
@@ -97,6 +137,7 @@ class Context:
     files: list[SourceFile]
     graph: object  # callgraph.CallGraph
     hot: set  # set[FuncKey]
+    model: object | None = None  # concurrency.ThreadModel
 
 
 def default_target() -> Path:
@@ -139,10 +180,16 @@ def analyze(paths, rules: list[str] | None = None) -> list[Finding]:
     """
     from magicsoup_tpu.analysis import rules as rules_mod
     from magicsoup_tpu.analysis.callgraph import CallGraph
+    from magicsoup_tpu.analysis.concurrency import ThreadModel
 
     files = load_files(paths)
     graph = CallGraph(files)
-    ctx = Context(files=files, graph=graph, hot=graph.hot_functions())
+    ctx = Context(
+        files=files,
+        graph=graph,
+        hot=graph.hot_functions(),
+        model=ThreadModel(files, graph),
+    )
 
     by_rel = {f.rel: f for f in files}
     findings: list[Finding] = []
@@ -168,7 +215,10 @@ def load_baseline(path: Path | None = None) -> dict[str, int]:
     if not Path(path).exists():
         return {}
     data = json.loads(Path(path).read_text() or "{}")
-    return {str(k): int(v) for k, v in data.items()}
+    # "_"-prefixed keys are policy/comment entries, not budgets
+    return {
+        str(k): int(v) for k, v in data.items() if not str(k).startswith("_")
+    }
 
 
 def apply_baseline(

@@ -23,6 +23,16 @@ Findings; registration at the bottom.
 |       |                      | guard/fleet-scoped modules)                |
 | GL014 | blocking-call-in-    | serve-loop liveness (no unbounded sleeps / |
 |       | serve-loop           | waits inside serve-scoped scheduler loops) |
+| GL015 | cross-thread-write   | single-writer discipline (no attribute     |
+|       |                      | written from two thread roles lock-free)   |
+| GL016 | lock-order-inversion | deadlock freedom (one global acquisition   |
+|       |                      | order for every lock pair)                 |
+| GL017 | queue-bypass         | the serve command-queue contract (handler  |
+|       |                      | threads never mutate fleet state directly) |
+
+GL015-GL017 are built on the graftrace thread-role model; see
+analysis/concurrency.py for the model and analysis/ownership.py for the
+matching runtime assertions.
 
 The device-taint analysis is a deliberately shallow intra-procedural
 pass: a name is "device" when it is a parameter annotated with a device
@@ -38,6 +48,7 @@ from __future__ import annotations
 import ast
 import re
 
+from magicsoup_tpu.analysis import concurrency
 from magicsoup_tpu.analysis.engine import Context, Finding
 
 JAX_ROOTS = {"jax", "jnp", "lax"}
@@ -172,6 +183,9 @@ RULE_INFO = {
         "and turns a transient hiccup into a fleet-wide outage",
     ),
 }
+# the graftrace concurrency rules keep their metadata next to their
+# model (analysis/concurrency.py) — merge so the CLI/docs see one table
+RULE_INFO.update(concurrency.RULE_INFO)
 
 
 def _root_name(node: ast.expr) -> str | None:
@@ -1363,6 +1377,9 @@ CHECKERS = {
     "GL012": check_gl012,
     "GL013": check_gl013,
     "GL014": check_gl014,
+    "GL015": concurrency.check_gl015,
+    "GL016": concurrency.check_gl016,
+    "GL017": concurrency.check_gl017,
 }
 
 

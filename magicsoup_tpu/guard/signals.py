@@ -26,6 +26,8 @@ from __future__ import annotations
 import signal
 import threading
 
+from magicsoup_tpu.analysis import ownership
+
 
 class GracefulShutdown:
     """Context manager that latches SIGTERM/SIGINT into a bool flag.
@@ -55,6 +57,11 @@ class GracefulShutdown:
         return self._event.wait(timeout)
 
     def _handle(self, signum, frame):
+        # Python delivers signals on the main thread only; assert the
+        # installing thread and the handling thread agree
+        ownership.assert_owner(
+            self, "signal-owner", attribute="GracefulShutdown.signum"
+        )
         if self._event.is_set():
             # second signal: restore + re-deliver the default behaviour
             previous = self._previous.get(signum, signal.SIG_DFL)
@@ -70,6 +77,7 @@ class GracefulShutdown:
     def __enter__(self) -> "GracefulShutdown":
         if threading.current_thread() is not threading.main_thread():
             return self
+        ownership.bind(self, "signal-owner")
         for signum in self.signals:
             self._previous[signum] = signal.signal(signum, self._handle)
         return self

@@ -47,7 +47,9 @@ from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
 from pathlib import Path
 
+from magicsoup_tpu.analysis import ownership
 from magicsoup_tpu.analysis import runtime as _runtime
+from magicsoup_tpu.analysis.ownership import owned_by
 from magicsoup_tpu.serve import api
 from magicsoup_tpu.serve.accounting import AccountingLedger
 from magicsoup_tpu.serve.admission import AdmissionController
@@ -246,12 +248,16 @@ class FleetService:
             self._http_thread.start()
         return self.port
 
-    def run(self) -> None:
+    def run(self) -> None:  # graftlint: owner=scheduler-loop
         """The scheduler loop (blocking).  On the main thread, SIGTERM/
         SIGINT latch a graceful stop: drain, checkpoint every tenant,
         write the registry, exit cleanly."""
         from magicsoup_tpu.guard.signals import GracefulShutdown
 
+        # sanctioned handoff: construction published the first health
+        # snapshot from the caller's thread; from here on the loop
+        # thread owns every fleet mutation
+        ownership.bind(self, "scheduler-loop")
         self.serve_http()
         try:
             with GracefulShutdown() as stop:
@@ -280,6 +286,7 @@ class FleetService:
         else:
             self._stopped.wait(timeout=timeout)
 
+    @owned_by("scheduler-loop")
     def _shutdown(self) -> None:
         self.scheduler.drain()
         for t in sorted(self._tenants.values(), key=lambda t: t.label):
@@ -339,6 +346,7 @@ class FleetService:
     # the scheduler loop (single writer)                           #
     # ------------------------------------------------------------ #
 
+    @owned_by("scheduler-loop")
     def _tick(self) -> None:
         self._drain_commands()
         self._admit_pending()
@@ -441,6 +449,7 @@ class FleetService:
             self.ledger.charge_fetch(self._last_stepped, self._fetch_carry)
             self._fetch_carry = 0
 
+    @owned_by("scheduler-loop")
     def _publish_health(self) -> None:
         statuses = {}
         for t in self._tenants.values():
@@ -462,6 +471,7 @@ class FleetService:
     # commands                                                     #
     # ------------------------------------------------------------ #
 
+    @owned_by("scheduler-loop")
     def _execute(self, name: str, payload: dict) -> dict:
         handler = getattr(self, f"_cmd_{name}", None)
         if handler is None:

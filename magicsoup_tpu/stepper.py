@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
+from magicsoup_tpu.analysis.ownership import owned_by
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import detmath as _detmath
 from magicsoup_tpu.ops import diffusion as _diff
@@ -933,7 +934,8 @@ class _Worker:
         self._t.start()
         _register_exit_join(self)
 
-    def _run(self) -> None:
+    @owned_by("stepper-worker")
+    def _run(self) -> None:  # graftlint: owner=stepper-worker
         while True:
             item = self._q.get()
             if item is None:

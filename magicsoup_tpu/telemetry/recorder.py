@@ -39,6 +39,7 @@ import threading
 import time
 import weakref
 
+from magicsoup_tpu.analysis import ownership
 from magicsoup_tpu.telemetry.summary import percentile
 
 # per-phase sample rings are trimmed at this size (same bound as the
@@ -93,13 +94,16 @@ class TelemetrySnapshot:
         return dataclasses.asdict(self)
 
 
-def _close_handle(fh, buffered: list[str]) -> None:
+def _close_handle(fh, buffered: list[str], lock) -> None:
     # weakref.finalize target: flush whatever the recorder still holds
-    # buffered if it is garbage-collected while attached
+    # buffered if it is garbage-collected while attached.  The finalizer
+    # can fire at interpreter exit while a live emit() holds the buffer,
+    # so it must take the same lock the recorder's writers hold.
     try:
-        if buffered:
-            fh.write("\n".join(buffered) + "\n")
-        fh.close()
+        with lock:
+            if buffered:
+                fh.write("\n".join(buffered) + "\n")
+            fh.close()
     except Exception:
         pass
 
@@ -146,6 +150,9 @@ class TelemetryRecorder:
 
     def attach(self, path) -> "TelemetryRecorder":
         """Open ``path`` for append and start emitting JSONL rows."""
+        # the attaching thread owns the sink lifecycle (flush/detach);
+        # concurrent emit() is fine — it only touches the locked buffer
+        ownership.bind(self, "telemetry-writer")
         with self._lock:
             if self._fh is not None:
                 raise ValueError(
@@ -154,7 +161,7 @@ class TelemetryRecorder:
             self.path = str(path)
             self._fh = open(self.path, "a", encoding="utf-8")
             self._finalizer = weakref.finalize(
-                self, _close_handle, self._fh, self._buffer
+                self, _close_handle, self._fh, self._buffer, self._lock
             )
         self.emit(
             {
@@ -173,6 +180,9 @@ class TelemetryRecorder:
         """Emit a final counters row, flush, and close the sink."""
         if self._fh is None:
             return
+        ownership.assert_owner(
+            self, "telemetry-writer", attribute="TelemetryRecorder._fh"
+        )
         self.emit_counters()
         with self._lock:
             fh = self._fh
@@ -284,6 +294,10 @@ class TelemetryRecorder:
         a power cut / SIGKILL that lands right after — the graceful
         SIGTERM drain uses this for its final telemetry flush.
         """
+        if self._fh is not None:
+            ownership.assert_owner(
+                self, "telemetry-writer", attribute="TelemetryRecorder._fh"
+            )
         with self._lock:
             self._flush_locked()
             if sync and self._fh is not None:

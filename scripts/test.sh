@@ -8,6 +8,11 @@ TARGET="${1:-tests/fast}"
 # import) and a hot-path violation should fail before the suite spends
 # minutes compiling
 python -m magicsoup_tpu.analysis --check
+# arm the graftrace runtime ownership assertions (analysis/ownership.py)
+# for the whole suite: every test doubles as a thread-ownership probe of
+# the serve loop, stepper workers, telemetry flush, and signal handlers;
+# production runs leave the flag unset and pay nothing
+export MAGICSOUP_DEBUG_OWNERSHIP=1
 # the unit tier includes the graftcheck property-based suite
 # (tests/fast/test_check_properties.py): under Hypothesis it runs a
 # bounded CI profile (max_examples + deadline capped); without it the

@@ -58,6 +58,9 @@ def marked_line(path: Path, code: str) -> int:
         ("gl012_shared_key.py", "GL012"),
         ("gl013_swallowed_guard.py", "GL013"),
         ("gl014_blocking_serve.py", "GL014"),
+        ("gl015_cross_thread.py", "GL015"),
+        ("gl016_lock_order.py", "GL016"),
+        ("gl017_queue_bypass.py", "GL017"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -297,6 +300,116 @@ def test_gl014_sleep_and_bare_result_forms(tmp_path):
     ]
 
 
+def test_gl015_waivable_like_the_other_rules(tmp_path):
+    # deliberately lock-free sharing (e.g. a monotonic counter whose
+    # readers tolerate staleness) waives with the standard inline
+    # annotation; pin that the machinery covers GL015
+    src = (FIXTURES / "gl015_cross_thread.py").read_text()
+    waived = src.replace(
+        "# GL015: races record()",
+        "# graftlint: disable=GL015 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl015_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl015_locked_and_single_threaded_stay_clean(tmp_path):
+    # the lock-guarded twin and the threadless class from the fixture
+    # are silent on their own: the rule keys on role divergence with no
+    # common lock, not on mere attribute sharing
+    src = (FIXTURES / "gl015_cross_thread.py").read_text()
+    negatives = "import threading\n" + src[src.index("class LockedSampler") :]
+    p = tmp_path / "gl015_negatives.py"
+    p.write_text(negatives)
+    assert analyze([p], rules=["GL015"]) == []
+
+
+def test_gl016_waivable_like_the_other_rules(tmp_path):
+    # a deliberate inversion behind a try-lock or documented external
+    # ordering waives with the standard inline annotation; pin that the
+    # machinery covers GL016
+    src = (FIXTURES / "gl016_lock_order.py").read_text()
+    waived = src.replace(
+        "# GL016: inverts credit()'s order",
+        "# graftlint: disable=GL016 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl016_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl017_waivable_like_the_other_rules(tmp_path):
+    # a sanctioned direct read-modify (e.g. an admin drain endpoint that
+    # owns the loop via other means) waives with the standard inline
+    # annotation; pin that the machinery covers GL017
+    src = (FIXTURES / "gl017_queue_bypass.py").read_text()
+    waived = src.replace(
+        "# GL017: bypasses the queue",
+        "# graftlint: disable=GL017 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl017_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl017_scoped_to_serve_modules(tmp_path):
+    # the SAME handler-thread mutation is silent once the module stops
+    # being serve-scoped: outside the serving layer there is no command
+    # queue to bypass
+    src = (FIXTURES / "gl017_queue_bypass.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import serve"
+        "  # noqa: F401  (marks the module serve-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl017_not_serve.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL017"]) == []
+
+
+def test_waiver_on_def_line_covers_decorator_line_findings(tmp_path):
+    # findings on decorated defs anchor to the DECORATOR line (ast puts
+    # node.lineno there for the checker's node), but humans write the
+    # waiver on the def line they are annotating; the engine must treat
+    # the whole decorated header as one waiver scope
+    p = tmp_path / "decorated_waiver.py"
+    p.write_text(
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def step(state: 'DeviceState'):"
+        "  # graftlint: disable=GL006 fixture\n"
+        "    return state\n"
+    )
+    assert analyze([p]) == []
+    # and the engine-level view: every header line shares the waiver
+    src = lint_engine.SourceFile(p, "decorated_waiver.py")
+    assert src.suppressed(3, "GL006")  # decorator line
+    assert src.suppressed(4, "GL006")  # def line
+
+
+def test_owner_declaration_shared_across_decorated_header(tmp_path):
+    # `# graftlint: owner=<role>` on a def line must also be visible at
+    # the decorator lines, mirroring the waiver-scope rule above
+    p = tmp_path / "decorated_owner.py"
+    p.write_text(
+        "def deco(fn):\n"
+        "    return fn\n"
+        "\n"
+        "@deco\n"
+        "def run():  # graftlint: owner=sampler-loop\n"
+        "    pass\n"
+    )
+    src = lint_engine.SourceFile(p, "decorated_owner.py")
+    assert src.owners.get(4) == "sampler-loop"  # decorator line
+    assert src.owners.get(5) == "sampler-loop"  # def line
+
+
 def test_gl010_write_form_detected(tmp_path):
     # fh.write(pickle.dumps(obj)) is the same torn-write hazard spelled
     # differently; atomic_write_bytes(path, pickle.dumps(obj)) is not
@@ -391,10 +504,25 @@ def test_cli_check_exits_zero_on_clean_tree():
 
 
 def test_cli_json_output_is_machine_readable():
+    # the graftlint/1 report contract CI archives: schema tag, per-rule
+    # counts (every rule present, zeros included), fresh/baselined/files
+    # totals, and one row per fresh finding
     res = run_cli("--json", str(FIXTURES / "gl002_recompile.py"))
-    findings = json.loads(res.stdout)
-    assert [f["rule"] for f in findings] == ["GL002"]
-    assert findings[0]["fixit"]
+    report = json.loads(res.stdout)
+    assert report["schema"] == "graftlint/1"
+    assert sorted(report["counts"]) == ALL_RULES
+    assert report["counts"]["GL002"] == 1
+    assert all(
+        report["counts"][code] == 0 for code in ALL_RULES if code != "GL002"
+    )
+    assert report["fresh"] == 1
+    assert report["baselined"] == 0
+    assert report["files"] == 1
+    (row,) = report["findings"]
+    assert row["rule"] == "GL002"
+    assert row["fixit"]
+    assert row["path"].endswith("gl002_recompile.py")
+    assert row["line"] == marked_line(FIXTURES / "gl002_recompile.py", "GL002")
 
 
 def test_cli_list_rules_and_unknown_rule():

@@ -1,0 +1,177 @@
+"""graftrace runtime ownership assertions (analysis/ownership.py).
+
+These run with MAGICSOUP_DEBUG_OWNERSHIP in whatever state the harness
+set; each test pins `ownership._ENABLED` explicitly via monkeypatch so
+both the armed and the zero-cost paths are exercised regardless.
+"""
+import threading
+
+import pytest
+
+from magicsoup_tpu.analysis import ownership
+from magicsoup_tpu.analysis.ownership import OwnershipViolation, owned_by
+
+
+def make_service():
+    # defined per-test AFTER _ENABLED is pinned: owned_by captures the
+    # flag at decoration time
+    class Service:
+        @owned_by("loop")
+        def tick(self):
+            return "ticked"
+
+    return Service()
+
+
+def run_in_thread(fn):
+    box = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — relayed to the test
+            box["error"] = exc
+
+    t = threading.Thread(target=target)
+    t.start()
+    t.join()
+    return box
+
+
+def test_foreign_thread_trips_violation(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+    svc = make_service()
+    assert svc.tick() == "ticked"  # main thread lazily claims `loop`
+    box = run_in_thread(svc.tick)
+    err = box.get("error")
+    assert isinstance(err, OwnershipViolation)
+    assert err.role == "loop"
+    assert err.attribute.endswith("tick")
+    assert err.owner is threading.main_thread()
+    # it is an AssertionError subtype: plain pytest.raises(AssertionError)
+    # in callers keeps working
+    assert isinstance(err, AssertionError)
+
+
+def test_owner_thread_passes_repeatedly(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+    svc = make_service()
+    assert svc.tick() == "ticked"
+    assert svc.tick() == "ticked"
+
+
+def test_dead_owner_frees_the_role(monkeypatch):
+    # a restarted loop thread may re-claim a role its predecessor held
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+    svc = make_service()
+    first = run_in_thread(svc.tick)
+    assert first.get("value") == "ticked"  # thread 1 claimed `loop`...
+    assert svc.tick() == "ticked"  # ...and died, so main re-claims
+
+
+def test_bind_is_a_sanctioned_handoff(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+    svc = make_service()
+    assert svc.tick() == "ticked"  # main owns `loop`
+    worker_box = {}
+
+    def worker():
+        ownership.bind(svc, "loop")  # e.g. the top of run()
+        worker_box.update(run_in_thread_inline())
+
+    def run_in_thread_inline():
+        return {"value": svc.tick()}
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert worker_box.get("value") == "ticked"
+    # ...and now main is the foreigner until the worker dies; it already
+    # has, so the lazy re-claim applies instead of a violation
+    assert svc.tick() == "ticked"
+
+
+def test_assert_owner_names_the_attribute(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+
+    class Sink:
+        pass
+
+    sink = Sink()
+    ownership.assert_owner(sink, "writer", attribute="Sink._fh")
+
+    def foreign():
+        ownership.assert_owner(sink, "writer", attribute="Sink._fh")
+
+    box = run_in_thread(foreign)
+    err = box.get("error")
+    assert isinstance(err, OwnershipViolation)
+    assert err.attribute == "Sink._fh"
+    assert "Sink._fh" in str(err)
+    assert "writer" in str(err)
+
+
+def test_slotted_instances_degrade_to_noop(monkeypatch):
+    # nothing to pin the owner table to: checks pass rather than crash
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+
+    class Slotted:
+        __slots__ = ()
+
+        @owned_by("loop")
+        def tick(self):
+            return "ticked"
+
+    svc = Slotted()
+    assert svc.tick() == "ticked"
+    assert run_in_thread(svc.tick).get("value") == "ticked"
+
+
+def test_disabled_mode_is_zero_cost(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", False)
+
+    def tick(self):
+        return "ticked"
+
+    assert ownership.owned_by("loop")(tick) is tick  # undecorated
+
+    class Service:
+        pass
+
+    svc = Service()
+    ownership.bind(svc, "loop")
+    ownership.assert_owner(svc, "loop")
+    assert not hasattr(svc, "_graftrace_owners")  # no table materialized
+
+
+def test_violation_message_names_both_threads(monkeypatch):
+    monkeypatch.setattr(ownership, "_ENABLED", True)
+    svc = make_service()
+    svc.tick()
+    box = run_in_thread(svc.tick)
+    msg = str(box["error"])
+    assert threading.main_thread().name in msg
+    assert "entered from" in msg
+
+
+def test_enabled_reflects_environment_contract():
+    # scripts/test.sh exports MAGICSOUP_DEBUG_OWNERSHIP=1 for tier-1;
+    # enabled() reports whatever the process was launched with
+    assert ownership.enabled() is ownership._ENABLED
+
+
+@pytest.mark.parametrize("flag", [True, False])
+def test_bind_accepts_explicit_thread(monkeypatch, flag):
+    monkeypatch.setattr(ownership, "_ENABLED", flag)
+
+    class Service:
+        pass
+
+    svc = Service()
+    ownership.bind(svc, "loop", thread=threading.main_thread())
+    if flag:
+        assert getattr(svc, "_graftrace_owners")["loop"] is (
+            threading.main_thread()
+        )
+    else:
+        assert not hasattr(svc, "_graftrace_owners")
