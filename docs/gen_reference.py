@@ -61,6 +61,17 @@ MODULES = {
         " deterministic resume, health sentinels, watchdogs, and the"
         " fault injectors behind the chaos smoke."
     ),
+    "magicsoup_tpu.guard.chaos": (
+        "graftchaos deterministic fault injection: named, seeded,"
+        " schedule-driven fault points at every robustness boundary"
+        " (armed via `MAGICSOUP_CHAOS`), plus the process-wide"
+        " degraded-state registry and robustness counters."
+    ),
+    "magicsoup_tpu.guard.backoff": (
+        "The one shared deterministic retry ladder: seeded, capped,"
+        " optionally jittered exponential backoff with an injectable"
+        " clock."
+    ),
     "magicsoup_tpu.check": (
         "graftcheck correctness checking: invariant flag decoding, the"
         " host deep audit (`audit_world` / `assert_consistent`), and"

@@ -29,6 +29,10 @@ Findings; registration at the bottom.
 |       |                      | order for every lock pair)                 |
 | GL017 | queue-bypass         | the serve command-queue contract (handler  |
 |       |                      | threads never mutate fleet state directly) |
+| GL018 | raw-io-in-guard-path | the guard.io write boundary (no direct     |
+|       |                      | `open(...,"wb")`/`os.replace` in guard/    |
+|       |                      | fleet/serve-scoped modules — raw writes    |
+|       |                      | bypass atomicity AND the chaos fault plane)|
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
@@ -181,6 +185,15 @@ RULE_INFO = {
         "serve-scoped module — the serving loop is the single writer "
         "for every tenant, so one unbounded wait stalls all of them "
         "and turns a transient hiccup into a fleet-wide outage",
+    ),
+    "GL018": (
+        "raw-io-in-guard-path",
+        "a direct write-mode `open()` or `os.replace`/`os.rename` in a "
+        "guard/fleet/serve-scoped module — raw file writes bypass "
+        "guard.io's write-temp->fsync->replace protocol (so a crash "
+        "tears the file) AND the graftchaos fault plane (so the chaos "
+        "campaign cannot reach the failure path at all); append-mode "
+        "streams are exempt",
     ),
 }
 # the graftrace concurrency rules keep their metadata next to their
@@ -1362,6 +1375,81 @@ def check_gl014(ctx: Context):
                     )
 
 
+# --------------------------------------------------------------- GL018
+#: open() modes that can MODIFY the target ("w"/"x" truncate or create,
+#: "+" allows in-place writes); plain reads and append-only streams
+#: (JSONL telemetry sinks) are legitimately raw
+_WRITE_MODE = re.compile(r"[wx+]")
+
+
+def _open_write_mode(node: ast.Call) -> str | None:
+    """The string-literal mode of an ``open()`` call when it can write,
+    else None (reads, appends, or a dynamic mode expression)."""
+    mode = node.args[1] if len(node.args) >= 2 else None
+    if mode is None:
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and _WRITE_MODE.search(mode.value)
+    ):
+        return mode.value
+    return None
+
+
+def check_gl018(ctx: Context):
+    """Writes in the robustness stack must go through ``guard.io``.
+    A raw ``open(path, "wb")`` (or ``os.replace`` of a hand-built temp
+    file) in a guard/fleet/serve-scoped module bypasses two contracts
+    at once: the write-temp -> fsync -> ``os.replace`` atomicity that
+    keeps a crash from tearing the file, and the graftchaos
+    ``io.write`` fault point — so the chaos campaign can never reach
+    the code's failure path, which means its recovery behavior is
+    unproven by construction.  ``guard/io.py`` itself (the one module
+    that owns the raw protocol) is exempt; append-mode streams and
+    reads are not flagged."""
+    fix = (
+        "route the write through guard.io.atomic_write_bytes / "
+        "atomic_write_text (pass chaos_site= to join the fault plane); "
+        "waive a deliberate raw write (e.g. a fault injector) with "
+        "`# graftlint: disable=GL018`"
+    )
+    for f in ctx.files:
+        if f.path.parts[-2:] == ("guard", "io.py"):
+            continue
+        if not (_is_guard_scoped(f) or _is_serve_scoped(f)):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain == "open":
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    yield _finding(
+                        "GL018",
+                        f,
+                        node,
+                        f"`open(..., {mode!r})` in a guard-path module "
+                        "writes raw — it bypasses guard.io's atomic "
+                        "protocol and the chaos fault plane",
+                        fix,
+                    )
+            elif chain in ("os.replace", "os.rename"):
+                yield _finding(
+                    "GL018",
+                    f,
+                    node,
+                    f"`{chain}()` in a guard-path module finishes a "
+                    "hand-rolled write protocol — use guard.io, which "
+                    "already fsyncs, replaces atomically, and carries "
+                    "the chaos fault point",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1380,6 +1468,7 @@ CHECKERS = {
     "GL015": concurrency.check_gl015,
     "GL016": concurrency.check_gl016,
     "GL017": concurrency.check_gl017,
+    "GL018": check_gl018,
 }
 
 

@@ -238,11 +238,18 @@ def snapshot() -> dict[str, int]:
     ``persistent_cache_misses``, ``phenotype_hits``,
     ``phenotype_misses``, ``phenotype_evictions``, ``restack_full``,
     ``restack_inserts``, ``restack_skipped``, ``attach_full``,
-    ``attach_skipped``.
+    ``attach_skipped`` — plus the chaos/robustness contribution from
+    ``guard.chaos.runtime_counters`` (``chaos_fired``, ``degraded``,
+    and every ``note_counter`` key, so counted failures ride the same
+    telemetry ``counters`` rows as everything else).
     """
     install()
+    # lazy import, and strictly runtime -> chaos: guard.chaos never
+    # imports this module, so the counter merge cannot cycle
+    from magicsoup_tpu.guard import chaos as _chaos
+
     with _lock:
-        return {
+        out = {
             "compiles": _count,
             "persistent_cache_hits": _cache_hits,
             "persistent_cache_misses": _cache_misses,
@@ -255,6 +262,8 @@ def snapshot() -> dict[str, int]:
             "attach_full": _attach_full,
             "attach_skipped": _attach_skipped,
         }
+    out.update(_chaos.runtime_counters())
+    return out
 
 
 def reset_counters() -> None:
@@ -270,6 +279,8 @@ def reset_counters() -> None:
     global _pheno_hits, _pheno_misses, _pheno_evictions
     global _restack_full, _restack_inserts, _restack_skipped
     global _attach_full, _attach_skipped
+    from magicsoup_tpu.guard import chaos as _chaos
+
     with _lock:
         _count = 0
         _cache_hits = 0
@@ -282,3 +293,4 @@ def reset_counters() -> None:
         _restack_skipped = 0
         _attach_full = 0
         _attach_skipped = 0
+    _chaos.reset_counters()

@@ -39,10 +39,13 @@ O((L+1) * K) steps — the healthy worlds never see any of it.
 """
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
 
+from magicsoup_tpu.guard import chaos as _chaos
+from magicsoup_tpu.guard.backoff import BackoffPolicy
 from magicsoup_tpu.guard.checkpoint import CheckpointManager
 from magicsoup_tpu.guard.errors import CheckpointError, GuardConfigError
 
@@ -71,6 +74,11 @@ class WardenStatus:
     last_flags: int
     cooldown_until: int | None = None
     reason: str | None = None
+    # graceful-degradation accounting: cadence saves that failed and
+    # were SKIPPED (the run kept stepping), and whether the stream is
+    # currently in its degraded state (consecutive failures > 0)
+    save_skips: int = 0
+    save_degraded: bool = False
 
 
 @dataclass
@@ -88,6 +96,8 @@ class _WorldRecord:
     last_kind: str = ""
     cooldown_until: int | None = None
     reason: str | None = None
+    save_skips: int = 0
+    save_degraded: bool = False
     extra: dict = field(default_factory=dict)
 
 
@@ -117,10 +127,19 @@ class FleetWarden:
         max_restarts: Heal budget per world; the breaker parks the
             world when a trip arrives with the budget exhausted.
         backoff_base: Cooldown before the n-th heal is
-            ``backoff_base * 2**n`` scheduler steps.
+            ``backoff_base * 2**n`` scheduler steps (the shared
+            :class:`~magicsoup_tpu.guard.backoff.BackoffPolicy` ladder).
         audit_on_heal: Run the graftcheck deep audit on the restored
             world before re-admission (an audit failure walks back is
             NOT attempted — the world parks with the typed reason).
+        max_save_failures: The graceful-degradation budget for cadence
+            checkpoint saves: a failed save (ENOSPC, EIO — the atomic
+            protocol guarantees no torn file) is SKIPPED with a warning
+            + counter and retried next cadence; only this many
+            CONSECUTIVE failures raise the typed
+            :class:`CheckpointError` (``check="degraded"``).  A later
+            successful save resets the ladder and clears the degraded
+            state.
     """
 
     def __init__(
@@ -134,6 +153,7 @@ class FleetWarden:
         max_restarts: int = 3,
         backoff_base: int = 1,
         audit_on_heal: bool = False,
+        max_save_failures: int = 5,
     ):
         if policy not in WARDEN_POLICIES:
             raise GuardConfigError(
@@ -169,6 +189,12 @@ class FleetWarden:
                 variable="scheduler",
                 value=repr(scheduler._warden),
             )
+        if max_save_failures < 1:
+            raise GuardConfigError(
+                "max_save_failures must be >= 1",
+                variable="max_save_failures",
+                value=str(max_save_failures),
+            )
         self.scheduler = scheduler
         self.policy = policy
         self.cadence = int(cadence)
@@ -176,6 +202,13 @@ class FleetWarden:
         self.max_restarts = int(max_restarts)
         self.backoff_base = int(backoff_base)
         self.audit_on_heal = bool(audit_on_heal)
+        self.max_save_failures = int(max_save_failures)
+        # restart-cooldown ladder: delay(n) = backoff_base * 2**(n-1),
+        # the exact schedule the old inline `backoff_base << restarts`
+        # produced, now shared with guard.retry and the serve edge
+        self._restart_backoff = BackoffPolicy(
+            base=float(backoff_base), factor=2.0
+        )
         self._dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
         self._records: list[_WorldRecord] = []
         self._by_lane: dict[int, _WorldRecord] = {}
@@ -414,14 +447,74 @@ class FleetWarden:
                     and rec.stream is not None
                     and step % self.cadence == 0
                 ):
-                    save_run(
-                        rec.stream,
-                        rec.lane.world,
-                        rec.lane,
-                        step=step,
-                        meta={"world": rec.label},
-                    )
+                    try:
+                        save_run(
+                            rec.stream,
+                            rec.lane.world,
+                            rec.lane,
+                            step=step,
+                            meta={"world": rec.label},
+                        )
+                    except OSError as exc:
+                        self._save_failed(rec, step, exc)
+                    else:
+                        self._save_recovered(rec, step)
         self._steps += 1
+
+    # ------------------------------------------------------------ #
+    # cadence-save graceful degradation                            #
+    # ------------------------------------------------------------ #
+
+    def _save_failed(self, rec: _WorldRecord, step: int, exc: OSError) -> None:
+        """One cadence save failed: the run does NOT die.  The skip is
+        counted (record + chaos registry + telemetry row), warned once
+        per degradation episode, and retried next cadence; only
+        ``max_save_failures`` CONSECUTIVE failures escalate to the
+        typed error — at that point data loss is unbounded and silence
+        would be lying."""
+        rec.save_skips += 1
+        consecutive = (
+            rec.stream.consecutive_save_failures if rec.stream else 1
+        )
+        subsystem = f"warden.checkpoint.world-{rec.label:03d}"
+        _chaos.note_degraded(subsystem, f"{type(exc).__name__}: {exc}")
+        _chaos.note_counter("warden_save_skips")
+        self._emit(
+            rec,
+            rec.lane,
+            "save_degraded",
+            step,
+            error=f"{type(exc).__name__}: {exc}",
+            save_skips=rec.save_skips,
+            consecutive=consecutive,
+        )
+        if not rec.save_degraded:
+            rec.save_degraded = True
+            warnings.warn(
+                f"cadence checkpoint save for world {rec.label} failed "
+                f"({exc}); skipped and counted — retrying next cadence "
+                f"(typed error after {self.max_save_failures} consecutive "
+                "failures)"
+            )
+        if consecutive >= self.max_save_failures:
+            raise CheckpointError(
+                f"cadence checkpoint stream for world {rec.label} is "
+                f"degraded: {consecutive} consecutive save failures "
+                f"(last: {exc}) exhausted the budget of "
+                f"{self.max_save_failures}",
+                check="degraded",
+                path=rec.stream.directory if rec.stream else None,
+            ) from exc
+
+    def _save_recovered(self, rec: _WorldRecord, step: int) -> None:
+        if not rec.save_degraded:
+            return
+        rec.save_degraded = False
+        subsystem = f"warden.checkpoint.world-{rec.label:03d}"
+        _chaos.clear_degraded(subsystem)
+        self._emit(
+            rec, rec.lane, "save_recovered", step, save_skips=rec.save_skips
+        )
 
     def _evict(self, rec: _WorldRecord, step: int) -> None:
         lane = rec.lane
@@ -436,8 +529,8 @@ class FleetWarden:
             and rec.restarts < self.max_restarts
         ):
             rec.status = "cooldown"
-            rec.cooldown_until = step + self.backoff_base * (
-                1 << rec.restarts
+            rec.cooldown_until = step + int(
+                self._restart_backoff.delay(rec.restarts + 1)
             )
             self._emit(
                 rec,
@@ -527,6 +620,8 @@ class FleetWarden:
                 last_flags=rec.last_flags,
                 cooldown_until=rec.cooldown_until,
                 reason=rec.reason,
+                save_skips=rec.save_skips,
+                save_degraded=rec.save_degraded,
             )
             for rec in self._records
         ]
@@ -546,6 +641,8 @@ class FleetWarden:
                     last_flags=rec.last_flags,
                     cooldown_until=rec.cooldown_until,
                     reason=rec.reason,
+                    save_skips=rec.save_skips,
+                    save_degraded=rec.save_degraded,
                 )
         raise KeyError(f"warden does not track {lane_or_label!r}")
 

@@ -35,8 +35,10 @@ import hashlib
 import json
 import pickle
 import re
+import warnings
 from pathlib import Path
 
+from magicsoup_tpu.guard import chaos as _chaos
 from magicsoup_tpu.guard.errors import CheckpointError
 from magicsoup_tpu.guard.io import atomic_write_bytes
 
@@ -59,17 +61,30 @@ def _pack(obj, meta: dict | None) -> bytes:
 def write_checkpoint(path, obj, *, meta: dict | None = None) -> Path:
     """Atomically write ``obj`` as a verified checkpoint file."""
     path = Path(path)
-    atomic_write_bytes(path, _pack(obj, meta))
+    atomic_write_bytes(path, _pack(obj, meta), chaos_site="checkpoint.write")
     return path
 
 
 def _read_header(path: Path) -> tuple[dict, bytes]:
     try:
+        fault = _chaos.site("checkpoint.read")
+        if fault is not None:
+            raise fault.as_oserror()
         raw = path.read_bytes()
     except FileNotFoundError:
         raise CheckpointError(
             f"checkpoint {path} does not exist", check="truncated", path=path
         ) from None
+    except OSError as exc:
+        # an EIO/EACCES on the read path is not corruption — surface it
+        # as its own typed check so load_latest's walk-back can count it
+        # separately from bad bytes
+        raise CheckpointError(
+            f"checkpoint {path} failed the io check: could not read the "
+            f"file: {exc}",
+            check="io",
+            path=path,
+        ) from exc
     if not raw.startswith(_MAGIC):
         raise CheckpointError(
             f"checkpoint {path} failed the magic check: not an MSCK file",
@@ -171,6 +186,14 @@ class CheckpointManager:
         self.keep = int(keep)
         self.prefix = prefix
         self._pat = re.compile(rf"^{re.escape(prefix)}-(\d+)\.msck$")
+        # failure accounting — the graceful-degradation contract needs a
+        # manager-level view of "saves have been failing" that wardens
+        # and statuses() can read without string-matching exceptions
+        self.save_failures = 0
+        self.consecutive_save_failures = 0
+        self.delete_failures = 0
+        self.last_save_error: str | None = None
+        self._warned_delete = False
 
     def path_for(self, step: int) -> Path:
         return self.directory / f"{self.prefix}-{int(step):010d}.msck"
@@ -192,24 +215,61 @@ class CheckpointManager:
         return cks[-1][1] if cks else None
 
     def save(self, obj, *, step: int, meta: dict | None = None) -> Path:
-        """Write ``obj`` at ``step`` and prune beyond ``keep``."""
+        """Write ``obj`` at ``step`` and prune beyond ``keep``.
+
+        An ``OSError`` (ENOSPC, EIO, ...) propagates to the caller — the
+        atomic-write protocol guarantees no torn file was left behind —
+        but is COUNTED first (``save_failures`` /
+        ``consecutive_save_failures``), so degradation policies can
+        decide "warn and retry next cadence" vs "give up" without
+        re-deriving history from exceptions.
+        """
         meta = dict(meta or {})
         meta.setdefault("step", int(step))
-        path = write_checkpoint(self.path_for(step), obj, meta=meta)
+        try:
+            path = write_checkpoint(self.path_for(step), obj, meta=meta)
+        except OSError as exc:
+            self.save_failures += 1
+            self.consecutive_save_failures += 1
+            self.last_save_error = f"{type(exc).__name__}: {exc}"
+            _chaos.note_counter("checkpoint_save_failures")
+            raise
+        self.consecutive_save_failures = 0
+        self.last_save_error = None
         self.prune()
         return path
 
     def prune(self) -> list[Path]:
         """Delete all but the newest ``keep`` snapshots; returns the
-        removed paths."""
+        removed paths.  Delete failures no longer vanish: each one bumps
+        ``delete_failures`` and the shared chaos counter (one warning
+        per manager, not per file — retention retries the same victims
+        every save)."""
         removed = []
         for _step, p in self.checkpoints()[: -self.keep or None]:
             try:
                 p.unlink()
-            except OSError:
+            except OSError as exc:
+                self.delete_failures += 1
+                _chaos.note_counter("checkpoint_delete_failures")
+                if not self._warned_delete:
+                    self._warned_delete = True
+                    warnings.warn(
+                        f"checkpoint retention could not delete {p.name}: "
+                        f"{exc} (counted; retried next save)"
+                    )
                 continue
             removed.append(p)
         return removed
+
+    def failure_counters(self) -> dict[str, int]:
+        """The manager's failure accounting as one flat dict (surfaced
+        by warden ``statuses()`` and the serve health snapshot)."""
+        return {
+            "save_failures": self.save_failures,
+            "consecutive_save_failures": self.consecutive_save_failures,
+            "delete_failures": self.delete_failures,
+        }
 
     def load(self, path) -> tuple[object, dict]:
         return read_checkpoint(path)

@@ -18,8 +18,11 @@ class CheckpointError(GuardError):
     Attributes:
         check: Which verification failed — one of ``"magic"``,
             ``"header"``, ``"version"``, ``"truncated"``, ``"digest"``,
-            ``"unpickle"``, ``"config"``, ``"format"``, or ``"none"``
-            (no loadable checkpoint found).
+            ``"unpickle"``, ``"config"``, ``"format"``, ``"io"`` (the
+            file could not be read at the OS level), ``"degraded"``
+            (too many consecutive save failures under a graceful-
+            degradation policy), or ``"none"`` (no loadable checkpoint
+            found).
         path: The offending file, when there is one.
     """
 

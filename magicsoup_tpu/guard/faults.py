@@ -56,7 +56,7 @@ def flip_byte(path, offset: int | None = None, *, rng=None) -> int:
     data[offset] ^= 0xFF
     # deliberately NON-atomic: this simulates on-disk corruption of an
     # already-complete file, not a torn write
-    with open(path, "wb") as fh:  # graftlint: disable=GL010 fault injector corrupts files on purpose
+    with open(path, "wb") as fh:  # graftlint: disable=GL010,GL018 fault injector corrupts files on purpose
         fh.write(data)
         fh.flush()
         os.fsync(fh.fileno())

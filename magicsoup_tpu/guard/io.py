@@ -15,11 +15,21 @@ old bytes or the complete new bytes, never a prefix:
 
 This module is stdlib-only on purpose: ``scripts/summarize_capture.py``
 and other no-jax consumers must be able to import it.
+
+Chaos instrumentation: every atomic write is a fault point of the
+graftchaos plane (``guard.chaos``).  To keep this file loadable as a
+STANDALONE file (the stdlib-pure contract above), the probe is handed
+over by registration — ``guard.chaos`` imports this module and sets
+``_chaos_probe``; nothing here imports the package.  Unarmed (or
+standalone) the probe is ``None`` and a write pays one attribute read.
 """
 from __future__ import annotations
 
 import os
 from pathlib import Path
+
+# set to guard.chaos.site by guard.chaos at import; None = disarmed
+_chaos_probe = None
 
 
 def _fsync_dir(dirpath: Path) -> None:
@@ -35,15 +45,34 @@ def _fsync_dir(dirpath: Path) -> None:
         os.close(fd)
 
 
-def atomic_write_bytes(path, data: bytes) -> None:
+def atomic_write_bytes(path, data: bytes, *, chaos_site: str = "io.write") -> None:
     """Atomically replace ``path`` with ``data`` (see module docstring).
 
     The temp file carries the target's name plus a pid/random suffix so
     concurrent writers never collide; on any failure the temp file is
     removed and the previous ``path`` contents are untouched.
+
+    ``chaos_site`` names this write's fault point in the graftchaos
+    plane (callers with a more specific identity pass their own —
+    ``checkpoint.write``, ``registry.write``); an armed ``enospc``/
+    ``eio`` fault raises the errno-carrying ``OSError`` before any byte
+    lands, and a ``torn`` fault simulates on-disk corruption of the
+    target (a truncated prefix) — the scenario the verified-checkpoint
+    walk-back exists to absorb.
     """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    if _chaos_probe is not None:
+        fault = _chaos_probe(chaos_site)
+        if fault is not None:
+            if fault.kind == "torn":
+                # deliberately NON-atomic truncated write: stands in for
+                # the corruption a non-atomic filesystem (or a flipped
+                # sector) leaves behind; readers must REFUSE these bytes
+                with open(path, "wb") as fh:  # graftlint: disable=GL018 chaos fault injector tears the target on purpose
+                    fh.write(data[: max(1, len(data) // 2)])
+                return
+            raise fault.as_oserror()
     tmp = path.parent / (
         f".{path.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"  # graftlint: disable=GL004 temp-file name uniqueness, not simulation state
     )
@@ -62,6 +91,8 @@ def atomic_write_bytes(path, data: bytes) -> None:
     _fsync_dir(path.parent)
 
 
-def atomic_write_text(path, text: str, encoding: str = "utf-8") -> None:
+def atomic_write_text(
+    path, text: str, encoding: str = "utf-8", *, chaos_site: str = "io.write"
+) -> None:
     """:func:`atomic_write_bytes` for text payloads."""
-    atomic_write_bytes(path, text.encode(encoding))
+    atomic_write_bytes(path, text.encode(encoding), chaos_site=chaos_site)

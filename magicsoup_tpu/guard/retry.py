@@ -16,8 +16,11 @@ bytes as a first-try success, so the bit-identity contract survives.
 """
 from __future__ import annotations
 
+import errno
 import time
 from typing import Callable
+
+from magicsoup_tpu.guard.backoff import BackoffPolicy
 
 # substrings that mark an error as plausibly transient; mirrors the
 # classification performance/bench.py uses for probe failures
@@ -30,10 +33,26 @@ _TRANSIENT_MARKERS = (
     "transport is closing",
 )
 
+# errnos that mean "the storage itself is unusable" — retrying a full
+# disk or a read-only filesystem is a hang with extra steps.  Checked
+# BEFORE the marker scan so a message that happens to contain a marker
+# substring cannot win retries for a dead disk.
+_NON_TRANSIENT_ERRNOS = frozenset(
+    {errno.ENOSPC, errno.EROFS, errno.EDQUOT}
+)
+
 
 def is_transient_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a transient backend/RPC failure
-    worth retrying (vs. a deterministic bug that never will succeed)."""
+    worth retrying (vs. a deterministic bug that never will succeed).
+
+    Errno-carrying ``OSError`` with ENOSPC / EROFS / EDQUOT is
+    explicitly NON-transient: disk-full does not heal inside a retry
+    window, and the graceful-degradation layer (skip + retry next
+    cadence) owns that failure mode instead.
+    """
+    if isinstance(exc, OSError) and exc.errno in _NON_TRANSIENT_ERRNOS:
+        return False
     text = f"{type(exc).__name__}: {exc}"
     return any(marker in text for marker in _TRANSIENT_MARKERS)
 
@@ -50,11 +69,14 @@ def retry_call(
 ):
     """Call ``fn()`` with up to ``retries`` retries on transient errors.
 
-    Delay doubles each attempt from ``base_delay`` up to ``max_delay``.
+    Delay doubles each attempt from ``base_delay`` up to ``max_delay``
+    (the shared :class:`~magicsoup_tpu.guard.backoff.BackoffPolicy`
+    ladder — same schedule the warden and serve edge use).
     Non-transient errors (per ``retry_if``) and the final transient
     failure propagate unchanged.  ``on_retry(attempt, exc)`` fires
     before each sleep; ``sleep`` is injectable so tests stay instant.
     """
+    policy = BackoffPolicy(base=base_delay, factor=2.0, max_delay=max_delay)
     attempt = 0
     while True:
         try:
@@ -65,4 +87,4 @@ def retry_call(
             attempt += 1
             if on_retry is not None:
                 on_retry(attempt, exc)
-            sleep(min(max_delay, base_delay * (2.0 ** (attempt - 1))))
+            policy.sleep(attempt, sleep=sleep)

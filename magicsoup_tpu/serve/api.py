@@ -44,6 +44,8 @@ import json
 import random
 from http.server import BaseHTTPRequestHandler
 
+from magicsoup_tpu.guard import chaos as _chaos
+
 __all__ = [
     "ServeError",
     "build_world",
@@ -77,11 +79,18 @@ _IDENTITY_FIELDS = ("tenant", "seed", "queue", "checkpoint_cadence")
 
 class ServeError(Exception):
     """A request failure with an HTTP status (the handler maps it to a
-    JSON ``{"error": ...}`` response instead of a stack trace)."""
+    JSON ``{"error": ...}`` response instead of a stack trace).
 
-    def __init__(self, status: int, message: str):
+    ``retry_after`` (seconds), when set, becomes a ``Retry-After``
+    response header — backpressure errors (503 queue-full) tell clients
+    WHEN to come back instead of leaving them to guess."""
+
+    def __init__(
+        self, status: int, message: str, *, retry_after: float | None = None
+    ):
         super().__init__(message)
         self.status = int(status)
+        self.retry_after = None if retry_after is None else float(retry_after)
 
 
 def _require(cond: bool, message: str) -> None:
@@ -288,12 +297,27 @@ def make_handler(service):
         def log_message(self, *args):  # quiet: telemetry is the log
             pass
 
-        def _reply(self, status: int, obj) -> None:
+        def _reply(
+            self, status: int, obj, *, retry_after: float | None = None
+        ) -> None:
             blob = (json.dumps(obj) + "\n").encode()
+            fault = _chaos.site("serve.response")
+            if fault is not None and fault.kind == "malformed":
+                # truncated non-JSON body with honest framing: the
+                # client's json parse fails, not its socket read
+                blob = b'{"chaos": malformed' + b"\n"
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(blob)))
+            if retry_after is not None:
+                self.send_header("Retry-After", f"{retry_after:g}")
             self.end_headers()
+            if fault is not None and fault.kind == "drop":
+                # connection drop mid-response: the header promised
+                # len(blob) bytes, the peer gets half and then EOF
+                self.wfile.write(blob[: max(1, len(blob) // 2)])
+                self.close_connection = True
+                return
             self.wfile.write(blob)
 
         def _body(self):
@@ -315,7 +339,11 @@ def make_handler(service):
                     return
                 self._reply(200, service.submit(name, payload))
             except ServeError as exc:
-                self._reply(exc.status, {"error": str(exc)})
+                self._reply(
+                    exc.status,
+                    {"error": str(exc)},
+                    retry_after=exc.retry_after,
+                )
             except Exception as exc:  # graftlint: disable=GL013 delivered to the client as HTTP 500
                 self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
 

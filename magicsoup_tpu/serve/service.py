@@ -39,10 +39,10 @@ was never killed (pinned by ``performance/smoke.py --serve``).
 from __future__ import annotations
 
 import json
-import os
 import queue
-import tempfile
 import threading
+import time
+import warnings
 from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
 from pathlib import Path
@@ -50,6 +50,9 @@ from pathlib import Path
 from magicsoup_tpu.analysis import ownership
 from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.analysis.ownership import owned_by
+from magicsoup_tpu.guard import chaos as _chaos
+from magicsoup_tpu.guard.backoff import BackoffPolicy
+from magicsoup_tpu.guard.io import atomic_write_text
 from magicsoup_tpu.serve import api
 from magicsoup_tpu.serve.accounting import AccountingLedger
 from magicsoup_tpu.serve.admission import AdmissionController
@@ -215,6 +218,13 @@ class FleetService:
         self._fetch_carry = 0
 
         self._commands: queue.Queue[_Command] = queue.Queue(maxsize=64)
+        # queue backpressure: consecutive rejections widen the
+        # Retry-After hint along the shared deterministic ladder
+        self._edge_lock = threading.Lock()
+        self._queue_full_streak = 0
+        self._retry_backoff = BackoffPolicy(base=1.0, factor=2.0, max_delay=8.0)
+        self._registry_degraded = False
+        self._save_degraded: set[str] = set()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._stopped = threading.Event()
@@ -291,7 +301,12 @@ class FleetService:
         self.scheduler.drain()
         for t in sorted(self._tenants.values(), key=lambda t: t.label):
             if t.lane is not None:
-                self._checkpoint_tenant(t)
+                try:
+                    self._checkpoint_tenant(t)
+                except OSError as exc:
+                    # one tenant's dead disk must not block the other
+                    # tenants' final checkpoints or the registry write
+                    self._cadence_save_failed(t, exc)
         self._settle_fetch()
         self._write_registry()
         if self._httpd is not None:
@@ -323,9 +338,39 @@ class FleetService:
             raise api.ServeError(503, "service is stopping")
         cmd = _Command(name, dict(payload or {}))
         try:
-            self._commands.put(cmd, timeout=2.0)
+            fault = _chaos.site("serve.queue")
+            if fault is not None:
+                if fault.kind == "slow":
+                    # a slow consumer: hold the handler thread, then
+                    # enqueue normally — clients see latency, not errors
+                    time.sleep(float(fault.arg or 0.0))
+                else:  # "full"
+                    raise queue.Full
+            self._commands.put_nowait(cmd)
         except queue.Full:
-            raise api.ServeError(503, "command queue is full")
+            # graceful backpressure: fail FAST with a typed 503 and a
+            # Retry-After hint (previously this blocked 2s and then
+            # 503'd with no hint — under sustained pressure handler
+            # threads piled up toward the 504 timeout instead)
+            with self._edge_lock:
+                self._queue_full_streak += 1
+                hint = self._retry_backoff.delay(
+                    min(self._queue_full_streak, 8)
+                )
+            _chaos.note_counter("serve_queue_full")
+            _chaos.note_degraded(
+                "serve.queue", f"command queue full rejecting {name!r}"
+            )
+            raise api.ServeError(
+                503,
+                f"command queue is full; retry {name!r} after "
+                f"{hint:g}s",
+                retry_after=hint,
+            )
+        with self._edge_lock:
+            if self._queue_full_streak:
+                self._queue_full_streak = 0
+                _chaos.clear_degraded("serve.queue")
         self._wake.set()
         if not cmd.done.wait(timeout=self.command_timeout):
             raise api.ServeError(
@@ -387,8 +432,35 @@ class FleetService:
         self._settle_fetch()
         for t in runnable:
             if t.cadence and t.megasteps % t.cadence == 0:
-                self._checkpoint_tenant(t)
+                try:
+                    self._checkpoint_tenant(t)
+                except OSError as exc:
+                    self._cadence_save_failed(t, exc)
+                else:
+                    self._cadence_save_recovered(t)
         self._publish_health()
+
+    def _cadence_save_failed(self, t: _Tenant, exc: OSError) -> None:
+        """A cadence checkpoint failed: the serving loop must keep
+        serving.  The skip is counted (chaos registry + stream
+        counters, both visible via /healthz and the tenant's stream
+        ``failure_counters()``) and retried at the next cadence; an
+        explicit ``POST /tenants/<id>/checkpoint`` still raises to its
+        client."""
+        subsystem = f"serve.checkpoint.{t.tenant}"
+        _chaos.note_counter("serve_save_skips")
+        _chaos.note_degraded(subsystem, f"{type(exc).__name__}: {exc}")
+        if t.tenant not in self._save_degraded:
+            self._save_degraded.add(t.tenant)
+            warnings.warn(
+                f"cadence checkpoint for tenant {t.tenant!r} failed "
+                f"({exc}); skipped and counted — retrying next cadence"
+            )
+
+    def _cadence_save_recovered(self, t: _Tenant) -> None:
+        if t.tenant in self._save_degraded:
+            self._save_degraded.discard(t.tenant)
+            _chaos.clear_degraded(f"serve.checkpoint.{t.tenant}")
 
     def _runnable(self) -> list[_Tenant]:
         """Tenants that will advance this tick: budget left and active
@@ -463,6 +535,10 @@ class FleetService:
             "megasteps": sum(t.megasteps for t in self._tenants.values()),
             "backlog": sum(t.budget for t in self._tenants.values()),
             "worlds": statuses,
+            # per-subsystem graceful-degradation states (telemetry
+            # sinks, checkpoint streams, the registry, the command
+            # queue) — empty when everything is healthy
+            "degraded": _chaos.degraded_states(),
         }
         with self._health_lock:
             self._health = snap
@@ -783,21 +859,33 @@ class FleetService:
             },
             "lost": dict(self._lost),
         }
-        fd, tmp = tempfile.mkstemp(
-            dir=self.dir, prefix=".tenants-", suffix=".json"
-        )
         try:
-            with os.fdopen(fd, "w") as fh:
-                json.dump(doc, fh, indent=1)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, self._registry_path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+            atomic_write_text(
+                self._registry_path,
+                json.dumps(doc, indent=1),
+                chaos_site="registry.write",
+            )
+        except OSError as exc:
+            # degrade, don't die: the registry only matters at the NEXT
+            # restart, and every later registry-changing command (and
+            # the shutdown epilogue) rewrites the whole document — the
+            # failure is counted and visible in /healthz until a write
+            # lands
+            _chaos.note_counter("registry_write_failures")
+            _chaos.note_degraded(
+                "serve.registry", f"{type(exc).__name__}: {exc}"
+            )
+            if not self._registry_degraded:
+                self._registry_degraded = True
+                warnings.warn(
+                    f"tenant registry write to {self._registry_path} "
+                    f"failed ({exc}); counted and retried at the next "
+                    "registry update"
+                )
+            return
+        if self._registry_degraded:
+            self._registry_degraded = False
+            _chaos.clear_degraded("serve.registry")
 
     def _recover(self) -> None:
         """Re-adopt every registered tenant from its rolling stream

@@ -56,6 +56,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from magicsoup_tpu.analysis.ownership import owned_by
+from magicsoup_tpu.guard import chaos as _chaos
 from magicsoup_tpu.native import engine as _engine
 from magicsoup_tpu.ops import detmath as _detmath
 from magicsoup_tpu.ops import diffusion as _diff
@@ -1583,6 +1584,14 @@ class PipelinedStepper:
                 from magicsoup_tpu.guard.faults import consume_dispatch_fault
 
                 consume_dispatch_fault(self)
+            fault = _chaos.site("dispatch")
+            if fault is not None:
+                from magicsoup_tpu.guard.errors import TransientDispatchError
+
+                raise TransientDispatchError(
+                    "injected fault: UNAVAILABLE: chaos dispatch fault "
+                    f"#{fault.index}"
+                )
             return step_fn(
                 self._state,
                 self.kin.params,
@@ -2039,6 +2048,21 @@ class PipelinedStepper:
         # budget makes a dead worker or wedged tunnel surface as stack
         # dumps + a typed error instead of a silent hang
         try:
+            fault = _chaos.site("fetch")
+            if fault is not None:
+                # a chaos "delay" stands in for a wedged transfer: hold
+                # the fetch for the injected duration, capped at the
+                # watchdog budget — a delay past the budget surfaces the
+                # same TimeoutError the real result() raises, so the
+                # diagnostics + typed-error path below is the production
+                # path under test; a shorter delay is just a slow fetch
+                delay = float(fault.arg or 0.0)
+                _time.sleep(min(delay, self._fetch_timeout))
+                if delay >= self._fetch_timeout:
+                    raise TimeoutError(
+                        f"chaos-injected fetch delay of {delay}s tripped "
+                        f"the {self._fetch_timeout}s watchdog"
+                    )
             arr = np.atleast_2d(
                 np.asarray(pend.out.result(timeout=self._fetch_timeout))
             )

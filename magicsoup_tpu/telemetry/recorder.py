@@ -37,9 +37,11 @@ import dataclasses
 import json
 import threading
 import time
+import warnings
 import weakref
 
 from magicsoup_tpu.analysis import ownership
+from magicsoup_tpu.guard import chaos as _chaos
 from magicsoup_tpu.telemetry.summary import percentile
 
 # per-phase sample rings are trimmed at this size (same bound as the
@@ -104,7 +106,7 @@ def _close_handle(fh, buffered: list[str], lock) -> None:
             if buffered:
                 fh.write("\n".join(buffered) + "\n")
             fh.close()
-    except Exception:
+    except Exception:  # graftlint: disable=GL013 gc-time finalizer; nothing above it can react
         pass
 
 
@@ -129,6 +131,18 @@ class TelemetryRecorder:
         self.path: str | None = None
         self.flush_every = max(1, int(flush_every))
         self.rows_emitted = 0
+        # graceful degradation: an I/O failure on the sink disarms the
+        # stream into this COUNTED state instead of raising through (or
+        # silently losing) a simulation step
+        self.degraded = False
+        self.degraded_reason: str | None = None
+        self.rows_dropped = 0
+        # chaos/degraded transitions are PULLED from guard.chaos's event
+        # ring at counter-emit boundaries (push would deadlock: the
+        # telemetry.emit fault fires inside our own flush).  Start the
+        # cursor at "now" so this stream only carries transitions from
+        # its own lifetime.
+        self._chaos_cursor = _chaos.events_since(0)[0]
         if path is not None:
             self.attach(path)
 
@@ -160,6 +174,12 @@ class TelemetryRecorder:
                 )
             self.path = str(path)
             self._fh = open(self.path, "a", encoding="utf-8")
+            if self.degraded:
+                # an explicit re-attach is the recovery path out of the
+                # degraded state (rows_dropped stays — it is history)
+                self.degraded = False
+                self.degraded_reason = None
+                _chaos.clear_degraded("telemetry.emit")
             self._finalizer = weakref.finalize(
                 self, _close_handle, self._fh, self._buffer, self._lock
             )
@@ -268,10 +288,15 @@ class TelemetryRecorder:
         """Buffer one JSONL row (no-op when detached); auto-flushes
         every ``flush_every`` rows."""
         if self._fh is None:
+            if self.degraded:
+                with self._lock:
+                    self.rows_dropped += 1
             return
         line = json.dumps(row, separators=(",", ":"))
         with self._lock:
             if self._fh is None:
+                if self.degraded:
+                    self.rows_dropped += 1
                 return
             self._buffer.append(line)
             self.rows_emitted += 1
@@ -280,9 +305,15 @@ class TelemetryRecorder:
 
     def emit_counters(self) -> None:
         """Emit a ``counters`` row (attach/flush boundaries call this,
-        giving the summarizer first/last values for delta reporting)."""
+        giving the summarizer first/last values for delta reporting),
+        preceded by any ``chaos``/``degraded`` transition rows recorded
+        since the last drain."""
         if self._fh is None:
             return
+        cursor, events = _chaos.events_since(self._chaos_cursor)
+        self._chaos_cursor = cursor
+        for row in events:
+            self.emit(row)
         self.emit({"type": "counters", "counters": runtime_counters()})
 
     def flush(self, sync: bool = False) -> None:
@@ -305,17 +336,57 @@ class TelemetryRecorder:
 
                 try:
                     os.fsync(self._fh.fileno())
-                except (OSError, ValueError):
+                except ValueError:
                     # not a real file (tests pass StringIO) or already
-                    # closed — durability is best-effort on teardown
+                    # closed — durability is best-effort on teardown.
+                    # io.UnsupportedOperation subclasses ValueError, so
+                    # this arm keeps absorbing the StringIO case while a
+                    # REAL fsync failure falls through to degrade below
                     pass
+                except OSError as exc:
+                    self._degrade_locked(exc)
 
     def _flush_locked(self) -> None:
         if self._fh is None or not self._buffer:
             return
-        self._fh.write("\n".join(self._buffer) + "\n")
+        try:
+            fault = _chaos.site("telemetry.emit")
+            if fault is not None:
+                raise fault.as_oserror()
+            self._fh.write("\n".join(self._buffer) + "\n")
+            self._buffer.clear()
+            self._fh.flush()
+        except OSError as exc:
+            self._degrade_locked(exc)
+
+    def _degrade_locked(self, exc: OSError) -> None:
+        # the telemetry degradation contract: a failed sink NEVER raises
+        # into the stepper's dispatch loop and NEVER silently vanishes —
+        # the stream disarms, the loss is counted (here + the process-
+        # wide chaos registry), and exactly one warning names the cause
+        dropped = len(self._buffer)
         self._buffer.clear()
-        self._fh.flush()
+        fh, self._fh = self._fh, None
+        if self._finalizer is not None:
+            self._finalizer.detach()
+            self._finalizer = None
+        self.degraded = True
+        self.degraded_reason = f"{type(exc).__name__}: {exc}"
+        self.rows_dropped += dropped
+        if dropped:
+            _chaos.note_counter("telemetry_rows_dropped", dropped)
+        _chaos.note_degraded("telemetry.emit", self.degraded_reason)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass  # graftlint: disable=GL013 sink is already dead; close failure adds nothing
+        warnings.warn(
+            f"telemetry stream to {self.path} degraded after an I/O "
+            f"failure ({self.degraded_reason}); {dropped} buffered rows "
+            "dropped, further rows are counted and discarded until "
+            "re-attach"
+        )
 
     # ------------------------------------------------------- snapshot
     def snapshot(self) -> TelemetrySnapshot:

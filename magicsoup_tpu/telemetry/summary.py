@@ -238,10 +238,28 @@ def validate_rows(rows: list[dict]) -> list[str]:
                     )
         elif kind == "warden":
             # graftwarden world-level event (quarantine / heal /
-            # heal_failed / circuit_break — fleet.warden.FleetWarden)
+            # heal_failed / circuit_break / save_degraded /
+            # save_recovered — fleet.warden.FleetWarden)
             if not isinstance(row.get("event"), str) or "step" not in row:
                 problems.append(
                     f"{where}: warden row missing 'event'/'step'"
+                )
+        elif kind == "chaos":
+            # graftchaos fault firing (guard.chaos.site) — drained from
+            # the chaos event ring at counter-emit boundaries
+            if not isinstance(row.get("site"), str) or not isinstance(
+                row.get("kind"), str
+            ):
+                problems.append(f"{where}: chaos row missing 'site'/'kind'")
+        elif kind == "degraded":
+            # graceful-degradation transition (guard.chaos.note_degraded
+            # / clear_degraded)
+            if not isinstance(row.get("subsystem"), str) or row.get(
+                "state"
+            ) not in ("degraded", "recovered"):
+                problems.append(
+                    f"{where}: degraded row needs 'subsystem' and a"
+                    " 'state' of degraded|recovered"
                 )
         elif kind != "meta":
             problems.append(f"{where}: unknown row type {kind!r}")

@@ -1,0 +1,822 @@
+"""
+graftchaos campaign matrix: enumerate fault-point cells, isolate each in
+a timeout-bounded child process, and assert the tri-state robustness
+contract per cell:
+
+- **recovered** — the run completes and its state digest is
+  BIT-identical to the same schedule with chaos disarmed,
+- **degraded** — the run completes in a NAMED degraded state with the
+  expected counters (``guard.chaos`` registry + subsystem counters),
+- **raised** — the run stops with the expected TYPED error
+  (``CheckpointError(check=...)``, ``TransientDispatchError``,
+  ``WatchdogTimeout``, ``ServeError``),
+
+and never a hang, crash, or silent corruption — the child is killed at
+its timeout and an unexpected traceback fails the cell.
+
+    python performance/chaos_matrix.py            # full matrix
+    python performance/chaos_matrix.py --gate     # reduced GATING subset
+    python performance/chaos_matrix.py --list
+    python performance/chaos_matrix.py --only ckpt_torn,dispatch_recovers
+    python performance/chaos_matrix.py --out matrix.json
+
+Each cell is one ``--cell NAME`` child armed via the ``MAGICSOUP_CHAOS``
+environment variable (the same spec grammar production arms with);
+digest cells additionally run a disarmed baseline child and compare.
+The final stdout line is the JSON matrix; exit is nonzero if any cell
+misses its contract.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+# ----------------------------------------------------------------- #
+# shared tiny workload (children only — imports stay lazy)          #
+# ----------------------------------------------------------------- #
+
+def _tiny_world(seed: int = 7):
+    import random
+
+    import magicsoup_tpu as ms
+
+    mols = [
+        ms.Molecule("cmx-a", 10e3),
+        ms.Molecule("cmx-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(seed)
+    world = ms.World(chemistry=chem, map_size=8, seed=seed)
+    world.deterministic = True
+    world.spawn_cells([ms.random_genome(s=80, rng=rng) for _ in range(6)])
+    return world
+
+
+def _tiny_stepper(world, **overrides):
+    import magicsoup_tpu as ms
+
+    kw = dict(
+        mol_name="cmx-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=80,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=2,
+    )
+    kw.update(overrides)
+    return ms.PipelinedStepper(world, **kw)
+
+
+def _digest(world, st) -> str:
+    # the canonical field-per-field digest the chaos smoke pins
+    # bit-identity with (performance/smoke.py) — import, don't re-derive
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_cmx_smoke", Path(__file__).resolve().parent / "smoke.py"
+    )
+    smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+    return smoke._chaos_digest(world, st)
+
+
+def _tenant_spec(name: str, seed: int = 5) -> dict:
+    return {
+        "tenant": name,
+        "seed": seed,
+        "map_size": 8,
+        "n_cells": 4,
+        "genome_size": 60,
+        "deterministic": True,
+        "chemistry": {
+            "molecules": [
+                {"name": "cmx-a", "energy": 10000.0},
+                {"name": "cmx-atp", "energy": 8000.0, "half_life": 100000},
+            ],
+            "reactions": [[["cmx-a"], ["cmx-atp"]]],
+        },
+        "stepper": {"mol_name": "cmx-atp", "megastep": 2},
+    }
+
+
+def _chaos_evidence() -> dict:
+    from magicsoup_tpu.guard import chaos
+
+    return {
+        "fired": chaos.fired_counts(),
+        "counters": chaos.counters(),
+        "degraded": chaos.degraded_states(),
+    }
+
+
+# ----------------------------------------------------------------- #
+# cell scenarios (run inside the child; MAGICSOUP_CHAOS pre-armed)  #
+# ----------------------------------------------------------------- #
+
+def cell_ckpt_enospc_solo(tmp: Path) -> dict:
+    """One ENOSPC on a cadence save: counted, the NEXT save lands, and
+    no torn .msck is left behind."""
+    from magicsoup_tpu.guard import CheckpointManager
+
+    mgr = CheckpointManager(tmp / "ckpt", keep=3)
+    try:
+        mgr.save({"step": 1}, step=1)
+    except OSError as exc:
+        first_errno = exc.errno
+    else:
+        return {"state": "completed", "note": "first save unexpectedly ok"}
+    mgr.save({"step": 2}, step=2)
+    payload, _meta, path = mgr.load_latest()
+    return {
+        "state": "degraded",
+        "first_errno": first_errno,
+        "manager": mgr.failure_counters(),
+        "loaded_step": payload["step"],
+        "files": sorted(p.name for p in (tmp / "ckpt").iterdir()),
+        **_chaos_evidence(),
+    }
+
+
+def cell_ckpt_torn(tmp: Path) -> dict:
+    """A torn (half-written) newest checkpoint: load_latest rejects it
+    on the digest check and walks back to the previous snapshot."""
+    from magicsoup_tpu.guard import CheckpointManager
+
+    mgr = CheckpointManager(tmp / "ckpt", keep=3)
+    mgr.save({"v": 1}, step=1)
+    mgr.save({"v": 2}, step=2)  # chaos tears this write
+    payload, _meta, path = mgr.load_latest()
+    return {
+        "state": "recovered",
+        "loaded_v": payload["v"],
+        "loaded_name": path.name,
+        **_chaos_evidence(),
+    }
+
+
+def cell_ckpt_read_eio(tmp: Path) -> dict:
+    """An EIO on the checkpoint READ path surfaces as the typed
+    ``CheckpointError(check="io")``, distinct from corruption."""
+    from magicsoup_tpu.guard import CheckpointError, CheckpointManager
+    from magicsoup_tpu.guard.checkpoint import read_checkpoint
+
+    mgr = CheckpointManager(tmp / "ckpt", keep=3)
+    path = mgr.save({"v": 1}, step=1)
+    try:
+        read_checkpoint(path)
+    except CheckpointError as exc:
+        return {
+            "state": "raised",
+            "error": type(exc).__name__,
+            "check": exc.check,
+            **_chaos_evidence(),
+        }
+    return {"state": "completed", "note": "read unexpectedly ok"}
+
+
+def cell_warden_save_enospc(tmp: Path) -> dict:
+    """ENOSPC on ONE warden cadence save: the fleet keeps stepping, the
+    skip is counted in statuses(), and the next successful save clears
+    the degraded episode."""
+    from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+
+    sch = FleetScheduler(block=4)
+    for i in range(2):
+        sch.admit(_tiny_world(10 + i), **_tiny_kw())
+    warden = FleetWarden(
+        sch, policy="warn", checkpoint_dir=tmp / "streams", cadence=2, keep=2
+    )
+    for _ in range(6):
+        sch.step()
+    sch.flush()
+    statuses = [
+        {
+            "label": s.label,
+            "status": s.status,
+            "save_skips": s.save_skips,
+            "save_degraded": s.save_degraded,
+        }
+        for s in warden.statuses()
+    ]
+    return {
+        "state": "degraded",
+        "steps": 6,
+        "statuses": statuses,
+        **_chaos_evidence(),
+    }
+
+
+def cell_warden_save_exhausted(tmp: Path) -> dict:
+    """Every cadence save fails: after ``max_save_failures`` consecutive
+    failures the warden stops absorbing and raises the typed
+    ``CheckpointError(check="degraded")``."""
+    from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+    from magicsoup_tpu.guard import CheckpointError
+
+    sch = FleetScheduler(block=4)
+    sch.admit(_tiny_world(10), **_tiny_kw())
+    FleetWarden(
+        sch,
+        policy="warn",
+        checkpoint_dir=tmp / "streams",
+        cadence=1,
+        keep=2,
+        max_save_failures=2,
+    )
+    try:
+        for _ in range(8):
+            sch.step()
+    except CheckpointError as exc:
+        return {
+            "state": "raised",
+            "error": type(exc).__name__,
+            "check": exc.check,
+            **_chaos_evidence(),
+        }
+    return {"state": "completed", "note": "no typed error after 8 steps"}
+
+
+def _tiny_kw(**overrides) -> dict:
+    kw = dict(
+        mol_name="cmx-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=80,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=2,
+    )
+    kw.update(overrides)
+    return kw
+
+
+def cell_dispatch_recovers(tmp: Path) -> dict:
+    """One transient dispatch fault inside the retry budget: absorbed,
+    and the trajectory stays bit-identical to the unfaulted run."""
+    world = _tiny_world()
+    st = _tiny_stepper(world, dispatch_retries=2)
+    for _ in range(4):
+        st.step()
+    st.flush()
+    return {
+        "state": "recovered",
+        "digest": _digest(world, st),
+        "dispatch_retries": st.stats["dispatch_retries"],
+        **_chaos_evidence(),
+    }
+
+
+def cell_dispatch_exhausted(tmp: Path) -> dict:
+    """Transient faults beyond the retry budget: the typed
+    ``TransientDispatchError`` propagates after bounded retries."""
+    from magicsoup_tpu.guard.errors import TransientDispatchError
+
+    world = _tiny_world()
+    st = _tiny_stepper(world, dispatch_retries=1)
+    try:
+        for _ in range(4):
+            st.step()
+        st.flush()
+    except TransientDispatchError as exc:
+        return {
+            "state": "raised",
+            "error": type(exc).__name__,
+            "retries": st.stats["dispatch_retries"],
+            **_chaos_evidence(),
+        }
+    return {"state": "completed", "note": "retries absorbed every fault"}
+
+
+def cell_fetch_watchdog(tmp: Path) -> dict:
+    """An injected fetch delay past the watchdog budget: the typed
+    ``WatchdogTimeout`` fires instead of a silent hang."""
+    from magicsoup_tpu.guard import WatchdogTimeout
+
+    world = _tiny_world()
+    st = _tiny_stepper(world, fetch_timeout=0.2)
+    try:
+        for _ in range(4):
+            st.step()
+        st.flush()
+    except WatchdogTimeout as exc:
+        return {
+            "state": "raised",
+            "error": type(exc).__name__,
+            **_chaos_evidence(),
+        }
+    return {"state": "completed", "note": "watchdog never fired"}
+
+
+def cell_telemetry_eio(tmp: Path) -> dict:
+    """An EIO on the telemetry sink: the stream degrades (counted, one
+    warning), the run completes, and the trajectory stays bit-identical
+    to the healthy-sink run."""
+    import warnings
+
+    world = _tiny_world()
+    rec = world.telemetry
+    rec.flush_every = 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rec.attach(tmp / "t.jsonl")
+        st = _tiny_stepper(world)
+        for _ in range(4):
+            st.step()
+        st.flush()
+    return {
+        "state": "degraded",
+        "digest": _digest(world, st),
+        "recorder": {
+            "degraded": rec.degraded,
+            "reason": rec.degraded_reason,
+            "rows_dropped": rec.rows_dropped,
+        },
+        **_chaos_evidence(),
+    }
+
+
+def _service(tmp: Path):
+    from magicsoup_tpu.serve.service import FleetService
+
+    return FleetService(
+        tmp / "serve", port=0, command_timeout=30.0, idle_wait=0.01
+    ).start()
+
+
+def cell_registry_enospc(tmp: Path) -> dict:
+    """ENOSPC on the tenant-registry write: the command still succeeds,
+    the failure is counted + degraded, and the next registry write
+    clears the state."""
+    import warnings
+
+    from magicsoup_tpu.guard import chaos
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc = _service(tmp)
+        try:
+            first = svc.submit("create", _tenant_spec("reg-a"))
+            degraded_mid = chaos.degraded_states()
+            second = svc.submit("create", _tenant_spec("reg-b", seed=6))
+            degraded_after = chaos.degraded_states()
+        finally:
+            svc.stop()
+    return {
+        "state": "degraded",
+        "created": [first.get("tenant"), second.get("tenant")],
+        "degraded_mid": degraded_mid,
+        "degraded_after_keys": sorted(degraded_after),
+        **_chaos_evidence(),
+    }
+
+
+def cell_serve_queue_full(tmp: Path) -> dict:
+    """A full command queue: 503 + Retry-After backpressure instead of
+    a hang into the 504 timeout; the next submit succeeds."""
+    from magicsoup_tpu.serve.api import ServeError
+
+    svc = _service(tmp)
+    try:
+        try:
+            svc.submit("list", {})
+        except ServeError as exc:
+            first = {
+                "status": exc.status,
+                "retry_after": exc.retry_after,
+                "message": str(exc),
+            }
+        else:
+            return {"state": "completed", "note": "queue never rejected"}
+        second = svc.submit("list", {})
+    finally:
+        svc.stop()
+    return {
+        "state": "degraded",
+        "first": first,
+        "second_ok": isinstance(second, dict),
+        **_chaos_evidence(),
+    }
+
+
+def cell_serve_queue_slow(tmp: Path) -> dict:
+    """A slow (but not full) queue: every command still completes —
+    injected latency must not break the command contract."""
+    svc = _service(tmp)
+    try:
+        results = [svc.submit("list", {}) for _ in range(3)]
+    finally:
+        svc.stop()
+    return {
+        "state": "recovered",
+        "all_ok": all(isinstance(r, dict) for r in results),
+        **_chaos_evidence(),
+    }
+
+
+def _http_get(port: int, path: str) -> dict:
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        try:
+            parsed = json.loads(body)
+            return {"status": resp.status, "json": True, "keys": sorted(parsed)[:4]}
+        except json.JSONDecodeError as exc:
+            return {"status": resp.status, "json": False, "parse_error": str(exc)}
+    except Exception as exc:  # noqa: BLE001 - the failure IS the evidence
+        return {"error": type(exc).__name__}
+    finally:
+        conn.close()
+
+
+def cell_serve_response_drop(tmp: Path) -> dict:
+    """A connection dropped mid-response: the client sees a short read,
+    the server keeps serving the next request."""
+    svc = _service(tmp)
+    try:
+        first = _http_get(svc.port, "/healthz")
+        second = _http_get(svc.port, "/healthz")
+    finally:
+        svc.stop()
+    return {
+        "state": "recovered",
+        "first": first,
+        "second": second,
+        **_chaos_evidence(),
+    }
+
+
+def cell_serve_response_malformed(tmp: Path) -> dict:
+    """A malformed (non-JSON) response body: the client's parse fails
+    once, the next request is well-formed again."""
+    svc = _service(tmp)
+    try:
+        first = _http_get(svc.port, "/healthz")
+        second = _http_get(svc.port, "/healthz")
+    finally:
+        svc.stop()
+    return {
+        "state": "recovered",
+        "first": first,
+        "second": second,
+        **_chaos_evidence(),
+    }
+
+
+# ----------------------------------------------------------------- #
+# contract verification (parent side)                               #
+# ----------------------------------------------------------------- #
+
+def _v_ckpt_enospc(out, base):
+    p = []
+    if out.get("first_errno") != 28:
+        p.append(f"expected ENOSPC (28), got errno {out.get('first_errno')}")
+    mgr = out.get("manager", {})
+    if mgr.get("save_failures") != 1 or mgr.get("consecutive_save_failures") != 0:
+        p.append(f"manager counters off: {mgr}")
+    if out.get("loaded_step") != 2:
+        p.append("later save did not become the loadable latest")
+    if any(n.startswith(".") for n in out.get("files", [])):
+        p.append(f"temp leftovers: {out['files']}")
+    if out.get("counters", {}).get("checkpoint_save_failures", 0) < 1:
+        p.append("chaos registry missed checkpoint_save_failures")
+    return p
+
+
+def _v_ckpt_torn(out, base):
+    p = []
+    if out.get("loaded_v") != 1:
+        p.append(f"walk-back loaded v={out.get('loaded_v')}, wanted 1")
+    if out.get("fired", {}).get("checkpoint.write", 0) != 1:
+        p.append("torn fault did not fire exactly once")
+    return p
+
+
+def _v_typed(error, check=None):
+    def verify(out, base):
+        p = []
+        if out.get("error") != error:
+            p.append(f"expected {error}, got {out.get('error')}")
+        if check is not None and out.get("check") != check:
+            p.append(f"expected check={check!r}, got {out.get('check')!r}")
+        return p
+
+    return verify
+
+
+def _v_warden_enospc(out, base):
+    p = []
+    skips = sum(s["save_skips"] for s in out.get("statuses", []))
+    if skips < 1:
+        p.append("no save_skips counted in statuses()")
+    if any(s["save_degraded"] for s in out.get("statuses", [])):
+        p.append("a stream is still marked degraded after a later success")
+    if any(s["status"] != "active" for s in out.get("statuses", [])):
+        p.append("a world stopped stepping")
+    if out.get("counters", {}).get("warden_save_skips", 0) < 1:
+        p.append("chaos registry missed warden_save_skips")
+    return p
+
+
+def _v_digest_equal(out, base):
+    p = []
+    if base is None or "digest" not in base:
+        p.append("baseline digest missing")
+    elif out.get("digest") != base["digest"]:
+        p.append("digest differs from the chaos-disarmed baseline")
+    return p
+
+
+def _v_dispatch_recovers(out, base):
+    p = _v_digest_equal(out, base)
+    if out.get("dispatch_retries", 0) < 1:
+        p.append("retry path never engaged")
+    return p
+
+
+def _v_telemetry(out, base):
+    p = _v_digest_equal(out, base)
+    rec = out.get("recorder", {})
+    if not rec.get("degraded"):
+        p.append("recorder did not degrade")
+    if rec.get("rows_dropped", 0) < 1:
+        p.append("dropped rows were not counted")
+    if "telemetry.emit" not in out.get("degraded", {}):
+        p.append("degraded registry missing telemetry.emit")
+    return p
+
+
+def _v_registry(out, base):
+    p = []
+    if out.get("created") != ["reg-a", "reg-b"]:
+        p.append(f"tenant creation failed: {out.get('created')}")
+    if "serve.registry" not in out.get("degraded_mid", {}):
+        p.append("registry failure not in degraded states")
+    if "serve.registry" in out.get("degraded_after_keys", []):
+        p.append("registry degraded state not cleared by the next write")
+    if out.get("counters", {}).get("registry_write_failures", 0) < 1:
+        p.append("chaos registry missed registry_write_failures")
+    return p
+
+
+def _v_queue_full(out, base):
+    p = []
+    first = out.get("first", {})
+    if first.get("status") != 503:
+        p.append(f"expected 503, got {first.get('status')}")
+    if not first.get("retry_after"):
+        p.append("503 carried no Retry-After hint")
+    if not out.get("second_ok"):
+        p.append("queue did not recover for the next command")
+    if out.get("counters", {}).get("serve_queue_full", 0) < 1:
+        p.append("chaos registry missed serve_queue_full")
+    return p
+
+
+def _v_queue_slow(out, base):
+    p = []
+    if not out.get("all_ok"):
+        p.append("a slowed command failed outright")
+    if out.get("fired", {}).get("serve.queue", 0) < 3:
+        p.append("slow fault did not fire per command")
+    return p
+
+
+def _v_response_drop(out, base):
+    p = []
+    if "error" not in out.get("first", {}):
+        p.append(f"client saw no failure on the dropped response: {out.get('first')}")
+    if out.get("second", {}).get("status") != 200:
+        p.append("service did not keep serving after the drop")
+    return p
+
+
+def _v_response_malformed(out, base):
+    p = []
+    if out.get("first", {}).get("json") is not False:
+        p.append(f"first body unexpectedly parsed: {out.get('first')}")
+    if out.get("second", {}).get("json") is not True:
+        p.append("second body did not recover to valid JSON")
+    return p
+
+
+#: the campaign: name -> (spec, expected contract state, verifier,
+#: needs-baseline, gate-subset membership)
+CELLS: dict[str, dict] = {
+    "ckpt_enospc_solo": dict(
+        spec="checkpoint.write:enospc@1x1", expect="degraded",
+        verify=_v_ckpt_enospc, gate=True,
+    ),
+    "ckpt_torn": dict(
+        spec="checkpoint.write:torn@2x1", expect="recovered",
+        verify=_v_ckpt_torn, gate=True,
+    ),
+    "ckpt_read_eio": dict(
+        spec="checkpoint.read:eio@1x1", expect="raised",
+        verify=_v_typed("CheckpointError", check="io"), gate=True,
+    ),
+    "warden_save_enospc": dict(
+        spec="checkpoint.write:enospc@1x1", expect="degraded",
+        verify=_v_warden_enospc,
+    ),
+    "warden_save_exhausted": dict(
+        spec="checkpoint.write:enospc@1x0", expect="raised",
+        verify=_v_typed("CheckpointError", check="degraded"),
+    ),
+    "dispatch_recovers": dict(
+        spec="dispatch:transient@2x1", expect="recovered",
+        verify=_v_dispatch_recovers, baseline=True,
+    ),
+    "dispatch_exhausted": dict(
+        spec="dispatch:transient@1x0", expect="raised",
+        verify=_v_typed("TransientDispatchError"),
+    ),
+    "fetch_watchdog": dict(
+        spec="fetch:delay:1.0@1x1", expect="raised",
+        verify=_v_typed("WatchdogTimeout"),
+    ),
+    "telemetry_eio": dict(
+        spec="telemetry.emit:eio@1x1", expect="degraded",
+        verify=_v_telemetry, baseline=True,
+    ),
+    "registry_enospc": dict(
+        spec="registry.write:enospc@1x1", expect="degraded",
+        verify=_v_registry,
+    ),
+    "serve_queue_full": dict(
+        spec="serve.queue:full@1x1", expect="degraded",
+        verify=_v_queue_full, gate=True,
+    ),
+    "serve_queue_slow": dict(
+        spec="serve.queue:slow:0.05@1x0", expect="recovered",
+        verify=_v_queue_slow,
+    ),
+    "serve_response_drop": dict(
+        spec="serve.response:drop@1x1", expect="recovered",
+        verify=_v_response_drop,
+    ),
+    "serve_response_malformed": dict(
+        spec="serve.response:malformed@1x1", expect="recovered",
+        verify=_v_response_malformed,
+    ),
+}
+
+
+# ----------------------------------------------------------------- #
+# child / parent drivers                                            #
+# ----------------------------------------------------------------- #
+
+def run_cell_child(name: str) -> None:
+    fn = globals()[f"cell_{name}"]
+    with tempfile.TemporaryDirectory(prefix=f"cmx-{name}-") as tmp:
+        try:
+            outcome = fn(Path(tmp))
+        except Exception as exc:  # noqa: BLE001 - reported to the parent as a contract miss
+            import traceback
+
+            outcome = {
+                "state": "crashed",
+                "error": type(exc).__name__,
+                "detail": str(exc),
+                "trace": traceback.format_exc(limit=6),
+            }
+    print(json.dumps({"cell": name, "outcome": outcome}))
+
+
+def _spawn(name: str, spec: str | None, timeout: float) -> dict:
+    env = dict(os.environ)
+    env.pop("MAGICSOUP_CHAOS", None)
+    if spec:
+        env["MAGICSOUP_CHAOS"] = spec
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    try:
+        res = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--cell", name],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"state": "hung", "seconds": round(time.monotonic() - t0, 1)}
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    try:
+        payload = json.loads(lines[-1])
+        outcome = payload["outcome"]
+    except (IndexError, ValueError, KeyError):
+        outcome = {
+            "state": "crashed",
+            "error": "unparseable child output",
+            "stderr": res.stderr[-2000:],
+        }
+    outcome["seconds"] = round(time.monotonic() - t0, 1)
+    return outcome
+
+
+def run_matrix(names: list[str], timeout: float) -> dict:
+    rows = []
+    for name in names:
+        cell = CELLS[name]
+        baseline = None
+        if cell.get("baseline"):
+            baseline = _spawn(name, None, timeout)
+        outcome = _spawn(name, cell["spec"], timeout)
+        problems = []
+        if outcome.get("state") != cell["expect"]:
+            problems.append(
+                f"terminal state {outcome.get('state')!r} != expected "
+                f"{cell['expect']!r}"
+            )
+            if outcome.get("state") in ("crashed", "hung"):
+                problems.append(json.dumps(outcome)[:400])
+        else:
+            problems.extend(cell["verify"](outcome, baseline))
+        rows.append(
+            {
+                "cell": name,
+                "spec": cell["spec"],
+                "expect": cell["expect"],
+                "state": outcome.get("state"),
+                "ok": not problems,
+                "problems": problems,
+                "seconds": outcome.get("seconds"),
+            }
+        )
+        status = "ok" if not problems else "FAIL"
+        print(
+            f"[chaos-matrix] {name:<26} {cell['spec']:<34} "
+            f"-> {outcome.get('state'):<10} {status}",
+            file=sys.stderr,
+        )
+        for prob in problems:
+            print(f"[chaos-matrix]   - {prob}", file=sys.stderr)
+    return {
+        "format": "magicsoup_tpu.chaos_matrix/1",
+        "cells": rows,
+        "passed": sum(r["ok"] for r in rows),
+        "failed": sum(not r["ok"] for r in rows),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cell", help=argparse.SUPPRESS)
+    ap.add_argument("--gate", action="store_true",
+                    help="run only the fast GATING subset")
+    ap.add_argument("--only", default="",
+                    help="comma-separated cell names")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-child wall-clock bound (seconds)")
+    ap.add_argument("--out", default="", help="also write the matrix here")
+    args = ap.parse_args()
+
+    if args.cell:
+        run_cell_child(args.cell)
+        return
+    if args.list:
+        for name, cell in CELLS.items():
+            gate = " [gate]" if cell.get("gate") else ""
+            print(f"{name:<26} {cell['spec']:<34} -> {cell['expect']}{gate}")
+        return
+    if args.only:
+        names = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = [n for n in names if n not in CELLS]
+        if unknown:
+            raise SystemExit(f"unknown cell(s): {', '.join(unknown)}")
+    elif args.gate:
+        names = [n for n, c in CELLS.items() if c.get("gate")]
+    else:
+        names = list(CELLS)
+
+    matrix = run_matrix(names, args.timeout)
+    blob = json.dumps(matrix, indent=1)
+    if args.out:
+        Path(args.out).write_text(blob)
+    print(blob)
+    if matrix["failed"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,3 +79,12 @@ python performance/smoke.py --differential
 # and finish the schedule with digests BIT-identical to the
 # uninterrupted baseline's.  Exits nonzero on any violation.
 python performance/smoke.py --serve
+# graftchaos campaign gate (GATING): the fast subset of the chaos
+# matrix (performance/chaos_matrix.py) — checkpoint ENOSPC mid-save
+# (counted, next save lands, no torn file), torn-write walk-back,
+# checkpoint-read EIO (typed CheckpointError check="io"), and the serve
+# command queue rejecting with 503 + Retry-After — each cell in a
+# timeout-bounded child process, each required to terminate in exactly
+# its contract state (recovered | degraded | raised).  Exits nonzero on
+# any contract violation; the full 14-cell matrix runs with no flag.
+python performance/chaos_matrix.py --gate

@@ -1,0 +1,457 @@
+"""
+graftchaos (:mod:`magicsoup_tpu.guard.chaos` + :mod:`.backoff`): the
+deterministic fault-injection plane and the graceful-degradation
+contracts it exists to prove.
+
+The acceptance contracts pinned here:
+
+- a bad ``MAGICSOUP_CHAOS`` spec refuses at PARSE time with a typed
+  :class:`GuardConfigError` naming the variable — never a silent no-op,
+- an armed schedule is DETERMINISTIC: the same spec (same seed) over
+  the same probe sequence fires the same sites at the same hits,
+- one :class:`BackoffPolicy` replays the same ladder every time and its
+  clock is injectable (schedules are asserted, never slept out),
+- ENOSPC in the middle of a checkpoint save leaves NO torn ``.msck``
+  behind, the failure is counted, and the next save simply lands —
+  solo manager and warden cadence alike (the run keeps stepping),
+- a telemetry sink fault disarms the stream into a COUNTED degraded
+  state instead of killing the run, and the chaos/degraded transitions
+  surface as telemetry rows,
+- a full serve command queue is backpressure (typed 503 + Retry-After),
+  not a hang,
+- an armed-but-never-firing plane is trajectory-invisible (probe cost
+  is observation only),
+- the campaign matrix (``performance/chaos_matrix.py``) keeps its cell
+  registry well-formed: every spec parses, every cell names one of the
+  three contract states, and the verifiers classify strictly.
+"""
+import errno
+import importlib.util
+import json
+import random
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu.analysis import runtime
+from magicsoup_tpu.fleet import FleetScheduler, FleetWarden
+from magicsoup_tpu.guard import CheckpointManager, GuardConfigError, chaos
+from magicsoup_tpu.guard.backoff import BackoffPolicy
+from magicsoup_tpu.serve import FleetService, ServeError
+from magicsoup_tpu.telemetry import TelemetryRecorder, validate_rows
+
+_MOLS = [
+    ms.Molecule("ch-a", 10e3),
+    ms.Molecule("ch-atp", 8e3, half_life=100_000),
+]
+_CHEM = ms.Chemistry(molecules=_MOLS, reactions=[([_MOLS[0]], [_MOLS[1]])])
+
+_KW = dict(
+    mol_name="ch-atp",
+    kill_below=-1.0,
+    divide_above=1e30,
+    divide_cost=0.0,
+    target_cells=None,
+    genome_size=100,
+    lag=1,
+    p_mutation=0.0,
+    p_recombination=0.0,
+    megastep=2,
+)
+
+
+def _world(seed):
+    world = ms.World(chemistry=_CHEM, map_size=16, seed=seed)
+    world.deterministic = True
+    rng = random.Random(seed)
+    world.spawn_cells([ms.random_genome(s=100, rng=rng) for _ in range(8)])
+    return world
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Chaos state is process-global: every test starts and ends
+    disarmed with zeroed counters."""
+    chaos.disarm()
+    runtime.reset_counters()
+    yield
+    chaos.disarm()
+    runtime.reset_counters()
+
+
+# ----------------------------------------------------------------- #
+# spec parsing                                                      #
+# ----------------------------------------------------------------- #
+
+def test_parse_spec_full_grammar():
+    plane = chaos.parse_spec(
+        "checkpoint.write:enospc@2x3;fetch:delay:1.5;"
+        "dispatch:transient x0 %0.25 ~7"
+    )
+    ck = plane["checkpoint.write"][0]
+    assert (ck.kind, ck.after, ck.count, ck.prob) == ("enospc", 2, 3, 1.0)
+    fe = plane["fetch"][0]
+    assert (fe.kind, fe.arg, fe.after, fe.count) == ("delay", 1.5, 1, 1)
+    dp = plane["dispatch"][0]
+    assert (dp.count, dp.prob, dp.seed) == (0, 0.25, 7)
+
+
+@pytest.mark.parametrize(
+    "bad, needle",
+    [
+        ("nosuch.site:eio", "unknown chaos site"),
+        ("checkpoint.write:delay:3", "does not understand fault kind"),
+        ("fetch:delay", "needs a seconds argument"),
+        ("dispatch:transient%0", "probability"),
+        ("checkpoint.write", "unparseable chaos clause"),
+        ("checkpoint.write:enospc@", "unparseable chaos clause"),
+    ],
+)
+def test_bad_specs_refuse_at_parse_time(bad, needle):
+    with pytest.raises(GuardConfigError) as ei:
+        chaos.parse_spec(bad)
+    msg = str(ei.value)
+    assert needle in msg
+    # the typed error names the env variable so the operator knows
+    # WHICH knob to fix
+    assert "MAGICSOUP_CHAOS" in msg
+    assert not chaos.armed()
+
+
+def test_arm_disarm_roundtrip():
+    chaos.arm("dispatch:transient@2")
+    assert chaos.armed()
+    assert chaos.spec() == "dispatch:transient@2"
+    assert chaos.site("checkpoint.write") is None  # other sites untouched
+    chaos.disarm()
+    assert not chaos.armed() and chaos.spec() is None
+    assert chaos.site("dispatch") is None
+
+
+# ----------------------------------------------------------------- #
+# deterministic schedules                                           #
+# ----------------------------------------------------------------- #
+
+def _fire_pattern(spec, hits=40):
+    chaos.arm(spec)
+    pattern = [chaos.site("dispatch") is not None for _ in range(hits)]
+    chaos.disarm()
+    return pattern
+
+
+def test_after_count_window():
+    pattern = _fire_pattern("dispatch:transient@3x2", hits=6)
+    assert pattern == [False, False, True, True, False, False]
+
+
+def test_probabilistic_schedule_is_seed_deterministic():
+    a = _fire_pattern("dispatch:transient x0 %0.3 ~11")
+    b = _fire_pattern("dispatch:transient x0 %0.3 ~11")
+    c = _fire_pattern("dispatch:transient x0 %0.3 ~12")
+    assert a == b            # same seed -> same fired sites, always
+    assert 0 < sum(a) < 40   # actually probabilistic, not all-or-nothing
+    assert a != c            # a different seed is a different schedule
+
+
+def test_first_eligible_clause_wins_and_all_observe():
+    chaos.arm("checkpoint.write:enospc@1x1;checkpoint.write:torn@1x0")
+    first = chaos.site("checkpoint.write")
+    second = chaos.site("checkpoint.write")
+    assert (first.kind, second.kind) == ("enospc", "torn")
+    # the torn clause observed hit 1 even while enospc won it
+    assert chaos.fired_counts() == {"checkpoint.write": 2}
+    assert second.index == 1
+
+
+def test_fault_as_oserror_carries_errno():
+    chaos.arm("checkpoint.write:enospc")
+    exc = chaos.site("checkpoint.write").as_oserror()
+    assert isinstance(exc, OSError) and exc.errno == errno.ENOSPC
+    assert "checkpoint.write" in str(exc)
+
+
+# ----------------------------------------------------------------- #
+# backoff policy                                                    #
+# ----------------------------------------------------------------- #
+
+def test_backoff_ladder_and_cap():
+    pol = BackoffPolicy(base=0.5, factor=2.0, max_delay=3.0)
+    assert pol.schedule(5) == [0.5, 1.0, 2.0, 3.0, 3.0]
+    with pytest.raises(ValueError):
+        pol.delay(0)
+    with pytest.raises(ValueError):
+        BackoffPolicy(base=1.0, jitter=1.0)
+
+
+def test_backoff_jitter_is_private_and_deterministic():
+    a = BackoffPolicy(base=1.0, jitter=0.5, seed=3)
+    b = BackoffPolicy(base=1.0, jitter=0.5, seed=3)
+    state = random.getstate()
+    sched = a.schedule(6)
+    assert random.getstate() == state  # never touches the global stream
+    assert sched == b.schedule(6)
+    assert sched != BackoffPolicy(base=1.0, jitter=0.5, seed=4).schedule(6)
+    for i, d in enumerate(sched, start=1):
+        exact = 1.0 * 2.0 ** (i - 1)
+        assert 0.5 * exact <= d <= 1.5 * exact
+
+
+def test_backoff_injectable_clock():
+    pol = BackoffPolicy(base=2.0)
+    slept = []
+    assert pol.sleep(3, sleep=slept.append) == 8.0
+    assert slept == [8.0]  # asserted, not waited out
+
+
+def test_retry_classification_storage_errnos_never_retried():
+    from magicsoup_tpu.guard.retry import is_transient_error, retry_call
+
+    for code in (errno.ENOSPC, errno.EROFS, errno.EDQUOT):
+        assert not is_transient_error(OSError(code, "boom"))
+    assert is_transient_error(ConnectionError("Socket closed"))
+    calls = {"n": 0}
+
+    def dead_disk():
+        calls["n"] += 1
+        # transient marker text in the message must NOT win retries for
+        # a dead disk: the errno check comes first
+        raise OSError(errno.ENOSPC, "UNAVAILABLE: no space left")
+
+    with pytest.raises(OSError):
+        retry_call(dead_disk, retries=5, sleep=lambda d: None)
+    assert calls["n"] == 1  # failed fast, zero retries
+
+
+# ----------------------------------------------------------------- #
+# event ring (chaos/degraded telemetry rows)                        #
+# ----------------------------------------------------------------- #
+
+def test_events_since_cursors_are_independent_and_monotone():
+    cur_a = chaos.events_since(0)[0]
+    cur_b = cur_a
+    chaos.arm("dispatch:transient@1x1")
+    chaos.site("dispatch")
+    chaos.note_degraded("sub.x", "why")
+    cur_a, rows_a = chaos.events_since(cur_a)
+    assert [r["type"] for r in rows_a] == ["chaos", "degraded"]
+    assert chaos.events_since(cur_a)[1] == []  # drained for this cursor
+    chaos.clear_degraded("sub.x")
+    _, rows_b = chaos.events_since(cur_b)  # second observer: everything
+    assert [r["type"] for r in rows_b] == ["chaos", "degraded", "degraded"]
+    assert [r.get("state") for r in rows_b[1:]] == ["degraded", "recovered"]
+    # reset keeps cursors valid (no replay of rows that never happened)
+    runtime.reset_counters()
+    cur_a, rows = chaos.events_since(cur_a)
+    assert rows == []
+    chaos.note_counter("x")  # counters don't produce rows
+    assert chaos.events_since(cur_a)[1] == []
+
+
+def test_runtime_snapshot_merges_chaos_counters():
+    chaos.arm("dispatch:transient@1x1")
+    chaos.site("dispatch")
+    chaos.note_degraded("sub.y", "detail")
+    chaos.note_counter("widget_failures", 3)
+    snap = runtime.snapshot()
+    assert snap["chaos_fired"] == 1
+    assert snap["degraded"] == 1
+    assert snap["widget_failures"] == 3
+    runtime.reset_counters()
+    snap = runtime.snapshot()
+    assert snap["chaos_fired"] == 0 and snap["degraded"] == 0
+    assert "widget_failures" not in snap
+
+
+# ----------------------------------------------------------------- #
+# checkpoint pressure                                               #
+# ----------------------------------------------------------------- #
+
+def test_enospc_mid_save_leaves_no_torn_file_and_next_save_lands(tmp_path):
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+    chaos.arm("checkpoint.write:enospc@1x1")
+    with pytest.raises(OSError) as ei:
+        mgr.save({"step": 1}, step=1)
+    assert ei.value.errno == errno.ENOSPC
+    # the atomic protocol cleaned up after itself: no temp, no torn file
+    assert list((tmp_path / "ckpt").glob("*.msck")) == []
+    assert mgr.failure_counters()["save_failures"] == 1
+    mgr.save({"step": 2}, step=2)
+    payload, _meta, _path = mgr.load_latest()
+    assert payload["step"] == 2
+    assert mgr.failure_counters()["consecutive_save_failures"] == 0
+
+
+def test_torn_write_walks_back(tmp_path):
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+    chaos.arm("checkpoint.write:torn@2x1")
+    mgr.save({"v": 1}, step=1)
+    mgr.save({"v": 2}, step=2)  # torn on disk, returns normally
+    with pytest.warns(UserWarning, match="falling back"):
+        payload, _meta, path = mgr.load_latest()
+    assert payload["v"] == 1 and "0000000001" in path.name
+
+
+def test_warden_cadence_save_enospc_keeps_stepping(tmp_path):
+    sch = FleetScheduler(block=4)
+    sch.admit(_world(3), **_KW)
+    warden = FleetWarden(
+        sch, policy="warn", checkpoint_dir=tmp_path / "streams",
+        cadence=1, keep=2,
+    )
+    chaos.arm("checkpoint.write:enospc@1x1")
+    with pytest.warns(UserWarning, match="skipped and counted"):
+        sch.step()  # first cadence save fails -> counted skip, NOT fatal
+    sch.step()      # next cadence save lands -> stream recovers
+    sch.flush()
+    (st,) = warden.statuses()
+    assert st.status == "active"
+    assert st.save_skips == 1
+    assert not st.save_degraded
+    snap = runtime.snapshot()
+    assert snap["warden_save_skips"] == 1
+    assert snap["degraded"] == 0  # recovered: nothing left degraded
+    # the stream really did keep rolling after the failure
+    assert any((tmp_path / "streams").glob("*.msck"))
+
+
+# ----------------------------------------------------------------- #
+# telemetry degradation                                             #
+# ----------------------------------------------------------------- #
+
+def test_recorder_degrades_counted_and_recovers_on_attach(tmp_path):
+    rec = TelemetryRecorder(flush_every=1)
+    rec.attach(tmp_path / "a.jsonl")
+    chaos.arm("telemetry.emit:eio@1x1")
+    with pytest.warns(UserWarning, match="degraded"):
+        rec.emit({"type": "note", "i": 0})
+    assert rec.degraded and "EIO" in rec.degraded_reason.upper()
+    rec.emit({"type": "note", "i": 1})  # dropped silently but counted
+    assert rec.rows_dropped >= 1
+    assert "telemetry.emit" in chaos.degraded_states()
+    # re-attach is the recovery path: stream re-arms and the buffered
+    # chaos/degraded transitions surface as telemetry rows
+    rec.attach(tmp_path / "b.jsonl")
+    assert not rec.degraded
+    rec.emit_counters()
+    rec.detach()
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "b.jsonl").read_text().splitlines()
+    ]
+    assert validate_rows(rows) == []
+    kinds = [r["type"] for r in rows]
+    assert "chaos" in kinds and "degraded" in kinds
+    counters = next(r for r in rows if r["type"] == "counters")["counters"]
+    assert counters["telemetry_rows_dropped"] >= 1
+
+
+# ----------------------------------------------------------------- #
+# serve backpressure                                                #
+# ----------------------------------------------------------------- #
+
+def test_serve_queue_full_is_typed_backpressure(tmp_path):
+    svc = FleetService(tmp_path, block=2, idle_wait=0.001).start()
+    try:
+        chaos.arm("serve.queue:full@1x1")
+        with pytest.raises(ServeError) as ei:
+            svc.submit("list", {})
+        assert ei.value.status == 503
+        assert ei.value.retry_after is not None and ei.value.retry_after > 0
+        assert "serve.queue" in chaos.degraded_states()
+        # the very next command goes through and clears the state
+        assert isinstance(svc.submit("list", {}), dict)
+        assert "serve.queue" not in chaos.degraded_states()
+    finally:
+        svc.stop()
+    assert runtime.snapshot()["serve_queue_full"] == 1
+
+
+# ----------------------------------------------------------------- #
+# trajectory invisibility                                           #
+# ----------------------------------------------------------------- #
+
+def _run_digest(seed, steps=3):
+    world = _world(seed)
+    st = ms.PipelinedStepper(world, **_KW)
+    for _ in range(steps):
+        st.step()
+    st.flush()
+    return (
+        int(world.n_cells),
+        np.asarray(world.molecule_map).tobytes(),
+        np.asarray(world.cell_molecules).tobytes(),
+    )
+
+
+def test_armed_but_silent_plane_is_trajectory_invisible():
+    baseline = _run_digest(5)
+    # armed, probed on every dispatch, never eligible to fire: the
+    # probe must be observation only
+    chaos.arm("dispatch:transient@100000x1")
+    shadowed = _run_digest(5)
+    chaos.disarm()
+    assert shadowed == baseline
+    assert chaos.fired_counts() == {}
+
+
+# ----------------------------------------------------------------- #
+# campaign matrix registry                                          #
+# ----------------------------------------------------------------- #
+
+def _load_matrix_module():
+    path = (
+        Path(__file__).resolve().parents[2]
+        / "performance"
+        / "chaos_matrix.py"
+    )
+    spec = importlib.util.spec_from_file_location("_chaos_matrix", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_matrix_registry_is_well_formed():
+    mx = _load_matrix_module()
+    assert len(mx.CELLS) >= 12
+    gate = [n for n, c in mx.CELLS.items() if c.get("gate")]
+    assert len(gate) >= 3
+    states = set()
+    for name, cell in mx.CELLS.items():
+        assert cell["expect"] in ("recovered", "degraded", "raised"), name
+        states.add(cell["expect"])
+        chaos.parse_spec(cell["spec"])  # every spec must stay parseable
+        assert callable(cell["verify"])
+        assert callable(getattr(mx, f"cell_{name}"))
+    assert states == {"recovered", "degraded", "raised"}  # all 3 covered
+
+
+def test_matrix_verifiers_classify_strictly():
+    mx = _load_matrix_module()
+    good_torn = {"loaded_v": 1, "fired": {"checkpoint.write": 1}}
+    assert mx.CELLS["ckpt_torn"]["verify"](good_torn, None) == []
+    bad_torn = {"loaded_v": 2, "fired": {"checkpoint.write": 1}}
+    assert mx.CELLS["ckpt_torn"]["verify"](bad_torn, None)
+
+    typed = mx.CELLS["ckpt_read_eio"]["verify"]
+    assert typed({"error": "CheckpointError", "check": "io"}, None) == []
+    assert typed({"error": "CheckpointError", "check": "corrupt"}, None)
+    assert typed({"error": "OSError"}, None)
+
+    full = mx.CELLS["serve_queue_full"]["verify"]
+    ok = {
+        "first": {"status": 503, "retry_after": 0.5},
+        "second_ok": True,
+        "counters": {"serve_queue_full": 1},
+    }
+    assert full(ok, None) == []
+    assert full({**ok, "first": {"status": 504}}, None)
+    assert full({**ok, "second_ok": False}, None)
+
+    dig = mx.CELLS["dispatch_recovers"]["verify"]
+    out = {"digest": "abc", "dispatch_retries": 1}
+    assert dig(out, {"digest": "abc"}) == []
+    assert dig(out, {"digest": "xyz"})  # digest drift is a failure
+    assert dig(out, None)               # missing baseline is a failure

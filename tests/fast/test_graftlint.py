@@ -61,6 +61,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl015_cross_thread.py", "GL015"),
         ("gl016_lock_order.py", "GL016"),
         ("gl017_queue_bypass.py", "GL017"),
+        ("gl018_raw_io.py", "GL018"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -297,6 +298,67 @@ def test_gl014_sleep_and_bare_result_forms(tmp_path):
     assert [(f.rule, f.line) for f in findings] == [
         ("GL014", 5),
         ("GL014", 8),
+    ]
+
+
+def test_gl018_waivable_like_the_other_rules(tmp_path):
+    # a deliberate raw write (the guard.faults injectors corrupt files
+    # on purpose) waives with the standard inline annotation; pin that
+    # the machinery covers GL018
+    src = (FIXTURES / "gl018_raw_io.py").read_text()
+    waived = src.replace(
+        "# GL018: raw write bypasses guard.io",
+        "# graftlint: disable=GL018 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl018_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl018_scoped_to_guard_path_modules(tmp_path):
+    # the SAME raw write is silent once the module stops being
+    # guard/fleet/serve-scoped: outside the robustness stack a plain
+    # open(.., "wb") is ordinary file handling
+    src = (FIXTURES / "gl018_raw_io.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu.guard.io import atomic_write_bytes"
+        "  # noqa: F401  (marks the module guard-scoped)",
+        "def atomic_write_bytes(path, data):\n    pass",
+    )
+    assert stripped != src
+    p = tmp_path / "gl018_not_guard.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL018"]) == []
+
+
+def test_gl018_replace_and_mode_forms(tmp_path):
+    # os.replace finishing a hand-rolled temp-file dance is the same
+    # bypass as the raw open; "r+b" in-place edits count, reads and
+    # append streams do not
+    p = tmp_path / "gl018_forms.py"
+    p.write_text(
+        "import os\n"
+        "from magicsoup_tpu import guard  # noqa: F401\n"
+        "def hand_rolled(tmp, dst, data):\n"
+        "    with open(tmp, 'xb') as fh:\n"
+        "        fh.write(data)\n"
+        "    os.replace(tmp, dst)\n"
+        "def in_place(path):\n"
+        "    with open(path, 'r+b') as fh:\n"
+        "        fh.write(b'x')\n"
+        "def read_only(path):\n"
+        "    with open(path, 'rb') as fh:\n"
+        "        return fh.read()\n"
+        "def append(path):\n"
+        "    with open(path, mode='a') as fh:\n"
+        "        fh.write('row')\n"
+    )
+    findings = analyze([p], rules=["GL018"])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("GL018", 4),
+        ("GL018", 6),
+        ("GL018", 8),
     ]
 
 

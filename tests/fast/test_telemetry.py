@@ -34,6 +34,10 @@ _SNAPSHOT_KEYS = {
     "restack_skipped",
     "attach_full",
     "attach_skipped",
+    # graftchaos contribution (guard.chaos.runtime_counters); dynamic
+    # note_counter keys may appear on top, so snapshot checks use <=
+    "chaos_fired",
+    "degraded",
 }
 
 
@@ -115,7 +119,7 @@ def test_runtime_snapshot_and_reset():
     # force at least one compile so the snapshot has something to show
     np.asarray(jnp.arange(3) * 2)
     snap = lint_rt.snapshot()
-    assert set(snap) == _SNAPSHOT_KEYS
+    assert _SNAPSHOT_KEYS <= set(snap)
     assert all(isinstance(v, int) for v in snap.values())
     lint_rt.reset_counters()
     assert all(v == 0 for v in lint_rt.snapshot().values())
