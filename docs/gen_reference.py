@@ -127,6 +127,12 @@ MODULES = {
         " `assert_owner()` raising typed `OwnershipViolation`s, armed"
         " by `MAGICSOUP_DEBUG_OWNERSHIP=1` and zero-cost otherwise."
     ),
+    "magicsoup_tpu.analysis.dataflow": (
+        "graftflow interprocedural host/device dataflow: the device-"
+        "taint fixpoint (call/return summaries, attribute facts, per-"
+        "element tuples) behind rules GL019-GL022, the D2H sync-point"
+        " inventory, and the chaos probe/registry coverage proofs."
+    ),
     "magicsoup_tpu.fleet.sharding": (
         "World-axis data parallelism: shard the fleet's leading axis"
         " over a `P(\"world\")` device mesh (no collectives — worlds are"

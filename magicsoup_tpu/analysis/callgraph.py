@@ -9,7 +9,14 @@ the callee can be pinned to a function in the linted set:
 - bare names defined in the same module;
 - ``self.meth(...)`` / ``cls.meth(...)`` within the defining class;
 - ``from pkg.mod import fn`` then ``fn(...)``;
-- ``import pkg.mod as m`` / ``from pkg import mod`` then ``m.fn(...)``.
+- ``import pkg.mod as m`` / ``from pkg import mod`` then ``m.fn(...)``;
+- ``self._mgr = Ctor(...)`` then ``self._mgr.meth(...)`` — the
+  attribute alias is pinned to ``Ctor`` when the constructor resolves
+  to a linted class (and dropped again if any other assignment
+  disagrees);
+- ``x = Ctor(...)`` then ``x.meth(...)`` within one function, and
+  ``def f(mgr: Ctor)`` then ``mgr.meth(...)`` through the parameter
+  annotation.
 
 Anything dynamic (callbacks, dict dispatch, attribute chains through
 objects) is dropped rather than guessed: a too-eager graph would mark
@@ -60,17 +67,27 @@ class FunctionRecord:
     node: ast.AST
     hot_marked: bool = False
     calls: set[FuncKey] = field(default_factory=set)
+    # return-value expressions (for the dataflow engine's summaries)
+    returns: list = field(default_factory=list)
+    # local name -> (rel, class) pinned via annotation or constructor
+    local_types: dict = field(default_factory=dict)
 
 
 class CallGraph:
     def __init__(self, files: list):
         self.files = list(files)
         self.functions: dict[FuncKey, FunctionRecord] = {}
+        self.classes: set[tuple[str, str]] = set()  # (rel, dotted class)
         self._by_module: dict[str, object] = {}
         self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
+        # (rel, cls, attr) -> (class rel, class name); None = conflicting
+        self._attr_class: dict[tuple[str, str, str], tuple[str, str] | None] = {}
+        self._callers: dict[FuncKey, set[FuncKey]] | None = None
         for f in self.files:
             self._by_module[f.module] = f
             self._index_file(f)
+        for rec in self.functions.values():
+            self._collect_attr_aliases(rec)
         for rec in self.functions.values():
             self._extract_calls(rec)
 
@@ -100,19 +117,139 @@ class CallGraph:
                         file=f, qualname=q, node=node, hot_marked=marked
                     )
                 elif isinstance(node, ast.ClassDef):
+                    self.classes.add((f.rel, prefix + node.name))
                     visit(node.body, prefix + node.name + ".")
 
         visit(f.tree.body, "")
 
+    # ----------------------------------------------------------- aliases
+    def _collect_attr_aliases(self, rec: FunctionRecord) -> None:
+        """Record ``self.X = Ctor(...)`` attribute→class pins for one
+        method.  Conflicting pins (two assignments, different classes)
+        collapse to None so resolution stays conservative."""
+        if "." not in rec.qualname:
+            return
+        cls = rec.qualname.rsplit(".", 1)[0]
+        for node in ast.walk(rec.node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            pairs = []
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Tuple)
+                    and isinstance(node.value, ast.Tuple)
+                    and len(tgt.elts) == len(node.value.elts)
+                ):
+                    pairs.extend(zip(tgt.elts, node.value.elts))
+                elif node.value is not None:
+                    pairs.append((tgt, node.value))
+            for tgt, value in pairs:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                pinned = None
+                if isinstance(value, ast.Call):
+                    pinned = self.resolve_class(rec.file, value.func)
+                key = (rec.file.rel, cls, tgt.attr)
+                if key in self._attr_class and self._attr_class[key] != pinned:
+                    self._attr_class[key] = None
+                else:
+                    self._attr_class[key] = pinned
+
     # ------------------------------------------------------------- edges
     def _extract_calls(self, rec: FunctionRecord) -> None:
         cls = rec.qualname.rsplit(".", 1)[0] if "." in rec.qualname else None
+        rec.local_types = self._local_types(rec)
         for node in ast.walk(rec.node):
             if isinstance(node, ast.Call):
-                tgt = self.resolve(rec.file, cls, node.func)
+                tgt = self.resolve(rec.file, cls, node.func, rec.local_types)
                 if tgt is not None:
                     rec.calls.add(tgt)
                 rec.calls.update(self._getattr_dispatch(rec.file, cls, node))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                rec.returns.append(node.value)
+
+    def _local_types(self, rec: FunctionRecord) -> dict:
+        """Pin local names to linted classes: parameter annotations and
+        ``x = Ctor(...)`` assignments.  Reassignment to anything else
+        drops the pin."""
+        types: dict[str, tuple[str, str] | None] = {}
+        node = rec.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for a in (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            ):
+                if a.annotation is not None:
+                    pinned = self._annotation_class(rec.file, a.annotation)
+                    if pinned is not None:
+                        types[a.arg] = pinned
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            for tgt in targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                pinned = None
+                if isinstance(sub.value, ast.Call):
+                    pinned = self.resolve_class(rec.file, sub.value.func)
+                if tgt.id in types and types[tgt.id] != pinned:
+                    types[tgt.id] = None
+                else:
+                    types[tgt.id] = pinned
+        return {k: v for k, v in types.items() if v is not None}
+
+    def _annotation_class(self, f, ann: ast.expr) -> tuple[str, str] | None:
+        """Resolve a parameter annotation to a linted class.  Handles
+        ``Cls``, ``"Cls"`` strings, and ``Cls | None`` unions."""
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return self._annotation_class(f, ann.left) or self._annotation_class(
+                f, ann.right
+            )
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            return self.resolve_class(f, ann)
+        return None
+
+    def resolve_class(self, f, expr: ast.expr) -> tuple[str, str] | None:
+        """Resolve a class-reference expression to a linted (rel, name)."""
+        imports = self._imports.get(f.rel, {})
+        if isinstance(expr, ast.Name):
+            if (f.rel, expr.id) in self.classes:
+                return (f.rel, expr.id)
+            if expr.id in imports:
+                mod, name = imports[expr.id]
+                if name is not None:
+                    return self._module_class(mod, name)
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base in imports:
+                mod, name = imports[base]
+                target = mod if name is None else f"{mod}.{name}"
+                return self._module_class(target, expr.attr)
+        return None
+
+    def _module_class(self, module: str, name: str) -> tuple[str, str] | None:
+        tf = self._by_module.get(module)
+        if tf is None:
+            for m, file in self._by_module.items():
+                if module.endswith("." + m) or m.endswith("." + module):
+                    tf = file
+                    break
+        if tf is None:
+            return None
+        key = (tf.rel, name)
+        return key if key in self.classes else None
 
     def _getattr_dispatch(self, f, cls: str | None, call: ast.Call) -> set[FuncKey]:
         """Edges for ``getattr(self, f"_cmd_{name}")``-style dispatch.
@@ -166,7 +303,13 @@ class CallGraph:
             return None
         return self.resolve(f, cls, expr)
 
-    def resolve(self, f, cls: str | None, func: ast.expr) -> FuncKey | None:
+    def resolve(
+        self,
+        f,
+        cls: str | None,
+        func: ast.expr,
+        local_types: dict | None = None,
+    ) -> FuncKey | None:
         """Resolve a call target expression to a linted function, or None."""
         imports = self._imports.get(f.rel, {})
         if isinstance(func, ast.Name):
@@ -186,6 +329,24 @@ class CallGraph:
                 mod, name = imports[base]
                 target = mod if name is None else f"{mod}.{name}"
                 return self._module_func(target, func.attr)
+            if local_types and base in local_types:
+                crel, cname = local_types[base]
+                key = (crel, f"{cname}.{func.attr}")
+                return key if key in self.functions else None
+            return None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "self"
+            and cls
+        ):
+            # ``self._mgr.save(...)`` through a pinned attribute alias
+            pinned = self._attr_class.get((f.rel, cls, func.value.attr))
+            if pinned is not None:
+                crel, cname = pinned
+                key = (crel, f"{cname}.{func.attr}")
+                return key if key in self.functions else None
         return None
 
     def _module_func(self, module: str, name: str) -> FuncKey | None:
@@ -201,6 +362,17 @@ class CallGraph:
             return None
         key = (tf.rel, name)
         return key if key in self.functions else None
+
+    # ------------------------------------------------------------ callers
+    def callers(self) -> dict[FuncKey, set[FuncKey]]:
+        """Reverse edge map (callee -> direct callers), computed once."""
+        if self._callers is None:
+            rev: dict[FuncKey, set[FuncKey]] = {}
+            for key, rec in self.functions.items():
+                for callee in rec.calls:
+                    rev.setdefault(callee, set()).add(key)
+            self._callers = rev
+        return self._callers
 
     # --------------------------------------------------------------- hot
     def hot_functions(self) -> set[FuncKey]:

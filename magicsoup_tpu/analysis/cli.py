@@ -48,6 +48,13 @@ def main(argv: list[str] | None = None) -> int:
         "rows) — what CI archives",
     )
     parser.add_argument(
+        "--sarif",
+        default=None,
+        metavar="PATH",
+        help="also write the (fresh) findings as a SARIF 2.1.0 log at "
+        "PATH — the format CI code-scanning ingests",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
     )
     args = parser.parse_args(argv)
@@ -59,11 +66,19 @@ def main(argv: list[str] | None = None) -> int:
 
     paths = args.paths or [engine.default_target()]
     only = args.rules.split(",") if args.rules else None
-    findings = engine.analyze(paths, rules=only)
+    timings: dict = {}
+    ctx = engine.build_context(paths, timings=timings)
+    findings = engine.analyze(paths, rules=only, ctx=ctx, timings=timings)
     baseline = engine.load_baseline(
         Path(args.baseline) if args.baseline else None
     )
     fresh = engine.apply_baseline(findings, baseline)
+    iterations = getattr(ctx.dataflow, "iterations", 0)
+
+    if args.sarif:
+        from magicsoup_tpu.analysis import sarif
+
+        sarif.write_sarif(args.sarif, fresh, RULE_INFO)
 
     if args.json:
         counts = {code: 0 for code in sorted(RULE_INFO)}
@@ -76,6 +91,13 @@ def main(argv: list[str] | None = None) -> int:
             "baselined": len(findings) - len(fresh),
             "files": len({f.path for f in fresh}),
             "findings": [asdict(f) for f in fresh],
+            # full device->host crossing inventory (sanctioned and not):
+            # the sync-point certificate downstream perf triage diffs
+            # against — a new unsanctioned row is a regression even when
+            # no rule fires (it may be waived or outside a hot path)
+            "d2h": ctx.dataflow.d2h_inventory(),
+            "dataflow_iterations": iterations,
+            "timings": {k: round(v, 4) for k, v in timings.items()},
         }
         print(json.dumps(report, indent=2))
     else:
@@ -86,6 +108,16 @@ def main(argv: list[str] | None = None) -> int:
             f"graftlint: {len(fresh)} finding(s) in {n_files} file(s) "
             f"({len(findings) - len(fresh)} baselined)"
         )
+        if args.check:
+            # --check is the CI gate: surface where the wall time goes
+            # and that the taint fixpoint converged (vs hit its cap)
+            passes = "  ".join(
+                f"{k}={v:.2f}s" for k, v in timings.items()
+            )
+            print(
+                f"graftlint: passes: {passes}  "
+                f"(dataflow fixpoint: {iterations} iteration(s))"
+            )
     return 1 if (args.check and fresh) else 0
 
 

@@ -138,6 +138,7 @@ class Context:
     graph: object  # callgraph.CallGraph
     hot: set  # set[FuncKey]
     model: object | None = None  # concurrency.ThreadModel
+    dataflow: object | None = None  # dataflow.DataflowModel
 
 
 def default_target() -> Path:
@@ -171,27 +172,59 @@ def load_files(paths, exclude_analysis: bool = True) -> list[SourceFile]:
     return files
 
 
-def analyze(paths, rules: list[str] | None = None) -> list[Finding]:
+def build_context(paths, timings: dict | None = None) -> Context:
+    """Parse `paths` and build every shared model (call graph, thread
+    roles, device dataflow).  `timings`, when given, is filled with
+    per-pass wall seconds — the CLI's `--check` telemetry."""
+    import time
+
+    from magicsoup_tpu.analysis.callgraph import CallGraph
+    from magicsoup_tpu.analysis.concurrency import ThreadModel
+    from magicsoup_tpu.analysis.dataflow import DataflowModel
+
+    marks = timings if timings is not None else {}
+    t0 = time.perf_counter()
+    files = load_files(paths)
+    t1 = time.perf_counter()
+    marks["parse"] = t1 - t0
+    graph = CallGraph(files)
+    t2 = time.perf_counter()
+    marks["callgraph"] = t2 - t1
+    model = ThreadModel(files, graph)
+    t3 = time.perf_counter()
+    marks["threadmodel"] = t3 - t2
+    dataflow = DataflowModel(files, graph)
+    marks["dataflow"] = time.perf_counter() - t3
+    return Context(
+        files=files,
+        graph=graph,
+        hot=graph.hot_functions(),
+        model=model,
+        dataflow=dataflow,
+    )
+
+
+def analyze(
+    paths,
+    rules: list[str] | None = None,
+    ctx: Context | None = None,
+    timings: dict | None = None,
+) -> list[Finding]:
     """Run the (optionally filtered) rule set over `paths`.
 
     Returns suppression-filtered findings sorted by location.  Baseline
     subtraction is separate (see apply_baseline) so callers can report
     both totals.
     """
+    import time
+
     from magicsoup_tpu.analysis import rules as rules_mod
-    from magicsoup_tpu.analysis.callgraph import CallGraph
-    from magicsoup_tpu.analysis.concurrency import ThreadModel
 
-    files = load_files(paths)
-    graph = CallGraph(files)
-    ctx = Context(
-        files=files,
-        graph=graph,
-        hot=graph.hot_functions(),
-        model=ThreadModel(files, graph),
-    )
+    if ctx is None:
+        ctx = build_context(paths, timings=timings)
 
-    by_rel = {f.rel: f for f in files}
+    t0 = time.perf_counter()
+    by_rel = {f.rel: f for f in ctx.files}
     findings: list[Finding] = []
     for code, checker in rules_mod.checkers(rules).items():
         for finding in checker(ctx):
@@ -199,6 +232,8 @@ def analyze(paths, rules: list[str] | None = None) -> list[Finding]:
             if src is not None and src.suppressed(finding.line, finding.rule):
                 continue
             findings.append(finding)
+    if timings is not None:
+        timings["rules"] = time.perf_counter() - t0
     return sorted(set(findings))
 
 

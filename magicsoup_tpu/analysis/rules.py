@@ -33,26 +33,42 @@ Findings; registration at the bottom.
 |       |                      | `open(...,"wb")`/`os.replace` in guard/    |
 |       |                      | fleet/serve-scoped modules — raw writes    |
 |       |                      | bypass atomicity AND the chaos fault plane)|
+| GL019 | implicit-host-sync   | step-loop latency across call boundaries   |
+|       |                      | (syncs the shallow GL001 pass cannot see:  |
+|       |                      | taint through returns/attrs/containers)    |
+| GL020 | fetch-boundary-bypass| the metered util.fetch_host boundary (D2H  |
+|       |                      | conversions that corrupt the fetch/bytes   |
+|       |                      | counters telemetry and accounting bill)    |
+| GL021 | unprobed-robustness- | chaos coverage as a static proof (every    |
+|       | boundary             | retry/except-OSError boundary in guarded   |
+|       |                      | subsystems reachable by a fault point, and |
+|       |                      | FAULT_POINTS registry/probe agreement)     |
+| GL022 | untyped-error-escape | typed errors at certified entries (no bare |
+|       |                      | ValueError/OSError escaping serve handlers,|
+|       |                      | warden hooks, or checkpoint paths)         |
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
 matching runtime assertions.
 
-The device-taint analysis is a deliberately shallow intra-procedural
-pass: a name is "device" when it is a parameter annotated with a device
-type, is assigned from a jax/jnp call, or flows through arithmetic /
-indexing / method calls on device values; fetching through the
-sanctioned boundary (util.fetch_host, jax.device_get) un-taints.  Shallow
-means under-approximate — the clean-tree test plus code review cover the
-rest; precision here buys a zero-noise default, which is what keeps the
-lint gate tolerable in CI.
+The device-taint analysis in THIS module is a deliberately shallow
+intra-procedural pass: a name is "device" when it is a parameter
+annotated with a device type, is assigned from a jax/jnp call, or flows
+through arithmetic / indexing / method calls on device values; fetching
+through the sanctioned boundary (util.fetch_host, jax.device_get)
+un-taints.  Shallow means under-approximate — precision here buys a
+zero-noise default, which is what keeps the lint gate tolerable in CI.
+GL019-GL022 layer the graftflow INTERPROCEDURAL taint fixpoint on top
+(analysis/dataflow.py): call/return summaries, self-attribute facts, and
+per-element tuple tracking catch what the shallow pass cannot, deduped
+so each site is reported by exactly one rule.
 """
 from __future__ import annotations
 
 import ast
 import re
 
-from magicsoup_tpu.analysis import concurrency
+from magicsoup_tpu.analysis import concurrency, dataflow
 from magicsoup_tpu.analysis.engine import Context, Finding
 
 JAX_ROOTS = {"jax", "jnp", "lax"}
@@ -199,6 +215,8 @@ RULE_INFO = {
 # the graftrace concurrency rules keep their metadata next to their
 # model (analysis/concurrency.py) — merge so the CLI/docs see one table
 RULE_INFO.update(concurrency.RULE_INFO)
+# ...and the graftflow dataflow rules next to theirs (analysis/dataflow.py)
+RULE_INFO.update(dataflow.RULE_INFO)
 
 
 def _root_name(node: ast.expr) -> str | None:
@@ -1469,6 +1487,10 @@ CHECKERS = {
     "GL016": concurrency.check_gl016,
     "GL017": concurrency.check_gl017,
     "GL018": check_gl018,
+    "GL019": dataflow.check_gl019,
+    "GL020": dataflow.check_gl020,
+    "GL021": dataflow.check_gl021,
+    "GL022": dataflow.check_gl022,
 }
 
 

@@ -68,7 +68,13 @@ class BackoffPolicy:
         """Delay before retry ``attempt`` (1-based).  Pure: no clock,
         no global randomness, no internal state."""
         if attempt < 1:
-            raise ValueError(f"attempt is 1-based, got {attempt}")
+            from magicsoup_tpu.guard.errors import GuardConfigError
+
+            raise GuardConfigError(
+                f"attempt is 1-based, got {attempt}",
+                variable="attempt",
+                value=str(attempt),
+            )
         d = min(self.max_delay, self.base * self.factor ** (attempt - 1))
         if self.jitter:
             import random

@@ -58,6 +58,7 @@ from magicsoup_tpu.guard import io as _io
 from magicsoup_tpu.guard.errors import GuardConfigError
 
 __all__ = [
+    "FAULT_POINTS",
     "SITES",
     "Fault",
     "arm",
@@ -67,6 +68,7 @@ __all__ = [
     "degraded_states",
     "disarm",
     "events_since",
+    "fault_points",
     "fired_counts",
     "note_counter",
     "note_degraded",
@@ -83,6 +85,7 @@ SITES: dict[str, tuple[str, ...]] = {
     "io.write": ("enospc", "eio", "torn"),
     "checkpoint.write": ("enospc", "eio", "torn"),
     "checkpoint.read": ("eio",),
+    "checkpoint.delete": ("eio",),
     "registry.write": ("enospc", "eio"),
     "dispatch": ("transient",),
     "fetch": ("delay",),
@@ -90,6 +93,49 @@ SITES: dict[str, tuple[str, ...]] = {
     "serve.response": ("drop", "malformed"),
     "serve.queue": ("full", "slow"),
 }
+
+#: where each fault point is probed: site -> (module, qualified callable).
+#: This literal is the machine-readable half of the probe contract —
+#: graftlint GL021 parses it straight out of this file's AST and fails
+#: the lint gate when it disagrees with the probes actually present in
+#: the tree, so the analyzer and the runtime plane can never drift.
+FAULT_POINTS: dict[str, tuple[str, str]] = {
+    "io.write": ("magicsoup_tpu.guard.io", "atomic_write_bytes"),
+    "checkpoint.write": ("magicsoup_tpu.guard.checkpoint", "write_checkpoint"),
+    "checkpoint.read": ("magicsoup_tpu.guard.checkpoint", "_read_header"),
+    "checkpoint.delete": (
+        "magicsoup_tpu.guard.checkpoint",
+        "CheckpointManager.prune",
+    ),
+    "registry.write": (
+        "magicsoup_tpu.serve.service",
+        "FleetService._write_registry",
+    ),
+    "dispatch": ("magicsoup_tpu.stepper", "PipelinedStepper.step"),
+    "fetch": ("magicsoup_tpu.stepper", "PipelinedStepper._replay"),
+    "telemetry.emit": (
+        "magicsoup_tpu.telemetry.recorder",
+        "TelemetryRecorder._flush_locked",
+    ),
+    "serve.response": ("magicsoup_tpu.serve.api", "make_handler"),
+    "serve.queue": ("magicsoup_tpu.serve.service", "FleetService.submit"),
+}
+
+
+def fault_points() -> list[dict]:
+    """Machine-readable fault-point registry: one row per site with its
+    fault kinds and the (module, callable) that probes it.  The single
+    source of truth shared by the runtime plane, the chaos campaign
+    matrix, and the static analyzer (GL021)."""
+    return [
+        {
+            "site": name,
+            "kinds": list(SITES[name]),
+            "module": module,
+            "callable": qualname,
+        }
+        for name, (module, qualname) in sorted(FAULT_POINTS.items())
+    ]
 
 #: kinds that require a float ``arg`` (seconds)
 _ARG_REQUIRED = ("delay", "slow")

@@ -248,6 +248,9 @@ class CheckpointManager:
         removed = []
         for _step, p in self.checkpoints()[: -self.keep or None]:
             try:
+                fault = _chaos.site("checkpoint.delete")
+                if fault is not None:
+                    raise fault.as_oserror()
                 p.unlink()
             except OSError as exc:
                 self.delete_failures += 1

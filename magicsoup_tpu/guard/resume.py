@@ -147,15 +147,20 @@ def _remesh_world(world, mesh) -> None:
 
     n_tiles = int(mesh.shape[mesh.axis_names[0]])
     if world.map_size % n_tiles != 0:
-        raise ValueError(
+        # typed: a restore loop falling back across checkpoints must be
+        # able to tell "this snapshot cannot live on this mesh" from an
+        # arbitrary crash (see guard.errors)
+        raise CheckpointError(
             f"map_size={world.map_size} must be divisible by the first"
-            f" mesh axis size {n_tiles} for row sharding"
+            f" mesh axis size {n_tiles} for row sharding",
+            check="config",
         )
     if world._capacity % n_tiles != 0:
-        raise ValueError(
+        raise CheckpointError(
             f"restored capacity {world._capacity} does not split across"
             f" {n_tiles} tiles; checkpoint was taken under a different"
-            " mesh size"
+            " mesh size",
+            check="config",
         )
     world._mesh = mesh
     world._map_sharding = tiled.map_sharding(mesh)

@@ -798,8 +798,8 @@ class Kinetics:
         # unsharded until a mesh-placed World re-sets cell_sharding
         state["cell_sharding"] = None
         state["params"] = CellParams(*(fetch_host(t) for t in self.params))
-        state["tables"] = TokenTables(*(np.asarray(t) for t in self.tables))
-        state["_abs_temp_arr"] = np.asarray(self._abs_temp_arr)
+        state["tables"] = TokenTables(*(fetch_host(t) for t in self.tables))
+        state["_abs_temp_arr"] = fetch_host(self._abs_temp_arr)
         return state
 
     def __setstate__(self, state: dict):

@@ -2800,7 +2800,7 @@ class PipelinedStepper:
         occ = np.asarray(_fetch_host(self._state.occ))
         pos = np.asarray(_fetch_host(self._state.pos))
         alive_dev = np.asarray(_fetch_host(self._state.alive))
-        n_rows_dev = int(self._state.n_rows)
+        n_rows_dev = int(_fetch_host(self._state.n_rows))
         assert n_rows_dev == self._n_rows, (n_rows_dev, self._n_rows)
         assert (alive_dev == self._alive).all()
         live = np.nonzero(self._alive)[0]

@@ -1564,9 +1564,9 @@ class World:
         # device arrays -> numpy for portable pickles
         state["_cell_molecules"] = _fetch_host(self._cell_molecules)
         state["_molecule_map"] = _fetch_host(self._molecule_map)
-        state["_diff_kernels"] = np.asarray(self._diff_kernels)
-        state["_perm_factors"] = np.asarray(self._perm_factors)
-        state["_degrad_factors"] = np.asarray(self._degrad_factors)
+        state["_diff_kernels"] = _fetch_host(self._diff_kernels)
+        state["_perm_factors"] = _fetch_host(self._perm_factors)
+        state["_degrad_factors"] = _fetch_host(self._degrad_factors)
         state.pop("_positions_dev")
         state.pop("_col_prefetch", None)
         state["_mm_cache"] = None

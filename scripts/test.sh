@@ -6,8 +6,9 @@ cd "$(dirname "$0")/.."
 TARGET="${1:-tests/fast}"
 # graftlint gate first: the static analyzer is cheap (stdlib AST, no jax
 # import) and a hot-path violation should fail before the suite spends
-# minutes compiling
-python -m magicsoup_tpu.analysis --check
+# minutes compiling.  The SARIF artifact is the machine-readable copy of
+# the same run — what a CI code-scanning upload step would ingest
+python -m magicsoup_tpu.analysis --check --sarif graftlint.sarif
 # arm the graftrace runtime ownership assertions (analysis/ownership.py)
 # for the whole suite: every test doubles as a thread-ownership probe of
 # the serve loop, stepper workers, telemetry flush, and signal handlers;

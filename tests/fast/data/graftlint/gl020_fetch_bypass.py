@@ -1,0 +1,27 @@
+"""GL020 fixture: a device->host conversion OUTSIDE util.fetch_host —
+the value is only known to be device-resident interprocedurally (it
+comes back from a helper), and `np.asarray` pulls it to host without
+touching the metered fetch counters.  The fetch_host form and the
+host-array conversion below it stay silent."""
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.util import fetch_host
+
+
+def _integrate(x):
+    return jnp.cumsum(x)  # device producer
+
+
+def snapshot(x) -> dict:
+    dev = _integrate(x)
+    return {"trace": np.asarray(dev)}  # GL020: unmetered D2H crossing
+
+
+def snapshot_metered(x) -> dict:
+    dev = _integrate(x)
+    return {"trace": fetch_host(dev)}  # the sanctioned, billed boundary
+
+
+def repack(rows: list) -> np.ndarray:
+    return np.asarray(rows)  # host list in, host array out: no crossing

@@ -180,7 +180,10 @@ def test_fault_as_oserror_carries_errno():
 def test_backoff_ladder_and_cap():
     pol = BackoffPolicy(base=0.5, factor=2.0, max_delay=3.0)
     assert pol.schedule(5) == [0.5, 1.0, 2.0, 3.0, 3.0]
-    with pytest.raises(ValueError):
+    # a bad attempt number escapes retry plumbing: must be typed (GL022)
+    from magicsoup_tpu.guard.errors import GuardConfigError
+
+    with pytest.raises(GuardConfigError):
         pol.delay(0)
     with pytest.raises(ValueError):
         BackoffPolicy(base=1.0, jitter=1.0)

@@ -1,0 +1,416 @@
+"""
+Tests for the graftflow interprocedural dataflow layer
+(:mod:`magicsoup_tpu.analysis.dataflow`): the taint fixpoint itself
+(returns, tuple unpacking, attribute round-trips, container escape),
+the GL019-GL022 rule scoping and waivers, the chaos probe/registry
+drift proofs, the D2H sync-point inventory the JSON report certifies,
+and the callgraph extensions (self-attribute aliases, parameter
+annotations) the fixpoint rides on.
+
+Everything here is pure stdlib analysis — no jax import, no device.
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from magicsoup_tpu.analysis import analyze
+from magicsoup_tpu.analysis import engine as lint_engine
+from magicsoup_tpu.analysis import sarif
+from magicsoup_tpu.analysis.rules import RULE_INFO
+
+FIXTURES = Path(__file__).parent / "data" / "graftlint"
+PKG = Path(lint_engine.default_target())
+
+
+def _ctx_for(tmp_path, src: str, name: str = "mod.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    return lint_engine.build_context([p])
+
+
+def _key(ctx, qualname: str):
+    return next(k for k in ctx.graph.functions if k[1] == qualname)
+
+
+# ------------------------------------------------- taint propagation
+def test_return_taint_flows_through_calls(tmp_path):
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def producer():\n"
+        "    return jnp.ones(3)\n"
+        "def relay():\n"
+        "    x = producer()\n"
+        "    return x\n"
+        "def host_only():\n"
+        "    return [1, 2, 3]\n",
+    )
+    df = ctx.dataflow
+    assert _key(ctx, "producer") in df.returns_device
+    assert _key(ctx, "relay") in df.returns_device  # interprocedural
+    assert _key(ctx, "host_only") not in df.returns_device
+
+
+def test_tuple_unpack_is_per_element(tmp_path):
+    # a mixed (device, host) return must NOT smear taint across every
+    # unpack target — the host half stays host
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def pair():\n"
+        "    return jnp.ones(3), 7\n"
+        "def take_device():\n"
+        "    d, n = pair()\n"
+        "    return d\n"
+        "def take_host():\n"
+        "    d, n = pair()\n"
+        "    return n\n",
+    )
+    df = ctx.dataflow
+    assert _key(ctx, "take_device") in df.returns_device
+    assert _key(ctx, "take_host") not in df.returns_device
+
+
+def test_attribute_taint_round_trip(tmp_path):
+    # a device value stored on self in one method is device when read
+    # back in another — the attr_device fact crosses methods
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "class Holder:\n"
+        "    def fill(self):\n"
+        "        self._buf = jnp.zeros(4)\n"
+        "    def read(self):\n"
+        "        return self._buf\n",
+    )
+    df = ctx.dataflow
+    assert _key(ctx, "Holder.read") in df.returns_device
+    assert any(a[1:] == ("Holder", "_buf") for a in df.attr_device)
+
+
+def test_container_escape_taints_list(tmp_path):
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def collect():\n"
+        "    out = []\n"
+        "    for i in range(3):\n"
+        "        out.append(jnp.ones(2))\n"
+        "    return out\n",
+    )
+    assert _key(ctx, "collect") in ctx.dataflow.returns_device
+
+
+def test_fetch_cache_idiom_stays_host(tmp_path):
+    # the (device, host-mirror) cache pair: returning the fetched half
+    # through a constant index must come back HOST
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "from magicsoup_tpu.util import fetch_host\n"
+        "class Cache:\n"
+        "    def refresh(self, dev):\n"
+        "        self._pair = (dev, fetch_host(dev))\n"
+        "        return self._pair[1]\n",
+    )
+    assert _key(ctx, "Cache.refresh") not in ctx.dataflow.returns_device
+
+
+def test_host_scalar_annotation_certifies_return(tmp_path):
+    # `-> bool` is an author-certified host boundary even when the body
+    # touches device slots (identity/equality predicates over tokens)
+    ctx = _ctx_for(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def token():\n"
+        "    return (1, jnp.ones(2))\n"
+        "def unchanged(a, b) -> bool:\n"
+        "    t = token()\n"
+        "    return a is t or b is t\n"
+        "def leaky(a, b):\n"
+        "    return token()\n",
+    )
+    df = ctx.dataflow
+    assert _key(ctx, "unchanged") not in df.returns_device
+    assert _key(ctx, "leaky") in df.returns_device
+
+
+# ----------------------------------------------- scoping and waivers
+def test_gl019_waivable_like_the_other_rules(tmp_path):
+    src = (FIXTURES / "gl019_implicit_sync.py").read_text()
+    waived = src.replace(
+        "# GL019: `if` on a device value that flowed in through a call",
+        "# graftlint: disable=GL019 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl019_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl019_scoped_to_hot_functions(tmp_path):
+    # the SAME interprocedural sync is silent once the function is not
+    # hot: blocking on a device value outside the step loop is allowed
+    src = (FIXTURES / "gl019_implicit_sync.py").read_text()
+    cold = src.replace("# graftlint: hot\n", "")
+    assert cold != src
+    p = tmp_path / "gl019_cold.py"
+    p.write_text(cold)
+    assert analyze([p], rules=["GL019"]) == []
+
+
+def test_gl020_exempts_the_boundary_module(tmp_path):
+    # the fetch implementation itself converts device memory — a file
+    # named util.py (where fetch_host lives) is the sanctioned interior
+    src = (FIXTURES / "gl020_fetch_bypass.py").read_text()
+    p = tmp_path / "util.py"
+    p.write_text(src)
+    assert analyze([p], rules=["GL020"]) == []
+
+
+def test_gl021_scoped_to_guarded_subsystems(tmp_path):
+    # without the guard import the module is plain library code: an
+    # unprobed except is allowed outside the robustness planes
+    src = (FIXTURES / "gl021_unprobed_boundary.py").read_text()
+    unscoped = src.replace(
+        "from magicsoup_tpu.guard import chaos\n", "chaos = None\n"
+    ).replace("chaos.site", "(lambda _s: None)")
+    p = tmp_path / "gl021_unscoped.py"
+    p.write_text(unscoped)
+    assert analyze([p], rules=["GL021"]) == []
+
+
+def test_gl022_scoped_to_certified_entries(tmp_path):
+    # same raise, but the class is not a Warden (and nothing else makes
+    # an entry of it): no certified boundary to escape from
+    src = (FIXTURES / "gl022_untyped_escape.py").read_text()
+    renamed = src.replace("MiniWarden", "MiniKeeper")
+    assert renamed != src
+    p = tmp_path / "gl022_unscoped.py"
+    p.write_text(renamed)
+    assert analyze([p], rules=["GL022"]) == []
+
+
+# ------------------------------------------- chaos coverage (GL021)
+def test_gl021_probe_deletion_is_caught():
+    # mutation-style acceptance: commenting out the probe in the
+    # fixture's PROBED twin turns its boundary into a fresh finding
+    src = (FIXTURES / "gl021_unprobed_boundary.py").read_text()
+    mutated = "\n".join(
+        (
+            "#" + line
+            if (
+                "chaos.site(" in line
+                or "if fault" in line
+                or "raise fault" in line
+            )
+            else line
+        )
+        for line in src.splitlines()
+    )
+    assert mutated != src
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = Path(td) / "gl021_mutated.py"
+        p.write_text(mutated)
+        findings = analyze([p], rules=["GL021"])
+    lines = sorted(f.line for f in findings)
+    assert len(findings) == 2  # the original finding PLUS the mutation
+    probed_except = next(
+        i
+        for i, line in enumerate(src.splitlines(), start=1)
+        if "injectable: the probe above raises into it" in line
+    )
+    assert probed_except in lines
+
+
+def test_gl021_registry_drift_both_directions(tmp_path):
+    chaos_src = (
+        "FAULT_POINTS = {\n"
+        '    "io.write": ("guard.io", "write_it"),\n'
+        '    "ghost.site": ("guard.io", "no_such_probe"),\n'
+        "}\n"
+    )
+    io_src = (
+        "from magicsoup_tpu.guard import chaos\n"
+        "def write_it(path):\n"
+        '    fault = chaos.site("io.write")\n'
+        "    if fault is not None:\n"
+        "        raise fault.as_oserror()\n"
+        "def rogue(path):\n"
+        '    fault = chaos.site("unregistered.site")\n'
+        "    if fault is not None:\n"
+        "        raise fault.as_oserror()\n"
+    )
+    (tmp_path / "guard").mkdir()
+    (tmp_path / "guard" / "chaos.py").write_text(chaos_src)
+    (tmp_path / "guard" / "io.py").write_text(io_src)
+    findings = analyze([tmp_path / "guard"], rules=["GL021"])
+    msgs = [f.message for f in findings]
+    # probe present in code, absent from the registry
+    assert any("'unregistered.site'" in m and "missing from" in m for m in msgs)
+    # registry entry with no matching probe in the tree
+    assert any("'ghost.site'" in m and "no matching probe" in m for m in msgs)
+    # the agreeing entry is silent
+    assert not any("'io.write'" in m for m in msgs)
+
+
+def test_fault_points_registry_matches_runtime():
+    # satellite contract: fault_points() is machine-readable and agrees
+    # with SITES — one row per site, each naming its probing callable
+    from magicsoup_tpu.guard import chaos
+
+    rows = chaos.fault_points()
+    assert sorted(r["site"] for r in rows) == sorted(chaos.SITES)
+    for r in rows:
+        assert r["kinds"] == list(chaos.SITES[r["site"]])
+        assert r["module"].startswith("magicsoup_tpu.")
+        assert r["callable"]
+    assert sorted(chaos.FAULT_POINTS) == sorted(chaos.SITES)
+
+
+# ------------------------------------------------- D2H certification
+@pytest.fixture(scope="module")
+def cli_tree_report(tmp_path_factory):
+    """ONE full-tree `--check --json --sarif` CLI run shared by the
+    report-schema and inventory tests (it is this module's priciest)."""
+    import contextlib
+    import io
+    import os
+
+    from magicsoup_tpu.analysis import cli
+
+    sarif_path = tmp_path_factory.mktemp("sarif") / "out.sarif"
+    buf = io.StringIO()
+    old = os.getcwd()
+    os.chdir(Path(__file__).resolve().parents[2])
+    try:
+        with contextlib.redirect_stdout(buf):
+            rc = cli.main(
+                ["--check", "--json", "--sarif", str(sarif_path)]
+            )
+    finally:
+        os.chdir(old)
+    return rc, json.loads(buf.getvalue()), sarif_path
+
+
+def test_d2h_inventory_pins_replay_path_sites(cli_tree_report):
+    _, report, _ = cli_tree_report
+    rows = report["d2h"]
+    seen = {(r["file"], r["function"], r["kind"]) for r in rows}
+    # the genome/mutation replay path's host mirrors and the pipelined
+    # replay fetch must appear — they are THE sanctioned crossings the
+    # ROADMAP's genome-on-device work has to move or batch
+    for expected in [
+        ("magicsoup_tpu/stepper.py", "_LazyFetch.result", "fetch_host"),
+        ("magicsoup_tpu/world.py", "World._host_molecule_map", "fetch_host"),
+        ("magicsoup_tpu/world.py", "World._host_cell_molecules", "fetch_host"),
+        ("magicsoup_tpu/world.py", "World._ensure_capacity", "fetch_host"),
+        ("magicsoup_tpu/world.py", "World.__getstate__", "fetch_host"),
+        ("magicsoup_tpu/guard/resume.py", "snapshot_run", "fetch_host"),
+    ]:
+        assert expected in seen, expected
+    # the tree's crossings are ALL routed through the audited boundary
+    unsanctioned = [r for r in rows if not r["sanctioned"]]
+    assert unsanctioned == []
+    # rows arrive sorted (the report embeds them deterministically)
+    assert rows == sorted(
+        rows, key=lambda r: (r["file"], r["line"], r["function"], r["kind"])
+    )
+
+
+def test_cli_json_reports_d2h_and_fixpoint(cli_tree_report):
+    rc, report, sarif_path = cli_tree_report
+    assert rc == 0, report
+    assert report["schema"] == "graftlint/1"
+    for code in ("GL019", "GL020", "GL021", "GL022"):
+        assert report["counts"][code] == 0  # enabled by default, clean
+    funcs = {r["function"] for r in report["d2h"]}
+    assert "_LazyFetch.result" in funcs
+    assert "World._host_molecule_map" in funcs
+    assert report["dataflow_iterations"] >= 1
+    assert set(report["timings"]) == {
+        "parse", "callgraph", "threadmodel", "dataflow", "rules"
+    }
+    # the SARIF artifact landed and is a valid 2.1.0 log
+    log = json.loads(sarif_path.read_text())
+    assert log["version"] == "2.1.0"
+    driver = log["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert {r["id"] for r in driver["rules"]} == set(RULE_INFO)
+    assert log["runs"][0]["results"] == []  # clean tree
+
+
+def test_sarif_maps_findings_with_locations():
+    findings = analyze([FIXTURES / "gl019_implicit_sync.py"])
+    assert len(findings) == 1
+    log = sarif.to_sarif(findings, RULE_INFO)
+    (result,) = log["runs"][0]["results"]
+    assert result["ruleId"] == "GL019"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("gl019_implicit_sync.py")
+    assert loc["region"]["startLine"] == findings[0].line
+    assert "fix-it:" in result["message"]["text"]
+
+
+# --------------------------------------------- callgraph extensions
+def test_callgraph_resolves_self_attribute_aliases(tmp_path):
+    ctx = _ctx_for(
+        tmp_path,
+        "class Saver:\n"
+        "    def save(self):\n"
+        "        return 1\n"
+        "class Owner:\n"
+        "    def __init__(self):\n"
+        "        self._mgr = Saver()\n"
+        "    def run(self):\n"
+        "        return self._mgr.save()\n",
+    )
+    run = _key(ctx, "Owner.run")
+    save = _key(ctx, "Saver.save")
+    assert save in ctx.graph.functions[run].calls
+    assert run in ctx.graph.callers()[save]
+
+
+def test_callgraph_resolves_annotated_parameters(tmp_path):
+    # the save_run shape: a module function receiving the manager by
+    # annotation — the GL021 coverage chains depend on this edge
+    ctx = _ctx_for(
+        tmp_path,
+        "class Manager:\n"
+        "    def save(self):\n"
+        "        return 1\n"
+        "def drive(manager: Manager):\n"
+        "    return manager.save()\n",
+    )
+    drive = _key(ctx, "drive")
+    save = _key(ctx, "Manager.save")
+    assert save in ctx.graph.functions[drive].calls
+
+
+def test_callgraph_conflicting_alias_pins_drop(tmp_path):
+    # two different classes stored on the same attribute: conservative
+    # resolution must refuse to pick one (no edge rather than a wrong edge)
+    ctx = _ctx_for(
+        tmp_path,
+        "class A:\n"
+        "    def go(self):\n"
+        "        return 1\n"
+        "class B:\n"
+        "    def go(self):\n"
+        "        return 2\n"
+        "class Owner:\n"
+        "    def __init__(self, flag):\n"
+        "        self._x = A()\n"
+        "        if flag:\n"
+        "            self._x = B()\n"
+        "    def run(self):\n"
+        "        return self._x.go()\n",
+    )
+    run = _key(ctx, "Owner.run")
+    calls = ctx.graph.functions[run].calls
+    assert _key(ctx, "A.go") not in calls
+    assert _key(ctx, "B.go") not in calls

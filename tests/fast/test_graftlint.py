@@ -62,6 +62,10 @@ def marked_line(path: Path, code: str) -> int:
         ("gl016_lock_order.py", "GL016"),
         ("gl017_queue_bypass.py", "GL017"),
         ("gl018_raw_io.py", "GL018"),
+        ("gl019_implicit_sync.py", "GL019"),
+        ("gl020_fetch_bypass.py", "GL020"),
+        ("gl021_unprobed_boundary.py", "GL021"),
+        ("gl022_untyped_escape.py", "GL022"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -495,11 +499,44 @@ def test_rules_filter_restricts_rule_set():
     assert all("suppressed" not in f.path for f in findings)
 
 
-def test_library_tree_lints_clean():
+@pytest.fixture(scope="module")
+def tree_run():
+    """ONE timed whole-tree analysis shared by the clean-tree gate and
+    the wall-budget test (a full run is the suite's priciest lint)."""
+    import time
+
+    timings: dict = {}
+    t0 = time.monotonic()
+    ctx = lint_engine.build_context([PKG], timings=timings)
+    findings = lint_engine.analyze([PKG], ctx=ctx, timings=timings)
+    elapsed = time.monotonic() - t0
+    return ctx, findings, timings, elapsed
+
+
+def test_library_tree_lints_clean(tree_run):
     # THE gate: the shipped baseline is empty, so any finding in the
     # package is a regression (or needs an inline annotation a reviewer
     # will see)
-    assert analyze([PKG]) == []
+    _, findings, _, _ = tree_run
+    assert findings == []
+
+
+def test_full_tree_analysis_under_wall_budget(tree_run):
+    # --check runs as the FIRST step of scripts/test.sh on every suite
+    # invocation: the whole-tree budget (parse + callgraph + threadmodel
+    # + dataflow fixpoint + all 22 rules) is a hard 30s, so the gate
+    # stays cheap enough to never be skipped
+    from magicsoup_tpu.analysis.dataflow import _FIXPOINT_CAP
+
+    ctx, _, timings, elapsed = tree_run
+    assert elapsed < 30.0, f"graftlint tree run took {elapsed:.1f}s"
+    # every pass reports its wall time (the --check telemetry line)
+    assert set(timings) == {
+        "parse", "callgraph", "threadmodel", "dataflow", "rules"
+    }
+    assert all(v >= 0.0 for v in timings.values())
+    # the taint fixpoint must CONVERGE, not hit its iteration cap
+    assert 1 <= ctx.dataflow.iterations < _FIXPOINT_CAP
 
 
 def test_baseline_tolerates_counted_findings():
