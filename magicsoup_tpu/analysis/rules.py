@@ -46,6 +46,10 @@ Findings; registration at the bottom.
 | GL022 | untyped-error-escape | typed errors at certified entries (no bare |
 |       |                      | ValueError/OSError escaping serve handlers,|
 |       |                      | warden hooks, or checkpoint paths)         |
+| GL023 | host-genome-in-hot-  | device-resident genomes (no host genome    |
+|       | path                 | list access or per-cell string mutation    |
+|       |                      | engine calls in stepper/fleet/serve hot    |
+|       |                      | functions — tokens stay on device)         |
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
@@ -210,6 +214,15 @@ RULE_INFO = {
         "tears the file) AND the graftchaos fault plane (so the chaos "
         "campaign cannot reach the failure path at all); append-mode "
         "streams are exempt",
+    ),
+    "GL023": (
+        "host-genome-in-hot-path",
+        "a host genome list access (`.cell_genomes` / `._genomes`) or a "
+        "per-cell string mutation engine call inside a stepper-, "
+        "fleet-, or serve-scoped hot function — genomes are "
+        "device-resident packed token arrays; decoding them (or running "
+        "the host string engine) on the hot path reintroduces the "
+        "per-cell host work the token backend exists to delete",
     ),
 }
 # the graftrace concurrency rules keep their metadata next to their
@@ -1468,6 +1481,91 @@ def check_gl018(ctx: Context):
                 )
 
 
+# --------------------------------------------------------------- GL023
+def _is_stepper_scoped(f) -> bool:
+    """A file is stepper-scoped when it IS the stepper module or imports
+    it — code that runs on (or rides along) the fused megastep's
+    dispatch/replay loop, where per-cell host work serializes the
+    pipeline."""
+    if f.path.stem == "stepper":
+        return True
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "stepper" in node.module.split("."):
+                return True
+            if any(a.name == "stepper" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("stepper" in a.name.split(".") for a in node.names):
+                return True
+    return False
+
+
+#: attribute names that resolve to a host genome string list; loading
+#: one in a hot function decodes the device token store (or walks the
+#: legacy list) cell by cell
+_GENOME_LIST_ATTRS = {"cell_genomes", "genomes", "_genomes", "_genomes_list"}
+#: the host string mutation engine's entry points — per-cell Python
+#: string work; hot paths use the token kernels instead
+_HOST_MUTATION_ENGINES = {"point_mutations", "recombinations"}
+
+
+def check_gl023(ctx: Context):
+    """Host genome work must not ride the hot path.  Genomes live on
+    device as packed token arrays; the string side (``.cell_genomes``,
+    the host mutation engine) is an import/export boundary.  In a hot
+    function of a stepper-, fleet-, or serve-scoped module, a genome
+    list load or a host-engine mutation call is per-cell host string
+    work on the step loop's critical path — the exact cost the token
+    backend deleted.  String-backend fallback sites waive with
+    ``# graftlint: disable=GL023``."""
+    fix = (
+        "keep genomes on device: use the GenomeStore token arrays and "
+        "the jitted mutation kernels (magicsoup_tpu.genomes); decode "
+        "through .cell_genomes only at the import/export boundary, or "
+        "waive a deliberate string-backend fallback with "
+        "`# graftlint: disable=GL023`"
+    )
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        if not (
+            _is_stepper_scoped(f)
+            or _is_fleet_scoped(f)
+            or _is_serve_scoped(f)
+        ):
+            continue
+        for node in ast.walk(rec.node):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in _GENOME_LIST_ATTRS
+            ):
+                yield _finding(
+                    "GL023",
+                    f,
+                    node,
+                    f"`.{node.attr}` in hot function `{rec.qualname}` "
+                    "loads the host genome string list — decoding the "
+                    "device token store per cell on the hot path",
+                    fix,
+                )
+            elif isinstance(node, ast.Call):
+                leaf = _attr_chain(node.func).rsplit(".", 1)[-1]
+                if leaf in _HOST_MUTATION_ENGINES:
+                    yield _finding(
+                        "GL023",
+                        f,
+                        node,
+                        f"`{leaf}()` in hot function `{rec.qualname}` "
+                        "runs the host string mutation engine per cell "
+                        "— use the jitted token kernels "
+                        "(point_mutations_tokens / "
+                        "recombinations_tokens)",
+                        fix,
+                    )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1491,6 +1589,7 @@ CHECKERS = {
     "GL020": dataflow.check_gl020,
     "GL021": dataflow.check_gl021,
     "GL022": dataflow.check_gl022,
+    "GL023": check_gl023,
 }
 
 

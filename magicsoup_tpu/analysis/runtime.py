@@ -155,17 +155,19 @@ def sanctioned_transfer(arr):
 _pheno_hits = 0
 _pheno_misses = 0
 _pheno_evictions = 0
+_pheno_pickle_drops = 0
 
 
 def note_phenotype_cache(
-    hits: int = 0, misses: int = 0, evictions: int = 0
+    hits: int = 0, misses: int = 0, evictions: int = 0, pickle_drops: int = 0
 ) -> None:
     """Accumulate phenotype-cache outcomes (called by the cache itself)."""
-    global _pheno_hits, _pheno_misses, _pheno_evictions
+    global _pheno_hits, _pheno_misses, _pheno_evictions, _pheno_pickle_drops
     with _lock:
         _pheno_hits += hits
         _pheno_misses += misses
         _pheno_evictions += evictions
+        _pheno_pickle_drops += pickle_drops
 
 
 def phenotype_cache_stats() -> dict[str, int]:
@@ -174,13 +176,37 @@ def phenotype_cache_stats() -> dict[str, int]:
     ``hits`` counts genome lookups served from cached entries (including
     within-batch duplicates after the first occurrence), ``misses``
     counts unique genomes that had to be translated, ``evictions``
-    counts LRU drops."""
+    counts LRU drops, ``pickle_drops`` counts entries dropped because a
+    cache was pickled (checkpoint/serve handoff) — a restored tenant
+    whose first steps miss-storm shows a matching ``pickle_drops`` spike
+    here instead of looking like an unexplained cold cache."""
     with _lock:
         return {
             "hits": _pheno_hits,
             "misses": _pheno_misses,
             "evictions": _pheno_evictions,
+            "pickle_drops": _pheno_pickle_drops,
         }
+
+
+# ----------------------------------------------------------------- #
+# genome decode counter                                             #
+# ----------------------------------------------------------------- #
+# fed by GenomeStore's token -> string export paths.  Decoding is the
+# sanctioned import/export boundary of the device-resident genome
+# store; a decode inside a hot loop (restack, steady-state megastep)
+# is host string work the token backend exists to delete, so tests pin
+# this counter flat across those windows.
+_genome_decode_calls = 0
+_genome_decode_rows = 0
+
+
+def note_genome_decode(rows: int = 0) -> None:
+    """Accumulate one token -> string decode of ``rows`` genome rows."""
+    global _genome_decode_calls, _genome_decode_rows
+    with _lock:
+        _genome_decode_calls += 1
+        _genome_decode_rows += rows
 
 
 # ----------------------------------------------------------------- #
@@ -256,11 +282,14 @@ def snapshot() -> dict[str, int]:
             "phenotype_hits": _pheno_hits,
             "phenotype_misses": _pheno_misses,
             "phenotype_evictions": _pheno_evictions,
+            "phenotype_pickle_drops": _pheno_pickle_drops,
             "restack_full": _restack_full,
             "restack_inserts": _restack_inserts,
             "restack_skipped": _restack_skipped,
             "attach_full": _attach_full,
             "attach_skipped": _attach_skipped,
+            "genome_decode_calls": _genome_decode_calls,
+            "genome_decode_rows": _genome_decode_rows,
         }
     out.update(_chaos.runtime_counters())
     return out
@@ -276,9 +305,10 @@ def reset_counters() -> None:
     underflows its budget math.
     """
     global _count, _cache_hits, _cache_misses
-    global _pheno_hits, _pheno_misses, _pheno_evictions
+    global _pheno_hits, _pheno_misses, _pheno_evictions, _pheno_pickle_drops
     global _restack_full, _restack_inserts, _restack_skipped
     global _attach_full, _attach_skipped
+    global _genome_decode_calls, _genome_decode_rows
     from magicsoup_tpu.guard import chaos as _chaos
 
     with _lock:
@@ -288,9 +318,12 @@ def reset_counters() -> None:
         _pheno_hits = 0
         _pheno_misses = 0
         _pheno_evictions = 0
+        _pheno_pickle_drops = 0
         _restack_full = 0
         _restack_inserts = 0
         _restack_skipped = 0
         _attach_full = 0
         _attach_skipped = 0
+        _genome_decode_calls = 0
+        _genome_decode_rows = 0
     _chaos.reset_counters()

@@ -120,6 +120,11 @@ def audit_world(world, *, sample: int = 8) -> list[InvariantViolation]:
         )
         n = min(n, cap)
 
+    # ---- token-store invariants (token genome backend only) -----------
+    store = getattr(world, "genome_store", None)
+    if store is not None:
+        violations += _audit_token_store(store, n, sample)
+
     pos = np.asarray(world.cell_positions)[:n]
     cell_map = np.asarray(world.cell_map)
 
@@ -235,6 +240,96 @@ def audit_world(world, *, sample: int = 8) -> list[InvariantViolation]:
             world, params, _sample_rows(n, sample), genomes
         )
     return violations
+
+
+def _audit_token_store(store, n: int, sample: int) -> list[InvariantViolation]:
+    """Packed-token invariants for the device genome store: length
+    ranges, PAD discipline beyond each genome and in dead rows, and a
+    sampled decode -> re-encode round trip.  These hold by construction
+    (every kernel normalizes PAD past the new length; compaction zeroes
+    evicted rows), so any hit means a kernel or scatter wrote outside
+    its mask."""
+    from magicsoup_tpu.genomes import PAD, decode_tokens, encode_genomes
+
+    out: list[InvariantViolation] = []
+    tok, lens = store.host_arrays()
+    tok = np.asarray(tok)
+    lens = np.asarray(lens)
+    cap, g = tok.shape
+    if n > cap:
+        out.append(
+            InvariantViolation(
+                "token_capacity",
+                f"n_cells={n} exceeds token store capacity {cap}",
+            )
+        )
+        n = cap
+    if (lens < 0).any() or (lens > g).any():
+        rows = np.nonzero((lens < 0) | (lens > g))[0]
+        out.append(
+            InvariantViolation(
+                "token_length_range",
+                f"{rows.size} rows hold lengths outside [0, {g}]",
+                rows=tuple(rows[:16].tolist()),
+            )
+        )
+        return out  # masks below would be nonsense
+    col = np.arange(g)
+    in_len = col[None, :] < lens[:, None]
+    bad_val = in_len & ((tok < 0) | (tok > 3))
+    if bad_val.any():
+        rows = np.nonzero(bad_val.any(axis=1))[0]
+        out.append(
+            InvariantViolation(
+                "token_range",
+                f"{rows.size} rows hold non-nucleotide tokens inside "
+                "their genome length",
+                rows=tuple(rows[:16].tolist()),
+            )
+        )
+    bad_pad = ~in_len & (tok != PAD)
+    if bad_pad.any():
+        rows = np.nonzero(bad_pad.any(axis=1))[0]
+        out.append(
+            InvariantViolation(
+                "token_pad_residue",
+                f"{rows.size} rows hold non-PAD bytes beyond their "
+                "genome length",
+                rows=tuple(rows[:16].tolist()),
+            )
+        )
+    if (lens[n:] != 0).any():
+        rows = n + np.nonzero(lens[n:] != 0)[0]
+        out.append(
+            InvariantViolation(
+                "token_dead_residue",
+                f"{rows.size} dead rows hold nonzero genome lengths",
+                rows=tuple(rows[:16].tolist()),
+            )
+        )
+    # sampled decode -> re-encode round trip (codec self-consistency)
+    if n and not out:
+        rows = _sample_rows(n, sample)
+        seqs = decode_tokens(tok[rows], lens[rows])
+        re_tok, re_lens = encode_genomes(seqs, length_cap=g)
+        if not (
+            np.array_equal(re_tok, tok[rows])
+            and np.array_equal(re_lens, lens[rows])
+        ):
+            bad = [
+                r
+                for k, r in enumerate(rows)
+                if not np.array_equal(re_tok[k], tok[r])
+            ]
+            out.append(
+                InvariantViolation(
+                    "token_roundtrip",
+                    f"{len(bad)} sampled rows fail the decode -> "
+                    "re-encode round trip",
+                    rows=tuple(bad[:16]),
+                )
+            )
+    return out
 
 
 def _cross_check_params(

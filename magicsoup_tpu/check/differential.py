@@ -19,6 +19,15 @@ host-side op implementations, and pinning the streams keeps a
 divergence report pointing at the device programs rather than at RNG
 consumption differences between drivers.
 
+A second axis crosses the first: every path re-runs with the world's
+genome backend flipped to device token arrays (``TOKEN_PATHS``).  The
+schedule's host-engine ops then operate through the string
+import/export boundary (``world.cell_genomes`` decodes the device
+store), so matching digests pin the packed-token storage bit-identical
+to the host string lists across spawn/mutate/kill/divide/compact —
+the contract that lets the token path replace the string path in hot
+loops.
+
 ``performance/smoke.py --differential`` gates on
 :func:`run_differential`; ``scripts/test.sh`` runs it after the unit
 tiers.  Import is numpy/stdlib-only; jax loads inside the entry points.
@@ -38,6 +47,22 @@ PATHS = ("classic", "k1", "k4", "mesh2")
 #: fleet has its own gating smoke); tests/fast/test_fleet.py pins these
 #: against the solo digests per boundary.
 FLEET_PATHS = ("fleet1", "fleet4")
+
+#: the token genome-backend axis: every base path re-run with the
+#: world's genomes held as device token arrays instead of host strings.
+#: ``token_fleet3`` drives the schedule world through a B=3 fleet (two
+#: companion token worlds share the group) — the ISSUE-pinned fleet
+#: shape.  A token path's digests must equal the string reference
+#: BIT-for-bit at every boundary: ``state_digest`` reads
+#: ``world.cell_genomes``, which in token mode decodes the device
+#: arrays, so a single byte of storage divergence forks the digest.
+TOKEN_PATHS = (
+    "token_classic",
+    "token_k1",
+    "token_k4",
+    "token_mesh2",
+    "token_fleet3",
+)
 
 #: chem-phase lengths between structural ops — multiples of 4 so the
 #: K=4 megastep divides every phase evenly
@@ -129,12 +154,13 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
     The stepper paths build a fresh chem-only stepper (selection
     disabled: the schedule owns all structural ops) and flush it, so
     the world is the source of truth again at the boundary."""
-    if path == "classic":
+    base = path[len("token_"):] if path.startswith("token_") else path
+    if base == "classic":
         world.step_many(n_steps)
         return
     import magicsoup_tpu as ms
 
-    k = 4 if path in ("k4", "fleet4") else 1
+    k = 4 if base in ("k4", "fleet4", "fleet3") else 1
     kwargs = dict(
         mol_name="dfx-atp",
         kill_below=-1.0,
@@ -148,16 +174,35 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         p_recombination=0.0,
     )
     assert n_steps % k == 0
-    if path in FLEET_PATHS:
+    if path in FLEET_PATHS or path == "token_fleet3":
         # B=1 fleet: same world, same kwargs, driven through the
-        # scheduler's stacked program — digests must not move a bit
+        # scheduler's stacked program — digests must not move a bit.
+        # token_fleet3 admits two companion token worlds alongside, so
+        # the schedule world steps from slot 0 of a B=3 group.
         from magicsoup_tpu.fleet import FleetScheduler
 
-        fleet = FleetScheduler(block=1)
+        fleet = FleetScheduler(block=4 if path == "token_fleet3" else 1)
         lane = fleet.admit(world, **kwargs)
+        companions = []
+        if path == "token_fleet3":
+            for j in range(2):
+                cw = ms.World(
+                    chemistry=world.chemistry,
+                    map_size=world.map_size,
+                    seed=1000 + j,
+                    genome_backend="token",
+                )
+                cw.deterministic = True
+                crng = random.Random(500 + j)
+                cw.spawn_cells(
+                    [ms.random_genome(s=200, rng=crng) for _ in range(4)]
+                )
+                companions.append(fleet.admit(cw, **kwargs))
         for _ in range(n_steps // k):
             fleet.step()
         fleet.flush()
+        for c in companions:
+            fleet.retire(c)
         fleet.retire(lane)
         return
     st = ms.PipelinedStepper(world, **kwargs)
@@ -180,19 +225,30 @@ def run_path(
     regression passes :func:`structural_digest` instead."""
     import magicsoup_tpu as ms
 
-    if path not in PATHS + FLEET_PATHS:
+    if path not in PATHS + FLEET_PATHS + TOKEN_PATHS:
         raise ValueError(
-            f"unknown path {path!r} (want one of {PATHS + FLEET_PATHS})"
+            f"unknown path {path!r} "
+            f"(want one of {PATHS + FLEET_PATHS + TOKEN_PATHS})"
         )
     if digest_fn is None:
         digest_fn = state_digest
+    backend = "string"
+    base = path
+    if path.startswith("token_"):
+        backend = "token"
+        base = path[len("token_"):]
     mesh = None
-    if path == "mesh2":
+    if base == "mesh2":
         from magicsoup_tpu.parallel import tiled
 
         mesh = tiled.make_mesh(2)
+    del base  # _chem_phase re-derives it from the full path name
     world = ms.World(
-        chemistry=_chemistry(), map_size=map_size, seed=seed, mesh=mesh
+        chemistry=_chemistry(),
+        map_size=map_size,
+        seed=seed,
+        mesh=mesh,
+        genome_backend=backend,
     )
     world.deterministic = True
     digests: list[str] = []

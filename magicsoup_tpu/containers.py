@@ -498,7 +498,9 @@ class Cell:
 
     Parameters:
         world: Originating :class:`World`.
-        genome: The cell's genome string.
+        genome: The cell's genome string; ``None`` defers to the world
+            (token-backed worlds then decode ONLY this cell's row on
+            first access instead of exporting the whole population).
         position: ``(x, y)`` pixel on the map.
         idx: The cell's current index.
         label: Free-form origin marker for tracking lineages.
@@ -513,7 +515,7 @@ class Cell:
     def __init__(
         self,
         world: "World",
-        genome: str,
+        genome: str | None = None,
         position: tuple[int, int] = (-1, -1),
         idx: int = -1,
         label: str = "C",
@@ -524,7 +526,7 @@ class Cell:
         ext_molecules: np.ndarray | None = None,
     ):
         self.world = world
-        self.genome = genome
+        self._genome = genome
         self.position = position
         self.idx = idx
         self.label = label
@@ -533,6 +535,18 @@ class Cell:
         self._proteome = proteome
         self._int_molecules = int_molecules
         self._ext_molecules = ext_molecules
+
+    @property
+    def genome(self) -> str:
+        """The genome string (fetched from the world on first access
+        when constructed lazily; token-backed worlds decode one row)."""
+        if self._genome is None:
+            self._genome = self.world.genome_of(self.idx)
+        return self._genome
+
+    @genome.setter
+    def genome(self, value: str) -> None:
+        self._genome = value
 
     @property
     def int_molecules(self) -> np.ndarray:

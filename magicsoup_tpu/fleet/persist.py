@@ -85,12 +85,16 @@ def _load(source) -> tuple[dict, dict]:
     return payload, meta
 
 
-def restore_world(source, index: int = 0, *, audit: bool = False) -> tuple:
+def restore_world(
+    source, index: int = 0, *, audit: bool = False, genome_backend=None
+) -> tuple:
     """Restore ONE world out of a fleet checkpoint as a standalone run;
     returns ``(world, stepper_aux, meta)`` exactly like
     :func:`magicsoup_tpu.guard.restore_run` — construct a stepper with
     the same kwargs and hand both to ``guard.restore_stepper`` (or keep
-    driving it with the classic API)."""
+    driving it with the classic API).  ``genome_backend`` converts the
+    restored world's genome storage (schema-1 string checkpoints resume
+    on the token path with ``genome_backend="token"``)."""
     payload, meta = _load(source)
     runs = payload["runs"]
     if not -len(runs) <= index < len(runs):
@@ -99,12 +103,19 @@ def restore_world(source, index: int = 0, *, audit: bool = False) -> tuple:
             "is out of range",
             check="index",
         )
-    world, aux = restore_run_payload(runs[index], audit=audit)
+    world, aux = restore_run_payload(
+        runs[index], audit=audit, genome_backend=genome_backend
+    )
     return world, aux, meta
 
 
 def restore_fleet(
-    source, scheduler, stepper_kwargs, *, audit: bool = False
+    source,
+    scheduler,
+    stepper_kwargs,
+    *,
+    audit: bool = False,
+    genome_backend=None,
 ) -> tuple[list, dict]:
     """Rebuild every world of a fleet checkpoint into ``scheduler``.
 
@@ -116,7 +127,9 @@ def restore_fleet(
     payload, meta = _load(source)
     lanes = []
     for i, run in enumerate(payload["runs"]):
-        world, aux = restore_run_payload(run, audit=audit)
+        world, aux = restore_run_payload(
+            run, audit=audit, genome_backend=genome_backend
+        )
         kwargs = (
             stepper_kwargs(i)
             if callable(stepper_kwargs)

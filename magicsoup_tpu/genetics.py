@@ -157,6 +157,24 @@ class Genetics:
         """
         return translate_genomes_flat(genomes, self.tables)
 
+    def translate_tokens_flat(
+        self, tokens, lengths
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """
+        Token-input translation path: host token rows (``(b, G)`` int8 in
+        the ``TCGA`` -> ``0..3`` code of :mod:`magicsoup_tpu.genomes`)
+        plus per-row lengths, translated through the same flat-buffer
+        engine as :meth:`translate_genomes_flat`.  The decode is the
+        string import/export boundary — device-resident paths only reach
+        it for phenotype-cache MISSES, so steady state translates from
+        tokens without per-cell string bookkeeping.
+        """
+        from magicsoup_tpu.genomes import decode_tokens
+
+        return translate_genomes_flat(
+            decode_tokens(tokens, lengths), self.tables
+        )
+
     def translate_genomes(self, genomes: list[str]) -> list[list[ProteinSpecType]]:
         """
         Translate all genomes into proteomes.
@@ -253,6 +271,48 @@ class PhenotypeCache:
         """Drop all entries (counters are kept)."""
         self._entries.clear()
 
+    def __getstate__(self) -> dict:
+        """Pickle WITHOUT the entries (cached rows would bloat saves) —
+        but record how many were dropped, so the restoring process's
+        :func:`~magicsoup_tpu.analysis.runtime.phenotype_cache_stats`
+        shows a ``pickle_drops`` spike explaining the first-step miss
+        storm instead of silently presenting a cold cache."""
+        state = self.__dict__.copy()
+        state["_entries"] = OrderedDict()
+        state["_pickle_dropped"] = len(self._entries)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        dropped = state.pop("_pickle_dropped", 0)
+        self.__dict__.update(state)
+        if dropped:
+            _note_phenotype_cache(pickle_drops=int(dropped))
+
+    def _translate_misses(self, genomes: list[str]) -> list[PhenotypeEntry]:
+        """Translate a batch of cache misses in ONE engine call and
+        build their entries (shared by the string- and token-key paths)."""
+        pc, prots, doms = self.genetics.translate_genomes_flat(genomes)
+        dom_counts = (
+            prots[:, 3] if len(prots) else np.zeros(0, dtype=np.int32)
+        )
+        p_offs = np.concatenate([[0], np.cumsum(pc)])
+        d_offs = np.concatenate([[0], np.cumsum(dom_counts)])
+        out: list[PhenotypeEntry] = []
+        for i in range(len(genomes)):
+            p0, p1 = int(p_offs[i]), int(p_offs[i + 1])
+            d0, d1 = int(d_offs[p0]), int(d_offs[p1])
+            out.append(
+                PhenotypeEntry(
+                    n_prots=p1 - p0,
+                    max_doms=(
+                        int(dom_counts[p0:p1].max()) if p1 > p0 else 0
+                    ),
+                    prots=np.ascontiguousarray(prots[p0:p1]),
+                    doms=np.ascontiguousarray(doms[d0:d1]),
+                )
+            )
+        return out
+
     # graftlint: hot
     def lookup(self, genomes: list[str]) -> list[PhenotypeEntry]:
         """Entries for ``genomes`` (one per input, duplicates aliased);
@@ -273,25 +333,7 @@ class PhenotypeCache:
                 self._entries.move_to_end(g)
                 entries[g] = e
         if misses:
-            pc, prots, doms = self.genetics.translate_genomes_flat(misses)
-            dom_counts = (
-                prots[:, 3]
-                if len(prots)
-                else np.zeros(0, dtype=np.int32)
-            )
-            p_offs = np.concatenate([[0], np.cumsum(pc)])
-            d_offs = np.concatenate([[0], np.cumsum(dom_counts)])
-            for i, g in enumerate(misses):
-                p0, p1 = int(p_offs[i]), int(p_offs[i + 1])
-                d0, d1 = int(d_offs[p0]), int(d_offs[p1])
-                e = PhenotypeEntry(
-                    n_prots=p1 - p0,
-                    max_doms=(
-                        int(dom_counts[p0:p1].max()) if p1 > p0 else 0
-                    ),
-                    prots=np.ascontiguousarray(prots[p0:p1]),
-                    doms=np.ascontiguousarray(doms[d0:d1]),
-                )
+            for g, e in zip(misses, self._translate_misses(misses)):
                 entries[g] = e
                 self._store(g, e)
         n_hits = len(genomes) - len(misses)
@@ -299,6 +341,55 @@ class PhenotypeCache:
         self.misses += len(misses)
         _note_phenotype_cache(hits=n_hits, misses=len(misses))
         return [entries[g] for g in genomes]
+
+    # graftlint: hot
+    def lookup_tokens(
+        self, tokens, lengths, idxs=None, hashes=None
+    ) -> list[PhenotypeEntry]:
+        """Token-path lookup: entries keyed by token-row CONTENT HASHES
+        (:func:`magicsoup_tpu.genomes.token_hashes`) instead of genome
+        strings.  Only cache MISSES decode their rows (the one string
+        boundary on this path); hits never materialize a string, so a
+        device-resident world's steady state translates straight from
+        token arrays.  ``idxs`` selects rows (all by default); pass
+        precomputed ``hashes`` to skip rehashing."""
+        from magicsoup_tpu.genomes import decode_tokens, token_hashes
+
+        tokens = np.asarray(tokens)
+        lengths = np.asarray(lengths)
+        idxs = list(range(len(lengths))) if idxs is None else list(idxs)
+        if hashes is None:
+            hashes = token_hashes(tokens, lengths, idxs)
+        entries: dict[bytes, PhenotypeEntry] = {}
+        miss_keys: list[bytes] = []
+        miss_rows: list[int] = []
+        seen: set[bytes] = set()
+        for i, h in zip(idxs, hashes):
+            if h in seen:
+                continue
+            seen.add(h)
+            e = self._entries.get(h)
+            if e is None:
+                miss_keys.append(h)
+                miss_rows.append(i)
+            else:
+                self._entries.move_to_end(h)
+                entries[h] = e
+        if miss_keys:
+            from magicsoup_tpu.genomes import _note_decode
+
+            genomes = decode_tokens(
+                tokens[miss_rows], lengths[miss_rows]
+            )
+            _note_decode(len(miss_rows))
+            for h, e in zip(miss_keys, self._translate_misses(genomes)):
+                entries[h] = e
+                self._store(h, e)
+        n_hits = len(hashes) - len(miss_keys)
+        self.hits += n_hits
+        self.misses += len(miss_keys)
+        _note_phenotype_cache(hits=n_hits, misses=len(miss_keys))
+        return [entries[h] for h in hashes]
 
     # graftlint: hot
     def dense_rows(

@@ -1,0 +1,797 @@
+"""Device-resident genomes: packed token arrays + jitted evolution kernels.
+
+The reference keeps genomes as host Python strings and runs every
+mutation/recombination round through the native engine — at 10k+ cells
+that host round trip sits on the hot path (ROADMAP item 1).  This module
+moves genomes onto the device as a fixed-capacity packed token tensor:
+
+* ``tokens`` — ``(cap, G)`` int8, one row per cell slot, capacity-padded
+  exactly like ``CellParams`` (pow2 slot capacity, cell-sharded on a
+  mesh).  ``G`` is the pow2 per-genome length capacity; positions past a
+  row's length hold :data:`PAD`.
+* ``lengths`` — ``(cap,)`` int32 per-row genome lengths.
+
+Token code ``i`` is nucleotide ``TOKEN_NTS[i]`` — the SAME ``TCGA`` →
+``0..3`` order the native translation engine uses (``_NT_CODE``), so a
+decoded row feeds ``Genetics`` without remapping.
+
+Evolution runs as jitted, PRNG-keyed kernels over those arrays:
+
+* :func:`point_mutations_tokens` — substitutions + indels in one fused
+  program.  Indels are a masked scatter: an exclusive cumulative
+  insert/delete offset per position maps every surviving source token to
+  its destination column (deleted tokens scatter out of bounds with
+  ``mode="drop"``; inserted bases land at their own offset column).
+* :func:`recombinations_tokens` — pairwise segment swap: a firing pair
+  draws one cut per strand and exchanges tails.  Rows touched by several
+  pairs resolve deterministically (a max-scatter picks the LAST firing
+  pair, matching the host engine's "update order, last wins").
+
+Both kernels are integer-only after the uniform draws (threefry bits,
+integer cumsums, gathers/scatters with unique destinations), so their
+trajectories are bit-reproducible across dispatches regardless of
+numeric mode; in deterministic mode the recombination fire probability
+additionally goes through :func:`ops.detmath.det_exp` so the one
+transcendental matches across backends.
+
+The kernels' mutation SEMANTICS intentionally match the host engine
+(per-bp event probability ``p``, indel fraction ``p_indel``, deletion
+fraction ``p_del``, uniform ``ACTG`` substitution that may silently
+redraw the same base) but their RNG streams are jax PRNG streams, not
+the engine's PCG64 — trajectories are pinned by the string-replay
+wrappers (:func:`point_mutations_strings` et al.), which run the SAME
+kernels so a token-backed and a string-backed world replaying them stay
+bit-identical (see ``check.differential`` token axes), and by the
+distribution sanity tests against the engine at matched rates.
+
+:class:`GenomeStore` owns the arrays for a World: all mutators are
+functional (they replace the arrays and bump a version counter), decoded
+string views and host token snapshots are cached per version, and
+per-row content hashes key the :class:`~magicsoup_tpu.genetics.
+PhenotypeCache` token path so translation is fed from device tokens.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magicsoup_tpu.ops.params import pad_idxs, pad_pow2
+
+
+def _note_decode(rows: int) -> None:
+    """Feed the analysis.runtime genome-decode counter (lazy import —
+    the counter module pulls in guard.chaos, which this module must not
+    load at import time)."""
+    from magicsoup_tpu.analysis import runtime as _runtime
+
+    _runtime.note_genome_decode(rows=rows)
+
+def _upload(arr, like):
+    """Explicitly place a small host operand next to ``like``
+    (replicated across its mesh when sharded).  Every operand of the
+    jitted store programs goes through here: implicit host->device
+    argument conversion is illegal under the steady-state
+    ``jax.transfer_guard("disallow")`` census, and an uncommitted
+    upload would silently re-replicate per dispatch on a mesh."""
+    if isinstance(like, jax.Array):
+        sharding = like.sharding
+        devices = sharding.device_set
+        if len(devices) == 1:
+            return jax.device_put(arr, next(iter(devices)))
+        return jax.device_put(
+            arr,
+            jax.sharding.NamedSharding(
+                sharding.mesh, jax.sharding.PartitionSpec()
+            ),
+        )
+    return jnp.asarray(arr)
+
+
+PAD = 4  # int8 fill value outside a row's live region (never a base)
+TOKEN_NTS = "TCGA"  # token code i <-> TOKEN_NTS[i]; matches engine _NT_CODE
+_MIN_G = 64  # minimum per-genome length capacity (pow2)
+_G_SLACK = 8  # regrow headroom: insertions may exceed G by a few bases
+
+_ENC = np.full(256, -1, dtype=np.int16)
+for _i, _c in enumerate(TOKEN_NTS.encode()):
+    _ENC[_c] = _i
+_DEC = np.frombuffer(TOKEN_NTS.encode(), dtype=np.uint8)
+
+
+# ------------------------------------------------------------------ #
+# host codec (the string import/export boundary)                     #
+# ------------------------------------------------------------------ #
+
+
+def length_capacity(max_len: int) -> int:
+    """The pow2 per-genome length capacity for a maximum genome length."""
+    return pad_pow2(max(int(max_len), 1), minimum=_MIN_G)
+
+
+def encode_genomes(
+    genomes: list[str], length_cap: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack genome strings into ``(tokens (b, G) int8, lengths (b,) int32)``.
+
+    ``G`` is ``length_cap`` or the pow2 capacity of the longest input.
+    Any byte outside ``TCGA`` raises ``ValueError`` — genomes are the
+    only alphabet the translation tables know, and a silent wrong code
+    would translate to a wrong (not absent) proteome.
+    """
+    n = len(genomes)
+    lengths = np.fromiter((len(g) for g in genomes), dtype=np.int32, count=n)
+    cap = length_capacity(int(lengths.max()) if n else 1)
+    if length_cap is not None:
+        if n and int(lengths.max()) > length_cap:
+            raise ValueError(
+                f"genome of length {int(lengths.max())} exceeds the"
+                f" requested length_cap={length_cap}"
+            )
+        cap = length_cap
+    tokens = np.full((n, cap), PAD, dtype=np.int8)
+    for i, g in enumerate(genomes):
+        if not g:
+            continue
+        raw = np.frombuffer(g.encode("ascii", "replace"), dtype=np.uint8)
+        row = _ENC[raw]
+        if (row < 0).any():
+            bad = g[int(np.argmax(row < 0))]
+            raise ValueError(
+                f"genome {i} contains non-TCGA byte {bad!r}; token"
+                " packing accepts only the TCGA nucleotide alphabet"
+            )
+        tokens[i, : len(row)] = row.astype(np.int8)
+    return tokens, lengths
+
+
+def decode_tokens(tokens: np.ndarray, lengths: np.ndarray) -> list[str]:
+    """Unpack host token rows back into genome strings (export boundary)."""
+    tokens = np.asarray(tokens)
+    return [
+        bytes(_DEC[tokens[i, : int(l)].astype(np.uint8)]).decode("ascii")
+        for i, l in enumerate(np.asarray(lengths))
+    ]
+
+
+def token_hashes(
+    tokens: np.ndarray, lengths: np.ndarray, idxs=None
+) -> list[bytes]:
+    """Per-row content hashes of the LIVE region (the token-path
+    phenotype-cache key: two rows with equal bases and length collide
+    regardless of slot, capacity padding, or ``G``)."""
+    tokens = np.asarray(tokens)
+    lengths = np.asarray(lengths)
+    rows = range(len(lengths)) if idxs is None else idxs
+    return [
+        hashlib.blake2b(
+            tokens[i, : int(lengths[i])].tobytes(), digest_size=16
+        ).digest()
+        for i in rows
+    ]
+
+
+# ------------------------------------------------------------------ #
+# jitted kernels                                                     #
+# ------------------------------------------------------------------ #
+
+
+@functools.partial(jax.jit, static_argnames=("det",))
+def _point_mutations_program(
+    tokens, lengths, live, key, p, p_indel, p_del, *, det: bool = False
+):
+    """Fused substitution+indel kernel.  Integer-only after the uniform
+    draws; every scatter destination is unique, so the program is
+    bit-reproducible (no ``det`` branch needed — the flag only keeps the
+    jit-cache identity aligned with the caller's numeric mode)."""
+    del det
+    cap, g = tokens.shape
+    ku, kk, kd, kb = jax.random.split(key, 4)
+    col = jnp.arange(g, dtype=jnp.int32)[None, :]
+    in_len = (col < lengths[:, None]) & live[:, None]
+
+    event = (jax.random.uniform(ku, (cap, g)) < p) & in_len
+    kind = jax.random.uniform(kk, (cap, g))
+    is_indel = event & (kind < p_indel)
+    is_sub = event & (kind >= p_indel)
+    dd = jax.random.uniform(kd, (cap, g))
+    is_del = is_indel & (dd < p_del)
+    is_ins = is_indel & (dd >= p_del)
+    base = jax.random.randint(kb, (cap, g), 0, 4, dtype=jnp.int8)
+
+    # substitutions first, at original coordinates (engine order); a draw
+    # equal to the current base is a silent substitution, as in the engine
+    mutated = jnp.where(is_sub, base, tokens)
+
+    # indel offsets: each destination column is `source + (#inserts
+    # before) - (#deletes before)`; an insertion lands at its own offset
+    # column and pushes its source token one further right
+    delta = is_ins.astype(jnp.int32) - is_del.astype(jnp.int32)
+    shift = jnp.cumsum(delta, axis=1) - delta  # exclusive cumsum
+    dst_src = col + shift + is_ins.astype(jnp.int32)
+    dst_ins = col + shift
+
+    keep = in_len & ~is_del
+    rows = jnp.arange(cap, dtype=jnp.int32)[:, None]
+    out = jnp.full((cap, g), np.int8(PAD))
+    out = out.at[rows, jnp.where(keep, dst_src, g)].set(
+        mutated, mode="drop"
+    )
+    out = out.at[rows, jnp.where(is_ins, dst_ins, g)].set(
+        base, mode="drop"
+    )
+
+    n_ins = is_ins.sum(axis=1, dtype=jnp.int32)
+    n_del = is_del.sum(axis=1, dtype=jnp.int32)
+    new_len = jnp.clip(lengths + n_ins - n_del, 0, g)
+    new_len = jnp.where(live, new_len, lengths)
+    out = jnp.where(col < new_len[:, None], out, np.int8(PAD))
+    changed = event.any(axis=1)
+    return out, new_len, changed
+
+
+@functools.partial(jax.jit, static_argnames=("det",))
+def _recombinations_program(
+    tokens, lengths, pair_a, pair_b, valid, key, log1mp, *, det: bool = False
+):
+    """Pairwise segment-swap kernel.  Each valid pair fires with
+    ``1 - (1-p)^(len_a + len_b)`` (one strand break over the combined
+    sequence, matching the host engine's per-bp break probability), draws
+    one cut per strand, and exchanges tails — total length is conserved
+    per pair, truncated only at the ``G`` capacity.  Rows touched by
+    several firing pairs resolve via a deterministic max-scatter: the
+    LAST firing pair wins, the same order the host engine's update list
+    applies."""
+    cap, g = tokens.shape
+    npairs = pair_a.shape[0]
+    kf, ka, kb = jax.random.split(key, 3)
+
+    la = jnp.where(valid, lengths[pair_a], 0)
+    lb = jnp.where(valid, lengths[pair_b], 0)
+    total = (la + lb).astype(jnp.float32)
+    if det:
+        from magicsoup_tpu.ops import detmath
+
+        miss = detmath.det_exp(total * log1mp)
+    else:
+        miss = jnp.exp(total * log1mp)
+    fire = (jax.random.uniform(kf, (npairs,)) >= miss) & valid
+
+    # one cut per strand, uniform over [0, len] inclusive
+    cut_a = jax.random.randint(ka, (npairs,), 0, la + 1, dtype=jnp.int32)
+    cut_b = jax.random.randint(kb, (npairs,), 0, lb + 1, dtype=jnp.int32)
+
+    # last firing pair wins each row: max-scatter of 1-based pair index
+    prio = jnp.where(fire, jnp.arange(npairs, dtype=jnp.int32) + 1, 0)
+    row_a = jnp.where(fire, pair_a, cap)
+    row_b = jnp.where(fire, pair_b, cap)
+    winner = jnp.zeros(cap + 1, dtype=jnp.int32)
+    winner = winner.at[row_a].max(prio, mode="drop")
+    winner = winner.at[row_b].max(prio, mode="drop")
+    write_a = fire & (winner[pair_a] == prio)
+    write_b = fire & (winner[pair_b] == prio)
+
+    col = jnp.arange(g, dtype=jnp.int32)[None, :]
+
+    def _swap(rows_keep, rows_tail, cut_keep, cut_tail, len_tail):
+        """head of `rows_keep` up to its cut + tail of `rows_tail` from
+        its cut, gathered in one pass."""
+        from_head = col < cut_keep[:, None]
+        src_row = jnp.where(from_head, rows_keep[:, None], rows_tail[:, None])
+        src_col = jnp.where(
+            from_head, col, col - cut_keep[:, None] + cut_tail[:, None]
+        )
+        out = tokens[
+            jnp.clip(src_row, 0, cap - 1), jnp.clip(src_col, 0, g - 1)
+        ]
+        new_len = jnp.clip(cut_keep + (len_tail - cut_tail), 0, g)
+        out = jnp.where(col < new_len[:, None], out, np.int8(PAD))
+        return out, new_len
+
+    new_a, len_a = _swap(pair_a, pair_b, cut_a, cut_b, lb)
+    new_b, len_b = _swap(pair_b, pair_a, cut_b, cut_a, la)
+
+    out_tokens = tokens.at[jnp.where(write_a, pair_a, cap), :].set(
+        new_a, mode="drop"
+    )
+    out_tokens = out_tokens.at[jnp.where(write_b, pair_b, cap), :].set(
+        new_b, mode="drop"
+    )
+    out_lengths = lengths.at[jnp.where(write_a, pair_a, cap)].set(
+        len_a, mode="drop"
+    )
+    out_lengths = out_lengths.at[jnp.where(write_b, pair_b, cap)].set(
+        len_b, mode="drop"
+    )
+    changed = jnp.zeros(tokens.shape[0], dtype=bool)
+    changed = changed.at[row_a].set(True, mode="drop")
+    changed = changed.at[row_b].set(True, mode="drop")
+    return out_tokens, out_lengths, changed
+
+
+@jax.jit
+def _set_rows_program(tokens, lengths, idxs, rows, lens):
+    """Scatter encoded rows into slots (OOB-padded idxs drop)."""
+    tokens = tokens.at[idxs, :].set(rows, mode="drop")
+    lengths = lengths.at[idxs].set(lens, mode="drop")
+    return tokens, lengths
+
+
+@jax.jit
+def _copy_rows_program(tokens, lengths, src, dst):
+    """Parent -> child row copies (division inheritance, zero decode)."""
+    tokens = tokens.at[dst, :].set(tokens[src.clip(0)], mode="drop")
+    lengths = lengths.at[dst].set(lengths[src.clip(0)], mode="drop")
+    return tokens, lengths
+
+
+@jax.jit
+def _permute_program(tokens, lengths, perm, n_keep):
+    """Apply a compaction permutation and PAD rows past ``n_keep``."""
+    tokens = tokens[perm]
+    lengths = lengths[perm]
+    row = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+    tokens = jnp.where((row < n_keep)[:, None], tokens, np.int8(PAD))
+    lengths = jnp.where(row < n_keep, lengths, 0)
+    return tokens, lengths
+
+
+def _as_key(seed: int | None) -> jax.Array:
+    if seed is None:
+        import random as _random
+
+        seed = _random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
+    return jax.random.PRNGKey(int(seed) & 0x7FFFFFFFFFFFFFFF)
+
+
+def point_mutations_tokens(
+    tokens,
+    lengths,
+    *,
+    p: float = 1e-6,
+    p_indel: float = 0.4,
+    p_del: float = 0.66,
+    seed: int | None = None,
+    live=None,
+    det: bool = False,
+):
+    """Jitted point mutations over a token array.  Returns
+    ``(tokens, lengths, changed)`` — full new arrays plus a ``(cap,)``
+    changed-row mask.  Rates arrive as traced scalars so sweeping them
+    never recompiles."""
+    if live is None:
+        live = jnp.ones(tokens.shape[0], dtype=bool)
+    elif not isinstance(live, jax.Array):
+        # host mask (callers hand in a bool ndarray) -> explicit upload
+        live = _upload(live, tokens)
+    return _point_mutations_program(
+        tokens,
+        lengths,
+        live,
+        _as_key(seed),
+        _upload(np.float32(p), tokens),
+        _upload(np.float32(p_indel), tokens),
+        _upload(np.float32(p_del), tokens),
+        det=det,
+    )
+
+
+def recombinations_tokens(
+    tokens,
+    lengths,
+    pairs,
+    *,
+    p: float = 1e-7,
+    seed: int | None = None,
+    det: bool = False,
+):
+    """Jitted pairwise recombination over a token array.  ``pairs`` is a
+    host ``(n, 2)`` row-index array (e.g. :func:`util.moore_pairs`
+    output); it is padded to an ``IDX_BLOCK`` multiple so pair-count
+    jitter between calls does not recompile.  Returns
+    ``(tokens, lengths, changed)``."""
+    pairs = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+    cap = tokens.shape[0]
+    n = len(pairs)
+    a = pad_idxs(pairs[:, 0], oob=cap)
+    b = pad_idxs(pairs[:, 1], oob=cap)
+    valid = np.zeros(len(a), dtype=bool)
+    valid[:n] = True
+    # log1p in float64 on host: the per-pair miss probability is then a
+    # single device exp of `total * log(1-p)`
+    log1mp = np.float32(np.log1p(-min(float(p), 1.0 - 1e-12)))
+    return _recombinations_program(
+        tokens,
+        lengths,
+        _upload(a, tokens),
+        _upload(b, tokens),
+        _upload(valid, tokens),
+        _as_key(seed),
+        _upload(log1mp, tokens),
+        det=det,
+    )
+
+
+# ------------------------------------------------------------------ #
+# string-replay wrappers (engine-shaped API over the same kernels)   #
+# ------------------------------------------------------------------ #
+
+
+def _encode_at_shape(
+    seqs: list[str], cap: int | None, length_cap: int | None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Encode ``seqs`` padded to an explicit ``(cap, G)`` shape.  The
+    kernels' PRNG draw shapes ARE ``(cap, G)`` — a string-side replay
+    only reproduces a token world's kernel call bit-for-bit when it runs
+    at the token world's exact array shape, so equivalence harnesses
+    pass the world's slot capacity and length cap here."""
+    tokens, lengths = encode_genomes(seqs, length_cap=length_cap)
+    if cap is not None and cap > len(seqs):
+        tokens = np.pad(
+            tokens,
+            ((0, cap - len(seqs)), (0, 0)),
+            constant_values=PAD,
+        )
+        lengths = np.pad(lengths, (0, cap - len(seqs)))
+    return tokens, lengths
+
+
+def point_mutations_strings(
+    seqs: list[str],
+    p: float = 1e-6,
+    p_indel: float = 0.4,
+    p_del: float = 0.66,
+    seed: int | None = None,
+    *,
+    cap: int | None = None,
+    length_cap: int | None = None,
+    det: bool = False,
+) -> list[tuple[str, int]]:
+    """:func:`mutations.point_mutations`-shaped wrapper over the token
+    kernel: encode, run the SAME jitted program, decode changed rows.
+    With ``cap``/``length_cap`` matching a token world's store shape, a
+    string-backed world replaying this sees bit-identical outcomes to
+    the token-backed world running the kernel directly — the
+    equivalence pin for the ``--genome`` smoke."""
+    if not seqs:
+        return []
+    tokens, lengths = _encode_at_shape(seqs, cap, length_cap)
+    live = np.zeros(tokens.shape[0], dtype=bool)
+    live[: len(seqs)] = True
+    out_t, out_l, changed = point_mutations_tokens(
+        tokens,
+        lengths,
+        p=p,
+        p_indel=p_indel,
+        p_del=p_del,
+        seed=seed,
+        live=jnp.asarray(live),
+        det=det,
+    )
+    from magicsoup_tpu.util import fetch_host
+
+    changed, host_t, host_l = (
+        np.asarray(a) for a in fetch_host((changed, out_t, out_l))
+    )
+    idxs = np.nonzero(changed[: len(seqs)])[0]
+    if not len(idxs):
+        return []
+    return [
+        (
+            bytes(_DEC[host_t[i, : host_l[i]].astype(np.uint8)]).decode(),
+            int(i),
+        )
+        for i in idxs
+    ]
+
+
+def recombinations_indexed_strings(
+    seqs: list[str],
+    pairs,
+    p: float = 1e-7,
+    seed: int | None = None,
+    *,
+    cap: int | None = None,
+    length_cap: int | None = None,
+    det: bool = False,
+) -> list[tuple[str, str, int]]:
+    """``engine.recombinations_indexed``-shaped wrapper over the token
+    kernel.  Same return shape — ``(genome_a, genome_b, pair_index)``
+    per pair touching a changed row; every entry for a given row carries
+    the kernel's FINAL row content, so applying them in any order
+    converges to the kernel state."""
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if not len(seqs) or not len(pairs):
+        return []
+    tokens, lengths = _encode_at_shape(seqs, cap, length_cap)
+    out_t, out_l, changed = recombinations_tokens(
+        tokens, lengths, pairs, p=p, seed=seed, det=det
+    )
+    from magicsoup_tpu.util import fetch_host
+
+    changed, host_t, host_l = (
+        np.asarray(a) for a in fetch_host((changed, out_t, out_l))
+    )
+
+    def _row(i: int) -> str:
+        return bytes(_DEC[host_t[i, : host_l[i]].astype(np.uint8)]).decode()
+
+    out = []
+    for k, (a, b) in enumerate(pairs):
+        if changed[a] or changed[b]:
+            out.append((_row(int(a)), _row(int(b)), int(k)))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# the device store                                                   #
+# ------------------------------------------------------------------ #
+
+
+class GenomeStore:
+    """Device-resident packed genomes for one World.
+
+    Owns the ``(cap, G)`` token tensor and ``(cap,)`` length vector.
+    Every mutator is functional — it replaces the arrays (placed through
+    the world's cell sharding, so mesh worlds keep genomes cell-sharded
+    like ``CellParams``) and bumps ``version``; the decoded string view,
+    the host token snapshot, and per-row hashes are caches keyed by that
+    version, so steady-state device evolution never decodes and a
+    repeated export decodes once.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        length_cap: int = _MIN_G,
+        place=None,
+    ):
+        self.capacity = int(capacity)
+        self.length_cap = length_capacity(length_cap)
+        self._place = place if place is not None else jnp.asarray
+        self.tokens = self._place(
+            np.full((self.capacity, self.length_cap), PAD, dtype=np.int8)
+        )
+        self.lengths = self._place(np.zeros(self.capacity, dtype=np.int32))
+        self.version = 0
+        self._decoded: tuple[int, list[str]] | None = None
+        self._host: tuple[int, np.ndarray, np.ndarray] | None = None
+
+    # -- placement / pickling ---------------------------------------- #
+
+    def place(self, place) -> None:
+        """(Re)bind the device placement callback and re-place the
+        arrays (used after unpickling and on mesh re-placement)."""
+        from magicsoup_tpu.util import fetch_host
+
+        self._place = place
+        tok, lens = fetch_host((self.tokens, self.lengths))
+        self.tokens = self._place(np.asarray(tok))
+        self.lengths = self._place(np.asarray(lens))
+
+    def __getstate__(self) -> dict:
+        from magicsoup_tpu.util import fetch_host
+
+        state = self.__dict__.copy()
+        state["tokens"] = np.asarray(fetch_host(self.tokens))
+        state["lengths"] = np.asarray(fetch_host(self.lengths))
+        state["_place"] = None
+        state["_decoded"] = None
+        state["_host"] = None
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._place = jnp.asarray
+        self.tokens = jnp.asarray(state["tokens"])
+        self.lengths = jnp.asarray(state["lengths"])
+
+    def clone(self) -> "GenomeStore":
+        """Array-SHARING copy (cheap: no device work).  Safe because
+        every mutator is functional — it replaces the arrays, never
+        writes in place — so the clone and the original diverge on first
+        write.  The stepper checks out a world's genomes this way:
+        attach performs zero decode/copy."""
+        new = GenomeStore.__new__(GenomeStore)
+        new.capacity = self.capacity
+        new.length_cap = self.length_cap
+        new._place = self._place
+        new.tokens = self.tokens
+        new.lengths = self.lengths
+        new.version = 0
+        new._decoded = None
+        new._host = None
+        return new
+
+    # -- cached host views ------------------------------------------- #
+
+    def _bump(self) -> None:
+        self.version += 1
+        self._decoded = None
+        self._host = None
+
+    def host_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """Host snapshot ``(tokens, lengths)`` (cached per version)."""
+        if self._host is None or self._host[0] != self.version:
+            from magicsoup_tpu.util import fetch_host
+
+            self._host = (
+                self.version,
+                np.asarray(fetch_host(self.tokens)),
+                np.asarray(fetch_host(self.lengths)),
+            )
+        return self._host[1], self._host[2]
+
+    def decoded(self, n: int) -> list[str]:
+        """The first ``n`` rows as genome strings (cached per version;
+        the export boundary — steady-state device paths never call it)."""
+        cached = self._decoded
+        if cached is not None and cached[0] == self.version and len(
+            cached[1]
+        ) == n:
+            return cached[1]
+        tok, lens = self.host_arrays()
+        out = decode_tokens(tok[:n], lens[:n])
+        _note_decode(n)
+        self._decoded = (self.version, out)
+        return out
+
+    def decode_row(self, i: int) -> str:
+        """One row as a genome string (per-cell inspection without the
+        whole-population export)."""
+        tok, lens = self.host_arrays()
+        _note_decode(1)
+        return decode_tokens(tok[i : i + 1], lens[i : i + 1])[0]
+
+    def hashes(self, idxs) -> list[bytes]:
+        """Content hashes for the given rows (phenotype-cache keys)."""
+        tok, lens = self.host_arrays()
+        return token_hashes(tok, lens, idxs)
+
+    def max_length(self) -> int:
+        _, lens = self.host_arrays()
+        return int(lens.max()) if len(lens) else 0
+
+    # -- mutators ------------------------------------------------------ #
+
+    def adopt(self, tokens, lengths) -> None:
+        """Replace the arrays wholesale (stepper flush hand-back)."""
+        self.capacity = int(tokens.shape[0])
+        self.length_cap = int(tokens.shape[1])
+        self.tokens = tokens
+        self.lengths = lengths
+        self._bump()
+
+    def set_all(self, genomes: list[str]) -> None:
+        """Reset the store to exactly these genomes (property setter)."""
+        n = len(genomes)
+        if n > self.capacity:
+            raise ValueError(
+                f"{n} genomes exceed the store capacity {self.capacity};"
+                " grow the world first"
+            )
+        rows, lens = encode_genomes(genomes) if n else (
+            np.zeros((0, self.length_cap), dtype=np.int8),
+            np.zeros(0, dtype=np.int32),
+        )
+        self.ensure_length_cap(rows.shape[1])
+        tokens = np.full(
+            (self.capacity, self.length_cap), PAD, dtype=np.int8
+        )
+        tokens[:n, : rows.shape[1]] = rows
+        lengths = np.zeros(self.capacity, dtype=np.int32)
+        lengths[:n] = lens
+        self.tokens = self._place(tokens)
+        self.lengths = self._place(lengths)
+        self._bump()
+
+    def set_rows(self, idxs, genomes: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Encode + scatter genomes into slots; returns the encoded
+        ``(rows, lens)`` so callers can hash/translate without a device
+        round trip (the string import boundary)."""
+        rows, lens = encode_genomes(genomes)
+        self.ensure_length_cap(rows.shape[1])
+        if rows.shape[1] < self.length_cap:
+            rows = np.pad(
+                rows,
+                ((0, 0), (0, self.length_cap - rows.shape[1])),
+                constant_values=PAD,
+            )
+        idxs_pad = pad_idxs(np.asarray(idxs, dtype=np.int64), oob=self.capacity)
+        b = len(idxs_pad)
+        rows_pad = np.full(
+            (b, self.length_cap), PAD, dtype=np.int8
+        )
+        rows_pad[: len(genomes)] = rows
+        lens_pad = np.zeros(b, dtype=np.int32)
+        lens_pad[: len(genomes)] = lens
+        self.tokens, self.lengths = _set_rows_program(
+            self.tokens,
+            self.lengths,
+            _upload(idxs_pad, self.tokens),
+            _upload(rows_pad, self.tokens),
+            _upload(lens_pad, self.tokens),
+        )
+        self._repin()
+        return rows, lens
+
+    def copy_rows(self, src, dst) -> None:
+        """Device parent->child copies (division; zero host work)."""
+        src_pad = pad_idxs(np.asarray(src, dtype=np.int64), oob=self.capacity)
+        dst_pad = pad_idxs(np.asarray(dst, dtype=np.int64), oob=self.capacity)
+        self.tokens, self.lengths = _copy_rows_program(
+            self.tokens,
+            self.lengths,
+            _upload(src_pad, self.tokens),
+            _upload(dst_pad, self.tokens),
+        )
+        self._repin()
+
+    def permute(self, perm, n_keep: int) -> None:
+        """Device compaction (kill path; zero host work)."""
+        self.tokens, self.lengths = _permute_program(
+            self.tokens,
+            self.lengths,
+            _upload(np.asarray(perm, dtype=np.int32), self.tokens),
+            _upload(np.int32(n_keep), self.tokens),
+        )
+        self._repin()
+
+    def apply(self, tokens, lengths) -> None:
+        """Install kernel outputs (mutation/recombination results)."""
+        self.tokens = tokens
+        self.lengths = lengths
+        self._repin()
+
+    def _repin(self) -> None:
+        """Keep mesh placement pinned after a jitted update (the
+        kernels' inferred out-shardings may differ) and invalidate the
+        per-version caches."""
+        if self._place is not jnp.asarray:
+            self.tokens = self._place(self.tokens)
+            self.lengths = self._place(self.lengths)
+        self._bump()
+
+    # -- capacity ------------------------------------------------------ #
+
+    def grow_capacity(self, capacity: int) -> None:
+        """Grow the slot axis to ``capacity`` (world capacity growth)."""
+        if capacity <= self.capacity:
+            return
+        tok, lens = self.host_arrays()
+        tokens = np.full(
+            (capacity, self.length_cap), PAD, dtype=np.int8
+        )
+        tokens[: self.capacity] = tok
+        lengths = np.zeros(capacity, dtype=np.int32)
+        lengths[: self.capacity] = lens
+        self.capacity = capacity
+        self.tokens = self._place(tokens)
+        self.lengths = self._place(lengths)
+        self._bump()
+
+    def ensure_length_cap(self, g: int) -> None:
+        """Grow the per-genome length axis to a pow2 >= ``g``.  Indel
+        drift regrows G BEFORE the live region reaches it (callers check
+        ``max_length()`` against ``length_cap - _G_SLACK``), so the
+        kernels' capacity truncation stays a never-hit backstop."""
+        if g <= self.length_cap:
+            return
+        new_g = length_capacity(g)
+        tok, lens = self.host_arrays()
+        tokens = np.full((self.capacity, new_g), PAD, dtype=np.int8)
+        tokens[:, : self.length_cap] = tok
+        self.length_cap = new_g
+        self.tokens = self._place(tokens)
+        self.lengths = self._place(lens)
+        self._bump()
+
+    def maybe_regrow(self) -> None:
+        """Regrow G when insertions drift the longest genome into the
+        slack band (one host scalar read per call — the caches make it
+        free when nothing changed)."""
+        if self.max_length() > self.length_cap - _G_SLACK:
+            self.ensure_length_cap(self.length_cap + _G_SLACK + 1)

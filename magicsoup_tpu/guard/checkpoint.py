@@ -3,7 +3,7 @@
 File format (``*.msck``)::
 
     b"MSCK\\n"                                   magic, 5 bytes
-    {"schema": 1, "payload_len": N,
+    {"schema": 2, "payload_len": N,
      "sha256": "...", "meta": {...}}\\n           one JSON header line
     <N payload bytes>                            pickle of the object
 
@@ -11,7 +11,10 @@ Every field exists to make loading REFUSE bad bytes instead of
 unpickling garbage into a live world:
 
 - the magic line rejects arbitrary files handed to the loader,
-- ``schema`` rejects checkpoints from an incompatible writer,
+- ``schema`` rejects checkpoints from an incompatible writer; schemas
+  older than the current one but listed in ``SUPPORTED_SCHEMAS`` load
+  through a typed migration chain instead (schema 1 wrote host-string
+  genome worlds — see :func:`_migrate_v1`),
 - ``payload_len`` catches truncation (a crash mid-copy, a partial
   download) before hashing,
 - ``sha256`` over the payload catches bit flips (the fault-injection
@@ -43,7 +46,73 @@ from magicsoup_tpu.guard.errors import CheckpointError
 from magicsoup_tpu.guard.io import atomic_write_bytes
 
 _MAGIC = b"MSCK\n"
-SCHEMA_VERSION = 1
+#: schema the writer stamps.  2 = device-resident genome era: World
+#: pickles carry ``genome_backend`` plus either the packed token store
+#: or the host string list.
+SCHEMA_VERSION = 2
+#: schemas the reader accepts; anything older than SCHEMA_VERSION
+#: passes through the typed migration chain in ``_MIGRATIONS``.
+SUPPORTED_SCHEMAS = frozenset({1, 2})
+
+
+def _payload_worlds(obj):
+    """Yield every World carried by a checkpoint payload: a bare World,
+    a ``guard.resume`` run payload (``{"world": ...}``), or a
+    ``fleet.persist`` payload nesting one run per world."""
+    if isinstance(obj, dict):
+        if "world" in obj:
+            yield obj["world"]
+        runs = obj.get("runs")
+        if isinstance(runs, (list, tuple)):
+            for run in runs:
+                if isinstance(run, dict) and "world" in run:
+                    yield run["world"]
+    elif hasattr(obj, "cell_genomes") and hasattr(obj, "n_cells"):
+        yield obj
+
+
+def _migrate_v1(obj, path):
+    """Schema 1 -> 2: v1 writers predate the device-resident genome
+    store — their worlds pickle genomes as a host ``cell_genomes``
+    string list with no ``genome_backend`` marker.
+    ``World.__setstate__`` adopts that legacy layout on unpickle
+    (string backend); the migration verifies each world actually landed
+    in a coherent v2 genome layout, so a damaged or foreign v1 payload
+    fails the typed ``migrate`` check HERE instead of deep inside a
+    resume.  Pass ``genome_backend="token"`` to the resume entry points
+    to continue a migrated run on the device-token path."""
+    for world in _payload_worlds(obj):
+        backend = getattr(world, "genome_backend", None)
+        if backend not in ("string", "token"):
+            raise CheckpointError(
+                f"checkpoint {path} failed the migrate check: schema 1 "
+                f"world did not normalize to a v2 genome layout "
+                f"(genome_backend={backend!r})",
+                check="migrate",
+                path=path,
+            )
+        try:
+            n = int(world.n_cells)
+            n_genomes = len(world.cell_genomes)
+        except Exception as exc:  # noqa: BLE001 - typed below
+            raise CheckpointError(
+                f"checkpoint {path} failed the migrate check: schema 1 "
+                f"world's genome state is unreadable: {exc}",
+                check="migrate",
+                path=path,
+            ) from exc
+        if n_genomes != n:
+            raise CheckpointError(
+                f"checkpoint {path} failed the migrate check: schema 1 "
+                f"world carries {n_genomes} genomes for n_cells={n}",
+                check="migrate",
+                path=path,
+            )
+    return obj
+
+
+#: schema N -> the migration that lifts a payload to schema N+1
+_MIGRATIONS = {1: _migrate_v1}
 
 
 def _pack(obj, meta: dict | None) -> bytes:
@@ -129,10 +198,11 @@ def read_checkpoint(path) -> tuple[object, dict]:
     BEFORE unpickling.  Returns ``(obj, meta)``."""
     path = Path(path)
     header, payload = _read_header(path)
-    if header["schema"] != SCHEMA_VERSION:
+    schema = header["schema"]
+    if schema not in SUPPORTED_SCHEMAS:
         raise CheckpointError(
             f"checkpoint {path} failed the version check: schema "
-            f"{header['schema']} != supported {SCHEMA_VERSION}",
+            f"{schema} not in supported {sorted(SUPPORTED_SCHEMAS)}",
             check="version",
             path=path,
         )
@@ -160,7 +230,12 @@ def read_checkpoint(path) -> tuple[object, dict]:
             check="unpickle",
             path=path,
         ) from exc
-    return obj, header.get("meta", {})
+    meta = header.get("meta", {})
+    if schema != SCHEMA_VERSION:
+        for v in range(schema, SCHEMA_VERSION):
+            obj = _MIGRATIONS[v](obj, path)
+        meta = {**meta, "migrated_from": schema}
+    return obj, meta
 
 
 class CheckpointManager:

@@ -180,15 +180,24 @@ def _remesh_world(world, mesh) -> None:
             for t in kin.params
         )
     )
+    if world._genome_store is not None:
+        world._genome_store.place(world._place_cells)
 
 
-def restore_run(source, *, mesh=None, audit: bool = False) -> tuple:
+def restore_run(
+    source, *, mesh=None, audit: bool = False, genome_backend=None
+) -> tuple:
     """Load a run checkpoint; returns ``(world, stepper_aux, meta)``.
 
     ``source`` is a :class:`CheckpointManager` (loads the newest
     verifiable snapshot, walking back over corrupt ones) or a path to a
     single ``.msck`` file.  Pass ``mesh`` to re-shard the restored world
-    (pickles are mesh-free by design).  ``stepper_aux`` is ``None`` for
+    (pickles are mesh-free by design).  Pass ``genome_backend`` to
+    continue the run on a specific genome storage path — the typed
+    entry for resuming a migrated schema-1 string checkpoint on the
+    device-token backend (``genome_backend="token"``); the conversion
+    is storage-only and trajectory-invisible in det mode (pinned by the
+    differential token axes).  ``stepper_aux`` is ``None`` for
     classic-driver checkpoints; otherwise construct a stepper with the
     SAME kwargs and hand both to :func:`restore_stepper`.
 
@@ -204,11 +213,15 @@ def restore_run(source, *, mesh=None, audit: bool = False) -> tuple:
         payload, meta, _path = source.load_latest()
     else:
         payload, meta = read_checkpoint(source)
-    world, aux = restore_run_payload(payload, mesh=mesh, audit=audit)
+    world, aux = restore_run_payload(
+        payload, mesh=mesh, audit=audit, genome_backend=genome_backend
+    )
     return world, aux, meta
 
 
-def restore_run_payload(payload, *, mesh=None, audit: bool = False) -> tuple:
+def restore_run_payload(
+    payload, *, mesh=None, audit: bool = False, genome_backend=None
+) -> tuple:
     """Restore a single run from an in-memory snapshot payload (the dict
     :func:`snapshot_run` produces); returns ``(world, stepper_aux)``.
 
@@ -231,6 +244,15 @@ def restore_run_payload(payload, *, mesh=None, audit: bool = False) -> tuple:
     world = payload["world"]
     if mesh is not None:
         _remesh_world(world, mesh)
+    if genome_backend is not None:
+        if genome_backend not in ("string", "token"):
+            raise CheckpointError(
+                f"unknown genome_backend {genome_backend!r} "
+                '(want "string" or "token")',
+                check="config",
+            )
+        if genome_backend != world.genome_backend:
+            world.convert_genome_backend(genome_backend)
     # classic resume: re-seat the world streams here (no stepper ctor
     # will draw from them); stepper resume re-seats in restore_stepper
     aux = payload["stepper"]

@@ -60,3 +60,31 @@ def recombinations(
     if seed is None:
         seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
     return _engine.recombinations(seq_pairs, p=p, seed=seed)
+
+
+def _lazy_genomes():
+    # the token kernels live in magicsoup_tpu.genomes and pull in jax;
+    # importing lazily keeps this module usable for pure host-string
+    # work (the engine above is jax-free)
+    from magicsoup_tpu import genomes
+
+    return genomes
+
+
+def point_mutations_tokens(tokens, lengths, **kwargs):
+    """Device-kernel counterpart of :func:`point_mutations` over packed
+    token arrays: one jitted program mutates the whole population in
+    place of the per-string host loop.  Returns
+    ``(tokens, lengths, changed)`` — see
+    :func:`magicsoup_tpu.genomes.point_mutations_tokens`."""
+    return _lazy_genomes().point_mutations_tokens(tokens, lengths, **kwargs)
+
+
+def recombinations_tokens(tokens, lengths, pairs, **kwargs):
+    """Device-kernel counterpart of :func:`recombinations` over packed
+    token arrays and an ``(n, 2)`` row-pair index array.  Returns
+    ``(tokens, lengths, changed)`` — see
+    :func:`magicsoup_tpu.genomes.recombinations_tokens`."""
+    return _lazy_genomes().recombinations_tokens(
+        tokens, lengths, pairs, **kwargs
+    )

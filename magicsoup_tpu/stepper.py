@@ -1329,8 +1329,13 @@ class PipelinedStepper:
         # evolution overlap runs on ALL backends (it calls only the C++
         # engine + numpy — none of the jax-client hazards that gate the
         # fetcher off CPU apply), so the CPU test tier exercises the
-        # exact threading the TPU path uses
-        if overlap_evolution:
+        # exact threading the TPU path uses.  Token-backed worlds run
+        # evolution INLINE instead: the compute half dispatches jitted
+        # device kernels, and jax dispatch from a second thread breaks
+        # the single-owner contract the ownership assertions pin — the
+        # kernels also remove the host latency the overlap existed to
+        # hide, so there is nothing left to overlap
+        if overlap_evolution and world._genome_store is None:
             import weakref
 
             self._evo_worker = _Worker("ms-stepper-evo")
@@ -1424,7 +1429,14 @@ class PipelinedStepper:
             w._positions_dev,
             w.kinetics,
             w.kinetics.params,
-            w.cell_genomes,
+            # token backend: the store's token ARRAY stands in for the
+            # genome list (every store mutator replaces it) — comparing
+            # the decoded view would force a whole-population export
+            (
+                w._genome_store.tokens
+                if w._genome_store is not None
+                else w._genomes_list  # graftlint: disable=GL023 identity probe only — no decode
+            ),
             w.cell_labels,
             w._np_positions,
             w._np_lifetimes,
@@ -1478,10 +1490,20 @@ class PipelinedStepper:
             n_rows=self._dev(w.n_cells, jnp.int32),
             key=key if mesh is None else jax.device_put(key, self._rep_sh),
         )
-        # host replay state (row-indexed, append-only between compactions)
-        self._genomes: list = list(w.cell_genomes) + [""] * (
-            self._cap - w.n_cells
-        )
+        # host replay state (row-indexed, append-only between compactions).
+        # Token-backed worlds keep genomes ON DEVICE: the stepper checks
+        # out an array-sharing clone of the world's store (no decode, no
+        # copy) and replays genome events with device programs; the host
+        # genome list stays None and every consumer branches on it.
+        if w._genome_store is not None:
+            self._token_store = w._genome_store.clone()
+            self._genomes = None
+        else:
+            self._token_store = None
+            # graftlint: disable=GL023 string-backend attach boundary
+            self._genomes = list(w.cell_genomes) + [""] * (
+                self._cap - w.n_cells
+            )
         self._labels: list = list(w.cell_labels) + [""] * (
             self._cap - w.n_cells
         )
@@ -2232,6 +2254,8 @@ class PipelinedStepper:
         # 0. spawns (allocation order matches the device: queue order)
         n_spawned = 0
         if spawn_genomes:
+            tok_rows: list[int] = []
+            tok_genomes: list[str] = []
             for i, (g, lab) in enumerate(
                 zip(spawn_genomes, spawn_labels)
             ):
@@ -2239,12 +2263,20 @@ class PipelinedStepper:
                     continue
                 row = self._n_rows + n_spawned
                 n_spawned += 1
-                self._genomes[row] = g
+                if self._token_store is not None:
+                    tok_rows.append(row)
+                    tok_genomes.append(g)
+                else:
+                    self._genomes[row] = g  # graftlint: disable=GL023 string-backend fallback
                 self._labels[row] = lab
                 self._lifetimes[row] = 0
                 self._divisions[row] = 0
                 self._positions[row] = spawn_pos[i]
                 self._alive[row] = True
+            if tok_rows:
+                # one batched encode+scatter per record (the string
+                # import boundary of the token replay)
+                self._token_store.set_rows(tok_rows, tok_genomes)
             self._n_rows += n_spawned
             self.stats["spawned"] += n_spawned
             self.stats["spawn_drops"] += len(spawn_genomes) - n_spawned
@@ -2259,11 +2291,19 @@ class PipelinedStepper:
         # DISPATCH; if the parent's genome changed in a replay since,
         # that copy is stale and the child needs its own push — without
         # it the child would keep the old phenotype forever.
-        repush: dict[int, str] = {}
+        # token mode: repush values are None — the store row IS the
+        # content, resolved hash-keyed at push-dispatch time
+        repush: dict[int, str | None] = {}
+        div_parents: list[int] = []
+        div_children: list[int] = []
         for i in range(n_placed):
             p = int(parents[i])
             row = self._n_rows + i
-            self._genomes[row] = self._genomes[p]
+            if self._token_store is not None:
+                div_parents.append(p)
+                div_children.append(row)
+            else:
+                self._genomes[row] = self._genomes[p]  # graftlint: disable=GL023 string-backend fallback
             self._labels[row] = self._labels[p]
             self._divisions[p] += 1
             self._divisions[row] = self._divisions[p]
@@ -2272,9 +2312,16 @@ class PipelinedStepper:
             self._positions[row] = child_pos[i]
             self._alive[row] = True
             if self._last_change[p] > change_seq:
-                repush[row] = self._genomes[row]
+                repush[row] = (
+                    None
+                    if self._token_store is not None
+                    else self._genomes[row]  # graftlint: disable=GL023 string-backend fallback
+                )
             else:
                 self._last_change[row] = self._last_change[p]
+        if div_children:
+            # parent->child genome copies stay on device
+            self._token_store.copy_rows(div_parents, div_children)
         self._n_rows += n_placed
         self.stats["divisions"] += n_placed
         self.stats["division_drops"] += out.n_candidates - out.n_attempted
@@ -2346,9 +2393,16 @@ class PipelinedStepper:
         self, out: StepOutputs, n_kills: int, n_divided: int, n_spawned: int
     ) -> dict:
         """One JSONL ``step`` row (schema: telemetry/summary.py)."""
-        lens = [
-            len(self._genomes[i]) for i in np.nonzero(self._alive)[0]
-        ]
+        if self._token_store is not None:
+            # length stats from the store's length vector (one cached
+            # host fetch per store version — no decode)
+            lens_arr = self._token_store.host_arrays()[1]
+            lens = lens_arr[np.nonzero(self._alive)[0]].tolist()
+        else:
+            lens = [
+                len(self._genomes[i])  # graftlint: disable=GL023 string-backend fallback
+                for i in np.nonzero(self._alive)[0]
+            ]
         n = len(lens)
         extra = {}
         if out.tile_occupancy is not None:
@@ -2379,7 +2433,10 @@ class PipelinedStepper:
         }
 
     def _apply_perm(self, perm: np.ndarray, n_keep: int) -> None:
-        self._genomes = [self._genomes[i] for i in perm]
+        if self._token_store is not None:
+            self._token_store.permute(perm, n_keep)
+        else:
+            self._genomes = [self._genomes[i] for i in perm]  # graftlint: disable=GL023 string-backend fallback
         self._labels = [self._labels[i] for i in perm]
         self._lifetimes = self._lifetimes[perm]
         self._divisions = self._divisions[perm]
@@ -2388,7 +2445,8 @@ class PipelinedStepper:
         self._alive = np.zeros(self._cap, dtype=bool)
         self._alive[:n_keep] = True
         for i in range(n_keep, self._cap):
-            self._genomes[i] = ""
+            if self._genomes is not None:  # graftlint: disable=GL023 string-backend fallback
+                self._genomes[i] = ""  # graftlint: disable=GL023 string-backend fallback
             self._labels[i] = ""
         self._lifetimes[n_keep:] = 0
         self._divisions[n_keep:] = 0
@@ -2417,7 +2475,8 @@ class PipelinedStepper:
                 pair_rows = rows[pairs_k]
                 seed = int(self._rng.integers(2**63))
                 for g0, g1, k in _engine.recombinations_indexed(
-                    self._genomes, pair_rows, p=self.p_recombination,
+                    self._genomes,  # graftlint: disable=GL023 string-backend fallback
+                    pair_rows, p=self.p_recombination,
                     seed=seed,
                 ):
                     r0, r1 = pair_rows[k]
@@ -2428,23 +2487,89 @@ class PipelinedStepper:
         # this round's recombinants without touching the shared list)
         if len(rows) and self.p_mutation > 0:
             seqs = [
-                changed.get(int(r), self._genomes[int(r)]) for r in rows
+                changed.get(int(r), self._genomes[int(r)])  # graftlint: disable=GL023 string-backend fallback
+                for r in rows
             ]
             seed = int(self._rng.integers(2**63))
-            for g, i in _engine.point_mutations(
+            for g, i in _engine.point_mutations(  # graftlint: disable=GL023 string-backend fallback
                 seqs, p=self.p_mutation, p_indel=self.p_indel,
                 p_del=self.p_del, seed=seed,
             ):
                 changed[int(rows[i])] = g
         return changed
 
-    def _submit_evolution(self, repush: dict[int, str]) -> None:
+    def _evolution_compute_tokens(
+        self, rows: np.ndarray, pos_rows: np.ndarray, repush_rows
+    ) -> list[int]:
+        """Token-mode evolution: the SAME phase as
+        :meth:`_evolution_compute`, but as two jitted kernel dispatches
+        over the device store instead of per-string host engine calls.
+        Runs on the main thread (no worker: jax dispatch is
+        single-owner) and returns the changed ROW indices — row content
+        lives in the store.  RNG draw order matches the string path
+        (recombination seed first, then mutation seed) so both backends
+        consume ``self._rng`` identically."""
+        from magicsoup_tpu import genomes as _genomes
+
+        store = self._token_store
+        changed_rows: set[int] = set(int(r) for r in repush_rows)
+        det = self.world.deterministic
+
+        if len(rows) > 1 and self.p_recombination > 0:
+            pairs_k = moore_pairs(pos_rows, self.world.map_size)
+            if len(pairs_k):
+                pair_rows = rows[pairs_k]
+                seed = int(self._rng.integers(2**63))
+                store.ensure_length_cap(
+                    _genomes.length_capacity(2 * store.max_length())
+                )
+                t, l, ch = _genomes.recombinations_tokens(
+                    store.tokens,
+                    store.lengths,
+                    pair_rows,
+                    p=self.p_recombination,
+                    seed=seed,
+                    det=det,
+                )
+                store.apply(t, l)
+                changed_rows.update(
+                    np.nonzero(_fetch_host(ch))[0].tolist()
+                )
+
+        if len(rows) and self.p_mutation > 0:
+            store.maybe_regrow()
+            live = np.zeros(store.capacity, dtype=bool)
+            live[rows] = True
+            seed = int(self._rng.integers(2**63))
+            t, l, ch = _genomes.point_mutations_tokens(
+                store.tokens,
+                store.lengths,
+                p=self.p_mutation,
+                p_indel=self.p_indel,
+                p_del=self.p_del,
+                seed=seed,
+                live=store._place(live),
+                det=det,
+            )
+            store.apply(t, l)
+            changed_rows.update(np.nonzero(_fetch_host(ch))[0].tolist())
+        return sorted(changed_rows)
+
+    def _submit_evolution(self, repush: dict[int, "str | None"]) -> None:
         """Kick off the evolution phase for the just-replayed state —
-        on the worker when overlap is on, inline otherwise."""
+        on the worker when overlap is on, inline otherwise.  Token mode
+        is always inline (main-thread kernel dispatches) and tracks
+        changed rows, not strings."""
         from functools import partial
 
         rows = np.nonzero(self._alive)[0]
         pos_rows = self._positions[rows]  # fancy indexing: already a copy
+        if self._token_store is not None:
+            changed_rows = self._evolution_compute_tokens(
+                rows, pos_rows, list(repush)
+            )
+            self._apply_evolution_rows(changed_rows)
+            return
         if self._evo_worker is not None:
             self._evo_future = self._evo_worker.submit(
                 partial(self._evolution_compute, rows, pos_rows, repush)
@@ -2464,6 +2589,25 @@ class PipelinedStepper:
         self._evo_future = None
         self._apply_evolution(fut.result(timeout=300.0))
 
+    def _apply_evolution_rows(self, changed_rows: list[int]) -> None:
+        """Token-mode apply half: the store already holds the new rows;
+        queue their hash-keyed phenotype refresh (genomes=None — content
+        is resolved from the store at push-dispatch time, so a row
+        changed twice naturally pushes its final content)."""
+        if not changed_rows:
+            return
+        self.stats["genome_changes"] += len(changed_rows)
+        self._change_seq += 1
+        self._last_change[changed_rows] = self._change_seq
+        if self._compact_outstanding:
+            self._push_buffer.append(
+                (None, list(changed_rows), self._change_seq)
+            )
+        else:
+            self._dispatch_push(
+                None, list(changed_rows), self._change_seq
+            )
+
     def _apply_evolution(self, changed: dict[int, str]) -> None:
         """The evolution phase's APPLY half (main thread only): write the
         changed genomes and queue their phenotype refresh.  Runs under
@@ -2471,7 +2615,7 @@ class PipelinedStepper:
         is in flight, the batch waits in the push buffer for its row
         permutation."""
         for r, g in changed.items():
-            self._genomes[r] = g
+            self._genomes[r] = g  # graftlint: disable=GL023 string-backend fallback
         if changed:
             self.stats["genome_changes"] += len(changed)
             rows_c = sorted(changed)
@@ -2498,15 +2642,25 @@ class PipelinedStepper:
         self._push_queue.append((genomes, rows, seq))
 
     def _apply_push_now(
-        self, genomes: list[str], rows: list[int], seq: int
+        self, genomes: "list[str] | None", rows: list[int], seq: int
     ) -> None:
         """Apply one refresh batch with its own standalone program (used
         for oversized bursts and at flush, when no step dispatch
-        follows)."""
-        entries = self.world.phenotypes.lookup(genomes)
+        follows).  ``genomes=None`` is the token-mode spelling: content
+        comes from the store, translated through the hash-keyed cache."""
+        entries = self._push_entries(genomes, rows)
         self.kin.set_cell_params_cached(rows, entries, self.world.phenotypes)
         self._dispatched_seq = max(self._dispatched_seq, seq)
         self.stats["pushes"] += 1
+
+    def _push_entries(self, genomes: "list[str] | None", rows: list[int]):
+        """Phenotype entries for one refresh batch — string-keyed lookup
+        on the string backend, hash-keyed token lookup (no decode unless
+        a row misses) on the token backend."""
+        if genomes is not None:
+            return self.world.phenotypes.lookup(genomes)
+        tokens, lengths = self._token_store.host_arrays()
+        return self.world.phenotypes.lookup_tokens(tokens, lengths, rows)
 
     def _take_ride_push(self):
         """Pop queued refreshes (in order) up to the fixed riding block
@@ -2534,15 +2688,21 @@ class PipelinedStepper:
             return None
         # duplicate rows across taken batches: the LAST queued genome
         # wins (dict update order) — one scatter with repeated indices
-        # would apply them in undefined order
-        merged: dict[int, str] = {}
+        # would apply them in undefined order.  Token batches (g=None)
+        # carry no content at all: the store row is already final, so
+        # merging is a plain row union
+        merged: dict[int, "str | None"] = {}
         top_seq = self._dispatched_seq
         for g, r, seq in taken:
-            merged.update(zip(r, g))
+            merged.update(zip(r, g) if g is not None else ((i, None) for i in r))
             top_seq = max(top_seq, seq)
         rows = sorted(merged)
-        genomes = [merged[r] for r in rows]
-        entries = self.world.phenotypes.lookup(genomes)
+        if self._token_store is not None:
+            entries = self._push_entries(None, rows)
+        else:
+            entries = self.world.phenotypes.lookup(
+                [merged[r] for r in rows]
+            )
         self._dispatched_seq = top_seq
         self.stats["pushes"] += 1
         return entries, rows
@@ -2768,7 +2928,15 @@ class PipelinedStepper:
 
         w = self.world
         w.n_cells = n_keep
-        w.cell_genomes = [self._genomes[i] for i in range(n_keep)]
+        if self._token_store is not None:
+            # hand the token arrays back wholesale — no decode, no
+            # encode; the world's store takes ownership of the arrays
+            # (functional updates make sharing safe)
+            w._genome_store.adopt(
+                self._token_store.tokens, self._token_store.lengths
+            )
+        else:
+            w.cell_genomes = [self._genomes[i] for i in range(n_keep)]  # graftlint: disable=GL023 string-backend flush boundary
         w.cell_labels = [self._labels[i] for i in range(n_keep)]
         w._np_positions = self._positions.copy()
         w._np_lifetimes = self._lifetimes.copy()

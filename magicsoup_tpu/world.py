@@ -437,6 +437,7 @@ class World:
         use_pallas: bool | None = None,
         phenotype_cache_size: int = 16384,
         telemetry=None,
+        genome_backend: str = "string",
     ):
         if seed is None:
             seed = random.SystemRandom().randrange(2**63)  # graftlint: disable=GL004 entropy only when the caller passed no seed
@@ -556,9 +557,22 @@ class World:
             _diff.degradation_factors([d.half_life for d in mols])
         )
 
+        # genome storage backend: "string" keeps the reference host list
+        # of genome strings; "token" keeps genomes device-resident as a
+        # packed (cap, G) int8 token tensor + length vector (GenomeStore),
+        # mutated by jitted kernels — strings then exist only at the
+        # import/export boundary (spawn/save/get_cell)
+        if genome_backend not in ("string", "token"):
+            raise ValueError(
+                f"genome_backend must be 'string' or 'token',"
+                f" got {genome_backend!r}"
+            )
+        self.genome_backend = genome_backend
+        self._genome_store = None
+
         # host-side state
         self.n_cells = 0
-        self.cell_genomes: list[str] = []
+        self._genomes_list: list[str] = []
         self.cell_labels: list[str] = []
         self._capacity = 0
         self._np_cell_map = np.zeros((map_size, map_size), dtype=bool)
@@ -589,6 +603,33 @@ class World:
     # ------------------------------------------------------------------ #
     # state views                                                        #
     # ------------------------------------------------------------------ #
+
+    @property
+    def cell_genomes(self) -> list[str]:
+        """Genome strings of all living cells.
+
+        String backend: the actual mutable host list.  Token backend: a
+        decoded EXPORT VIEW of the device token store, cached per store
+        version — cheap to re-read, but treat it as read-only (mutations
+        of the returned list are not written back; assign a full list or
+        use the ``update_cells``/``spawn_cells`` APIs instead).
+        """
+        if self._genome_store is not None:
+            return self._genome_store.decoded(self.n_cells)
+        return self._genomes_list
+
+    @cell_genomes.setter
+    def cell_genomes(self, value):
+        if self._genome_store is not None:
+            self._genome_store.set_all(list(value))
+        else:
+            self._genomes_list = list(value)
+
+    @property
+    def genome_store(self):
+        """The device :class:`~magicsoup_tpu.genomes.GenomeStore`
+        (token backend only; ``None`` on the string backend)."""
+        return self._genome_store
 
     @property
     def molecule_map(self) -> jax.Array:
@@ -775,6 +816,15 @@ class World:
         cm[: self._capacity] = _fetch_host(self._cell_molecules)
         self._cell_molecules = self._place_cells(cm)
         self._capacity = cap
+        if self.genome_backend == "token":
+            if self._genome_store is None:
+                from magicsoup_tpu.genomes import GenomeStore
+
+                self._genome_store = GenomeStore(
+                    cap, place=self._place_cells
+                )
+            else:
+                self._genome_store.grow_capacity(cap)
         self._sync_positions()
         self.kinetics.ensure_capacity(n_cells=cap)
         # capacity growth changes the activity program's shapes: the
@@ -839,12 +889,25 @@ class World:
         return Cell(
             world=self,
             idx=idx,
-            genome=self.cell_genomes[idx],
+            # token backend: defer to Cell.genome, which decodes ONE row
+            # instead of exporting the whole population
+            genome=(
+                None
+                if self._genome_store is not None
+                else self._genomes_list[idx]
+            ),
             position=tuple(self._np_positions[idx].tolist()),  # type: ignore
             label=self.cell_labels[idx],
             n_steps_alive=int(self._np_lifetimes[idx]),
             n_divisions=int(self._np_divisions[idx]),
         )
+
+    def genome_of(self, idx: int) -> str:
+        """One cell's genome string (token backend: decodes just that
+        row; string backend: a list index)."""
+        if self._genome_store is not None:
+            return self._genome_store.decode_row(idx)
+        return self._genomes_list[idx]
 
     def get_neighbors(
         self, cell_idxs: list[int], nghbr_idxs: list[int] | None = None
@@ -947,7 +1010,12 @@ class World:
         new_idxs = list(range(self.n_cells, self.n_cells + n_new))
         self._ensure_capacity(self.n_cells + n_new)
         self.n_cells += n_new
-        self.cell_genomes.extend(genomes)
+        if self._genome_store is not None:
+            # string import boundary: encode once; the encoded rows feed
+            # both the device scatter and the hash-keyed translation below
+            g_rows, g_lens = self._genome_store.set_rows(new_idxs, genomes)
+        else:
+            self._genomes_list.extend(genomes)
         self.cell_labels.extend(randstr(n=12, rng=self._rng) for _ in range(n_new))
 
         self._np_cell_map[free_pos[:, 0], free_pos[:, 1]] = True
@@ -970,7 +1038,10 @@ class World:
             jnp.asarray(valid),
         )
 
-        self._update_cell_params(genomes=genomes, idxs=new_idxs)
+        if self._genome_store is not None:
+            self._update_cell_params_rows(new_idxs, g_rows, g_lens)
+        else:
+            self._update_cell_params(genomes=genomes, idxs=new_idxs)
         return new_idxs
 
     def add_cells(self, cells: list[Cell]) -> list[int]:
@@ -994,8 +1065,13 @@ class World:
         new_idxs = list(range(self.n_cells, self.n_cells + n_new))
         self._ensure_capacity(self.n_cells + n_new)
         self.n_cells += n_new
+        if self._genome_store is not None:
+            g_rows, g_lens = self._genome_store.set_rows(
+                new_idxs, [d.genome for d in cells]
+            )
+        else:
+            self._genomes_list.extend(d.genome for d in cells)
         for cell in cells:
-            self.cell_genomes.append(cell.genome)
             self.cell_labels.append(cell.label)
 
         self._np_cell_map[free_pos[:, 0], free_pos[:, 1]] = True
@@ -1011,7 +1087,12 @@ class World:
             self._cell_molecules, jnp.asarray(idxs_pad), jnp.asarray(vals)
         )
 
-        self._update_cell_params(genomes=[d.genome for d in cells], idxs=new_idxs)
+        if self._genome_store is not None:
+            self._update_cell_params_rows(new_idxs, g_rows, g_lens)
+        else:
+            self._update_cell_params(
+                genomes=[d.genome for d in cells], idxs=new_idxs
+            )
         return new_idxs
 
     _MOORE_DX = np.array([-1, -1, -1, 0, 0, 1, 1, 1], dtype=np.int64)
@@ -1124,7 +1205,13 @@ class World:
         self._ensure_capacity(self.n_cells + n_new)
         self.n_cells += n_new
 
-        self.cell_genomes.extend([self.cell_genomes[d] for d in parent_idxs])
+        if self._genome_store is not None:
+            # parent->child copies stay on device: zero host string work
+            self._genome_store.copy_rows(parent_idxs, child_idxs)
+        else:
+            self._genomes_list.extend(
+                [self._genomes_list[d] for d in parent_idxs]
+            )
         self.cell_labels.extend([self.cell_labels[d] for d in parent_idxs])
 
         child_pos_arr = np.array(child_pos, dtype=np.int32)
@@ -1161,8 +1248,26 @@ class World:
         proteomes."""
         if len(genome_idx_pairs) == 0:
             return
+        if self._genome_store is not None:
+            genomes = [g for g, _ in genome_idx_pairs]
+            idxs_arr = np.asarray(
+                [i for _, i in genome_idx_pairs], dtype=np.int32
+            )
+            if len(np.unique(idxs_arr)) != len(idxs_arr):
+                # duplicate target slots must resolve last-wins BEFORE
+                # the device scatter (duplicate indices in one scatter
+                # have no defined order)
+                _, keep = np.unique(idxs_arr[::-1], return_index=True)
+                keep = np.sort(len(idxs_arr) - 1 - keep)
+                idxs_arr = idxs_arr[keep]
+                genomes = [genomes[i] for i in keep]
+            g_rows, g_lens = self._genome_store.set_rows(
+                idxs_arr.tolist(), genomes
+            )
+            self._update_cell_params_rows(idxs_arr, g_rows, g_lens)
+            return
         for genome, idx in genome_idx_pairs:
-            self.cell_genomes[idx] = genome
+            self._genomes_list[idx] = genome
         genomes, idxs = map(list, zip(*genome_idx_pairs))
         self._update_cell_params(genomes=genomes, idxs=idxs)  # type: ignore
 
@@ -1220,9 +1325,16 @@ class World:
         self._np_divisions[n_keep:] = 0
 
         kill_set = set(kill.tolist())
-        self.cell_genomes = [
-            g for i, g in enumerate(self.cell_genomes) if i not in kill_set
-        ]
+        if self._genome_store is not None:
+            # same compaction permutation as every other cell tensor,
+            # applied on device
+            self._genome_store.permute(perm, n_keep)
+        else:
+            self._genomes_list = [
+                g
+                for i, g in enumerate(self._genomes_list)
+                if i not in kill_set
+            ]
         self.cell_labels = [
             l for i, l in enumerate(self.cell_labels) if i not in kill_set
         ]
@@ -1481,6 +1593,34 @@ class World:
     ):
         """Point-mutate cell genomes, then update changed cells"""
         seed = int(self._nprng.integers(2**63))
+        if self._genome_store is not None:
+            from magicsoup_tpu import genomes as _genomes
+
+            store = self._genome_store
+            # regrow G before the live region reaches it, so the kernel's
+            # capacity truncation stays a never-hit backstop
+            store.maybe_regrow()
+            live = np.zeros(store.capacity, dtype=bool)
+            if cell_idxs is None:
+                live[: self.n_cells] = True
+            else:
+                live[np.asarray(cell_idxs, dtype=np.int64)] = True
+            tokens, lengths, changed = _genomes.point_mutations_tokens(
+                store.tokens,
+                store.lengths,
+                p=p,
+                p_indel=p_indel,
+                p_del=p_del,
+                seed=seed,
+                live=live,
+                det=self.deterministic,
+            )
+            store.apply(tokens, lengths)
+            changed_idx = np.nonzero(
+                _fetch_host(changed)[: self.n_cells]
+            )[0]
+            self._update_cell_params_tokens(changed_idx)
+            return
         if cell_idxs is None:
             seqs = self.cell_genomes
             mutated = _engine.point_mutations(
@@ -1500,6 +1640,31 @@ class World:
         cells."""
         pair_arr = self._neighbor_pairs(cell_idxs=cell_idxs)
         seed = int(self._nprng.integers(2**63))
+        if self._genome_store is not None:
+            from magicsoup_tpu import genomes as _genomes
+
+            store = self._genome_store
+            if len(pair_arr) == 0:
+                return
+            # a tail exchange can at most double a genome: pre-grow G so
+            # the kernel's capacity clamp stays a never-hit backstop
+            store.ensure_length_cap(
+                _genomes.length_capacity(2 * store.max_length())
+            )
+            tokens, lengths, changed = _genomes.recombinations_tokens(
+                store.tokens,
+                store.lengths,
+                pair_arr,
+                p=p,
+                seed=seed,
+                det=self.deterministic,
+            )
+            store.apply(tokens, lengths)
+            changed_idx = np.nonzero(
+                _fetch_host(changed)[: self.n_cells]
+            )[0]
+            self._update_cell_params_tokens(changed_idx)
+            return
         mutated = _engine.recombinations_indexed(
             self.cell_genomes, pair_arr, p=p, seed=seed
         )
@@ -1531,6 +1696,38 @@ class World:
             idxs_arr = idxs_arr[keep]
             genomes = [genomes[i] for i in keep]
         entries = self.phenotypes.lookup(genomes)
+        self._apply_phenotype_entries(idxs_arr, entries)
+
+    # graftlint: hot
+    def _update_cell_params_tokens(self, idxs):
+        """Param update for token-store rows already ON DEVICE (mutation
+        kernels' changed rows): one cached host fetch of the store, then
+        hash-keyed translation — no per-cell string appears unless a row
+        is a cache miss."""
+        idxs_arr = np.unique(np.asarray(idxs, dtype=np.int32))
+        if len(idxs_arr) == 0:
+            return
+        tokens, lengths = self._genome_store.host_arrays()
+        entries = self.phenotypes.lookup_tokens(
+            tokens, lengths, idxs_arr.tolist()
+        )
+        self._apply_phenotype_entries(idxs_arr, entries)
+
+    # graftlint: hot
+    def _update_cell_params_rows(self, idxs, rows, lens):
+        """Param update from freshly ENCODED host rows (the string import
+        boundary: spawn/add/update): hashes come straight from the
+        encoded rows, no device round trip."""
+        idxs_arr = np.asarray(idxs, dtype=np.int32)
+        if len(idxs_arr) == 0:
+            return
+        entries = self.phenotypes.lookup_tokens(rows, lens)
+        self._apply_phenotype_entries(idxs_arr, entries)
+
+    # graftlint: hot
+    def _apply_phenotype_entries(self, idxs_arr, entries):
+        """Shared tail of the param-update paths: unset empty proteomes,
+        grow token limits for the whole dispatch, chunked packing."""
         has_prots = np.fromiter(
             (e.n_prots > 0 for e in entries),
             dtype=bool,
@@ -1559,6 +1756,37 @@ class World:
     # persistence                                                        #
     # ------------------------------------------------------------------ #
 
+    def convert_genome_backend(self, backend: str) -> None:
+        """Switch genome storage in place.  ``'token'`` packs the host
+        string list into a device :class:`~magicsoup_tpu.genomes.GenomeStore`
+        (the checkpoint-migration path for string-era saves); ``'string'``
+        decodes back to the host list.  Phenotypes/kinetics are untouched —
+        both backends derive identical parameters from identical genomes."""
+        if backend not in ("string", "token"):
+            raise ValueError(
+                f"genome_backend must be 'string' or 'token',"
+                f" got {backend!r}"
+            )
+        if backend == self.genome_backend:
+            return
+        if backend == "token":
+            from magicsoup_tpu.genomes import GenomeStore
+
+            store = GenomeStore(
+                max(self._capacity, _MIN_CAPACITY),
+                place=self._place_cells,
+            )
+            store.set_all(self._genomes_list)
+            self._genome_store = store
+            self._genomes_list = []
+        else:
+            self._genomes_list = list(
+                self._genome_store.decoded(self.n_cells)
+            )
+            self._genome_store = None
+        self.genome_backend = backend
+        self._host_epoch += 1
+
     def __getstate__(self) -> dict:
         state = self.__dict__.copy()
         # device arrays -> numpy for portable pickles
@@ -1571,10 +1799,12 @@ class World:
         state.pop("_col_prefetch", None)
         state["_mm_cache"] = None
         state["_cm_cache"] = None
-        # the phenotype cache is runtime state: entries re-fill on demand
-        # and pickling cached rows would bloat saves — persist the knob only
-        state["phenotypes"] = None
-        state["_phenotype_cache_size"] = self.phenotypes.maxsize
+        # the phenotype cache pickles ITSELF entry-free (cached rows
+        # would bloat saves) and counts the dropped entries into
+        # analysis.runtime, so a restored process's first-step miss storm
+        # shows up as pickle_drops instead of looking unexplained; the
+        # genome store (token backend) likewise pickles its own device
+        # arrays as numpy
         # WarmScheduler pickles itself empty (thread handles are not
         # picklable; warm state is runtime-local)
         # meshes/shardings/devices are bound to live runtimes — a restored
@@ -1592,8 +1822,16 @@ class World:
         return state
 
     def __setstate__(self, state: dict):
+        # legacy pickles stored the genome list under the name that is
+        # now a property — route it to the backing attribute
+        legacy_genomes = state.pop("cell_genomes", None)
         self.__dict__.update(state)
         # compat defaults for pickles from before these attributes existed
+        self.__dict__.setdefault("genome_backend", "string")
+        self.__dict__.setdefault("_genome_store", None)
+        self.__dict__.setdefault("_genomes_list", [])
+        if legacy_genomes is not None:
+            self._genomes_list = list(legacy_genomes)
         self.__dict__.setdefault("use_pallas", False)
         self.__dict__.setdefault("deterministic", default_deterministic())
         self.__dict__.setdefault("_host_epoch", 0)
@@ -1649,6 +1887,8 @@ class World:
         self._diff_kernels = jnp.asarray(state["_diff_kernels"])
         self._perm_factors = jnp.asarray(state["_perm_factors"])
         self._degrad_factors = jnp.asarray(state["_degrad_factors"])
+        if self._genome_store is not None:
+            self._genome_store.place(self._place_cells)
         self._sync_positions()
 
     def save(self, rundir: Path, name: str = "world.pkl"):
@@ -1714,6 +1954,8 @@ class World:
             )
             obj._molecule_map = obj._place_map(obj._molecule_map)
             obj._cell_molecules = obj._place_cells(obj._cell_molecules)
+            if obj._genome_store is not None:
+                obj._genome_store.place(obj._place_cells)
             obj._sync_positions()
             obj._mm_cache = None
             obj._cm_cache = None
@@ -1775,8 +2017,8 @@ class World:
         with open(statedir / "cells.fasta", "r", encoding="utf-8") as fh:
             entries = [d.strip() for d in fh.read().split(">") if len(d.strip()) > 0]
 
-        self.cell_labels = []
-        self.cell_genomes = []
+        genomes: list[str] = []
+        labels: list[str] = []
         genome_idx_pairs: list[tuple[str, int]] = []
         for idx, entry in enumerate(entries):
             parts = entry.split("\n")
@@ -1784,13 +2026,17 @@ class World:
             seq = "" if len(parts) < 2 else parts[1]
             names = descr.split()
             label = names[1].strip() if len(names) > 1 else ""
-            self.cell_genomes.append(seq)
-            self.cell_labels.append(label)
+            genomes.append(seq)
+            labels.append(label)
             genome_idx_pairs.append((seq, idx))
+        self.cell_labels = labels
 
         n = len(genome_idx_pairs)
         self.n_cells = 0
         self._ensure_capacity(n)
+        # assign genomes AFTER the capacity grow: the token backend's
+        # setter scatters into store slots that must already exist
+        self.cell_genomes = genomes
         self.n_cells = n
         self._np_positions[:n] = positions
         self._np_positions[n:] = 0

@@ -1,0 +1,206 @@
+"""
+Micro-benchmarks of the genome ops on BOTH backends: the host string
+engine (per-string C++/Python loop) vs the packed device token kernels
+(`magicsoup_tpu.genomes`), at 1k / 8k / 40k cells with 1k-bp genomes.
+
+    python performance/genome_ops.py [--sizes 1000,8000,40000] [--s 1000]
+                                     [--r 5] [--json]
+
+Three ops per (size, backend) point:
+
+- ``mutate``       — point mutations over the whole population
+  (`mutations.point_mutations` vs `genomes.point_mutations_tokens`)
+- ``recombinate``  — strand-break recombination over n/2 neighbor pairs
+  (`mutations.recombinations` vs `genomes.recombinations_tokens`)
+- ``translate``    — the steady-state phenotype feed: a WARM
+  `PhenotypeCache` lookup keyed by genome strings vs token content
+  hashes (`lookup` vs `lookup_tokens`).  Misses are warmed untimed —
+  the timed number is the per-step translation feed cost, which is what
+  the evolution megastep pays after the first pass.
+
+Mutation rates are raised (``--p 1e-4``, break ``--pb 1e-5``) so every
+repeat does real work at 1k-bp genomes; both backends get the same
+rates.  Token kernels are warmed once per shape before timing (the jit
+compile is a one-off, not a per-op cost); timings block on VALUE
+fetches, matching `performance/check.py`.
+
+``--json`` streams one `check.py`-style JSON row per (op, size,
+backend) — seconds per op, LOWER is better — which
+`scripts/summarize_capture.py` folds from a ``genome_ops.log`` into
+BASELINE.json's ``published["genome_ops"]`` map.  Row parsing is pinned
+by tests/fast/test_bench_parsing.py.
+"""
+import json
+import random
+import statistics
+import sys
+import time
+from argparse import ArgumentParser
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _summary(tds: list[float]) -> str:
+    mu = statistics.fmean(tds)
+    sd = statistics.pstdev(tds)
+    return f"({mu:.4f}+-{sd:.4f})s"
+
+
+def result_row(
+    op: str,
+    tds: list[float],
+    n_cells: int,
+    genome_size: int,
+    backend: str,
+) -> dict:
+    """One (op, size, backend) measurement — seconds per op call, LOWER
+    is better.  Same keys as `performance/check.py:result_row` so the
+    capture tooling shares one parser; the ``backend`` field here is the
+    GENOME backend ("string" | "token"), not the jax platform, and the
+    metric prefix is ``genome_ops.`` so the two harnesses' rows can
+    never be confused in a merged log."""
+    return {
+        "metric": (
+            f"genome_ops.{op} ({n_cells} cells, {genome_size} nt,"
+            f" {backend})"
+        ),
+        "op": op,
+        "value": round(statistics.fmean(tds), 4),
+        "unit": "s",
+        "sd": round(statistics.pstdev(tds), 4),
+        "repeats": len(tds),
+        "n_cells": n_cells,
+        "genome_size": genome_size,
+        "backend": backend,
+    }
+
+
+def main() -> None:
+    ap = ArgumentParser()
+    ap.add_argument(
+        "--sizes", type=str, default="1000,8000,40000",
+        help="comma-separated cell counts",
+    )
+    ap.add_argument("--s", type=int, default=1_000, help="genome size")
+    ap.add_argument("--r", type=int, default=5, help="repeats")
+    ap.add_argument("--p", type=float, default=1e-4, help="mutation rate")
+    ap.add_argument(
+        "--pb", type=float, default=1e-5, help="strand-break rate"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="also print one JSON result line per (op, size, backend)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import apply_platform_pin
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    apply_platform_pin(jax)
+    ensure_compile_cache()
+
+    import numpy as np
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.genetics import Genetics, PhenotypeCache
+    from magicsoup_tpu.genomes import (
+        encode_genomes,
+        length_capacity,
+        point_mutations_tokens,
+        recombinations_tokens,
+    )
+
+    rng = random.Random(args.seed)
+    sizes = [int(x) for x in args.sizes.split(",") if x.strip()]
+    platform = jax.devices()[0].platform
+    print(
+        f"Benchmarking mutate, recombinate, translate — string vs token\n"
+        f"{sizes} cells, {args.s:,} genome size, on {platform}"
+    )
+
+    def emit(op: str, tds: list[float], n: int, backend: str) -> None:
+        print(f"{_summary(tds)} - {op} ({n:,} cells, {backend})")
+        if args.json:
+            print(
+                json.dumps(result_row(op, tds, n, args.s, backend)),
+                flush=True,
+            )
+
+    genetics = Genetics(seed=args.seed)
+
+    for n in sizes:
+        seqs = [ms.random_genome(s=args.s, rng=rng) for _ in range(n)]
+        cap = length_capacity(args.s)
+        tokens_np, lengths_np = encode_genomes(seqs, length_cap=cap)
+        tokens = jax.device_put(tokens_np)
+        lengths = jax.device_put(lengths_np)
+        pair_rows = list(range(n))
+        rng.shuffle(pair_rows)
+        pairs = np.asarray(pair_rows[: 2 * (n // 2)]).reshape(-1, 2)
+        seq_pairs = [(seqs[a], seqs[b]) for a, b in pairs]
+
+        # -- mutate
+        tds = []
+        for k in range(args.r):
+            t0 = time.perf_counter()
+            ms.point_mutations(seqs, p=args.p, seed=args.seed + k)
+            tds.append(time.perf_counter() - t0)
+        emit("mutate", tds, n, "string")
+
+        point_mutations_tokens(tokens, lengths, p=args.p, seed=0)  # warm
+        tds = []
+        for k in range(args.r):
+            t0 = time.perf_counter()
+            out_t, out_l, changed = point_mutations_tokens(
+                tokens, lengths, p=args.p, seed=args.seed + k
+            )
+            int(out_l[0]), int(out_t[0, 0])  # value fetch: block on result
+            tds.append(time.perf_counter() - t0)
+        emit("mutate", tds, n, "token")
+
+        # -- recombinate
+        tds = []
+        for k in range(args.r):
+            t0 = time.perf_counter()
+            ms.recombinations(seq_pairs, p=args.pb, seed=args.seed + k)
+            tds.append(time.perf_counter() - t0)
+        emit("recombinate", tds, n, "string")
+
+        recombinations_tokens(tokens, lengths, pairs, p=args.pb, seed=0)
+        tds = []
+        for k in range(args.r):
+            t0 = time.perf_counter()
+            out_t, out_l, changed = recombinations_tokens(
+                tokens, lengths, pairs, p=args.pb, seed=args.seed + k
+            )
+            int(out_l[0]), int(out_t[0, 0])
+            tds.append(time.perf_counter() - t0)
+        emit("recombinate", tds, n, "token")
+
+        # -- translate (warm steady-state phenotype feed)
+        cache = PhenotypeCache(genetics, maxsize=max(2 * n, 16_384))
+        cache.lookup(seqs)  # warm: misses translate once, untimed
+        tds = []
+        for _ in range(args.r):
+            t0 = time.perf_counter()
+            cache.lookup(seqs)
+            tds.append(time.perf_counter() - t0)
+        emit("translate", tds, n, "string")
+
+        cache = PhenotypeCache(genetics, maxsize=max(2 * n, 16_384))
+        cache.lookup_tokens(tokens_np, lengths_np)  # warm
+        tds = []
+        for _ in range(args.r):
+            t0 = time.perf_counter()
+            cache.lookup_tokens(tokens_np, lengths_np)
+            tds.append(time.perf_counter() - t0)
+        emit("translate", tds, n, "token")
+
+
+if __name__ == "__main__":
+    main()

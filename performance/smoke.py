@@ -57,6 +57,18 @@ service restarted on the same directory must re-adopt every tenant and
 finish the SAME request schedule with digests BIT-identical to the
 uninterrupted baseline's.
 
+``--genome`` runs the device-resident-genome smoke (GATING): a
+string-backed and a token-backed det-mode world drive the SAME seeded
+mutate -> recombinate -> translate -> divide schedule — the string world
+REPLAYS the token kernels at the token world's exact ``(cap, G)`` store
+shape (`genomes.point_mutations_strings` / `recombinations_indexed_strings`)
+so every boundary digest must be BIT-identical across backends; the token
+store must pass `check.audit_world` (PAD discipline, length range,
+round-trip); and a token-backed pipelined steady state must run under
+``hot_path_guard(compile_budget=0)`` with ZERO host genome decodes
+(`analysis.runtime` ``genome_decode_calls`` census — no per-cell string
+work on the megastep).
+
 ``--differential`` runs the graftcheck differential smoke (GATING): one
 seeded spawn/step/mutate/kill/divide/compact schedule driven through the
 classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
@@ -113,6 +125,8 @@ def main() -> None:
     )
     # graftfleet smoke (see fleet_main below)
     ap.add_argument("--fleet", action="store_true")
+    # device-resident-genome smoke (see genome_main below)
+    ap.add_argument("--genome", action="store_true")
     # graftwarden fault-isolation smoke (see fleet_chaos_main below)
     ap.add_argument("--fleet-chaos", action="store_true")
     # graftserve multi-tenant serving smoke (see serve_main below)
@@ -128,6 +142,8 @@ def main() -> None:
         return differential_main(args)
     if args.fleet:
         return fleet_main(args)
+    if args.genome:
+        return genome_main(args)
     if args.fleet_chaos:
         return fleet_chaos_main(args)
     if args.serve:
@@ -832,6 +848,206 @@ def fleet_main(args) -> None:
     )
     if problems:
         raise SystemExit("fleet smoke FAILED: " + "; ".join(problems))
+
+
+def genome_main(args) -> None:
+    """GATING device-resident-genome smoke.
+
+    Gates, in order: (1) a token-backed world and a string-backed world
+    driving the same seeded mutate -> recombinate -> translate -> divide
+    schedule — the string side replaying the token kernels at the token
+    store's exact ``(cap, G)`` shape — must produce BIT-identical state
+    digests at every boundary; (2) the token store must pass
+    ``check.audit_world`` afterwards; (3) a token-backed pipelined
+    steady state must hold ``hot_path_guard(compile_budget=0)`` with
+    ZERO host genome decodes across the measured megasteps.
+    """
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu import genomes as _genomes
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.check import audit_world
+    from magicsoup_tpu.check.differential import state_digest
+
+    mols = [
+        ms.Molecule("gen-a", 10e3),
+        ms.Molecule("gen-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+    def _world(backend):
+        w = ms.World(
+            chemistry=chem,
+            map_size=args.map_size,
+            seed=args.seed,
+            genome_backend=backend,
+        )
+        w.deterministic = True
+        rng = random.Random(99)  # same genomes on both backends
+        w.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        return w
+
+    ws = _world("string")
+    wt = _world("token")
+    problems = []
+    dig_s = [state_digest(ws)]
+    dig_t = [state_digest(wt)]
+
+    p_mut, p_rec = 5e-3, 5e-3
+    for r in range(3):
+        # -- mutate: token world runs the kernel natively; the string
+        # world replays it at the token store's exact (cap, G) shape
+        # with the SAME seed (both worlds share one ctor seed, so their
+        # _nprng streams are aligned draw for draw)
+        wt.mutate_cells(p=p_mut)
+        store = wt.genome_store
+        seed = int(ws._nprng.integers(2**63))
+        mutated = _genomes.point_mutations_strings(
+            list(ws.cell_genomes),
+            p=p_mut,
+            seed=seed,
+            cap=store.capacity,
+            length_cap=store.length_cap,
+            det=True,
+        )
+        ws.update_cells(genome_idx_pairs=mutated)
+        dig_s.append(state_digest(ws))
+        dig_t.append(state_digest(wt))
+
+        # -- recombinate: neighbor pairs derive from positions (equal on
+        # both worlds), seed from the shared stream; wt grows G BEFORE
+        # its kernel call, so the post-call shape IS the kernel shape
+        wt.recombinate_cells(p=p_rec)
+        pair_arr = ws._neighbor_pairs(cell_idxs=None)
+        seed = int(ws._nprng.integers(2**63))
+        recombined = _genomes.recombinations_indexed_strings(
+            list(ws.cell_genomes),
+            pair_arr,
+            p=p_rec,
+            seed=seed,
+            cap=store.capacity,
+            length_cap=wt.genome_store.length_cap,
+            det=True,
+        )
+        pairs = []
+        for c0, c1, idx in recombined:
+            a, b = pair_arr[idx]
+            pairs.append((c0, int(a)))
+            pairs.append((c1, int(b)))
+        ws.update_cells(genome_idx_pairs=pairs)
+        dig_s.append(state_digest(ws))
+        dig_t.append(state_digest(wt))
+
+        # -- translate + chem: kinetics from the updated params
+        ws.enzymatic_activity()
+        wt.enzymatic_activity()
+        dig_s.append(state_digest(ws))
+        dig_t.append(state_digest(wt))
+
+        # -- divide: shared pick, shared placement stream
+        idxs = sorted(
+            random.Random(1000 + r).sample(
+                range(wt.n_cells), wt.n_cells // 3
+            )
+        )
+        ws.divide_cells(cell_idxs=idxs)
+        wt.divide_cells(cell_idxs=idxs)
+        dig_s.append(state_digest(ws))
+        dig_t.append(state_digest(wt))
+
+    mismatch = [i for i, (a, b) in enumerate(zip(dig_s, dig_t)) if a != b]
+    if mismatch:
+        problems.append(
+            f"token/string digest mismatch at boundaries {mismatch}"
+            f" of {len(dig_s)}"
+        )
+    audit = audit_world(wt)
+    if audit:
+        problems.append(f"token store audit: {audit}")
+
+    # -- steady state: a token-backed pipelined run must hold a frozen
+    # compile census AND perform zero host genome decodes per megastep
+    wt2 = ms.World(
+        chemistry=chem,
+        map_size=args.map_size,
+        seed=args.seed + 1,
+        genome_backend="token",
+    )
+    wt2.deterministic = True
+    rng = random.Random(7)
+    wt2.spawn_cells(
+        [
+            ms.random_genome(s=args.genome_size, rng=rng)
+            for _ in range(args.n_cells)
+        ]
+    )
+    st = ms.PipelinedStepper(
+        wt2,
+        mol_name="gen-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=args.megastep,
+    )
+    for _ in range(args.warmup + 1):
+        st.step()
+    st.drain()
+    d0 = runtime.snapshot()["genome_decode_calls"]
+    try:
+        with runtime.hot_path_guard(compile_budget=0):
+            for _ in range(args.steps):
+                st.step()
+            st.drain()
+    except runtime.CompileBudgetExceeded as e:
+        problems.append(str(e))
+    decodes = runtime.snapshot()["genome_decode_calls"] - d0
+    if decodes:
+        problems.append(
+            f"{decodes} host genome decode(s) in the steady-state"
+            " megastep (want zero)"
+        )
+    st.flush()
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"genome smoke ({args.n_cells} cells, "
+                    f"{args.genome_size} nt, token vs string, cpu)"
+                ),
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "boundaries": len(dig_s),
+                "final_n_cells": wt.n_cells,
+                "steady_decodes": decodes,
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("genome smoke FAILED: " + "; ".join(problems))
 
 
 def fleet_chaos_main(args) -> None:

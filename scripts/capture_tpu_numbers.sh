@@ -125,6 +125,13 @@ run multichip       1800 python performance/mesh_sweep.py --devices 1,2,4,8 --pl
 # the graftfleet batch axis exists for.
 run fleet           1800 python performance/fleet_sweep.py --platform ''
 run check           1200 python performance/check.py
+# string engine vs device token kernels per (op, backend, size): one
+# JSON row per point that summarize_capture publishes under
+# published["genome_ops"] — the mutate/update >=3x-at-8k gate of the
+# device-resident-genome work is judged from THIS capture's token rows
+# (BENCH_NOTES.md: on XLA:CPU the dense-PRNG kernels lose to the
+# O(#mutations) host engine; the win is an accelerator lever)
+run genome_ops      1200 python performance/genome_ops.py --json
 
 echo "done; logs in $OUT" | tee -a "$OUT/capture.log"
 # (summarize + publish runs in the EXIT trap above, on success AND abort)

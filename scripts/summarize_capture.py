@@ -147,6 +147,22 @@ def summarize(outdir: Path) -> dict:
         for r in check_rows:
             ops[str(r["op"])] = r
         summary["check_ops"] = ops
+    # performance/genome_ops.py rows: one seconds-per-op measurement per
+    # (op, genome backend, cell count) point — keyed
+    # "{op}.{backend}.{n_cells}" so the string/token pair at each size
+    # stays side by side in BASELINE.json.  Same error-row rule as
+    # check.log: a failed point is an outcome, not a measurement
+    genome_rows = [
+        r
+        for r in _json_lines(outdir / "genome_ops.log")
+        if "op" in r and "backend" in r and "n_cells" in r
+        and "value" in r and "error" not in r
+    ]
+    if genome_rows:
+        gops: dict = {}
+        for r in genome_rows:
+            gops[f"{r['op']}.{r['backend']}.{r['n_cells']}"] = r
+        summary["genome_ops"] = gops
     # performance/mesh_sweep.py rows: one steps/s measurement per device
     # count (the MULTICHIP capture).  Last clean row per count wins;
     # error rows ({"error": "need 8 devices, have 1"}) are capture
@@ -244,6 +260,24 @@ def publish(summary: dict) -> None:
                 if (prev_v <= new_v) if lower_better else (prev_v >= new_v):
                     continue
             pub_ops[op] = {**entry, "capture_dir": summary["capture_dir"]}
+            merged = True
+    gops = summary.get("genome_ops")
+    if gops:
+        pub_gops = published.setdefault("genome_ops", {})
+        for point, entry in gops.items():
+            # per-(op, backend, size)-point best-value-wins; genome_ops
+            # rows are seconds per op (lower is better) like check_ops,
+            # with the same metric-match overwrite rule
+            prev = pub_gops.get(point)
+            if (
+                isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
+                and prev.get("value", 0) <= entry.get("value", 0)
+            ):
+                continue
+            pub_gops[point] = {
+                **entry, "capture_dir": summary["capture_dir"]
+            }
             merged = True
     multi = summary.get("multichip")
     if multi:

@@ -53,6 +53,16 @@ python performance/smoke.py --chaos
 # fleet_size lanes on every dispatch row.  Exits nonzero on any
 # violation.
 python performance/smoke.py --fleet
+# device-resident-genome smoke (GATING): a token-backed and a
+# string-backed det-mode world drive the same seeded
+# mutate -> recombinate -> translate -> divide schedule (the string
+# side REPLAYS the token kernels at the token store's exact (cap, G)
+# shape) — every boundary digest must be BIT-identical across
+# backends, the token store must pass check.audit_world, and a
+# token-backed pipelined steady state must hold
+# hot_path_guard(compile_budget=0) with ZERO host genome decodes.
+# Exits nonzero on any violation.
+python performance/smoke.py --genome
 # graftwarden fault-isolation smoke (GATING): a B=3 det fleet under
 # policy="heal" has one world NaN-poisoned mid-run — only that world
 # may be evicted, it must heal from its own rolling checkpoint stream,

@@ -23,6 +23,7 @@ def _load(name: str, rel: str):
 
 bench = _load("bench", "bench.py")
 check = _load("check", "performance/check.py")
+genome_ops = _load("genome_ops", "performance/genome_ops.py")
 summarize_capture = _load("summarize_capture", "scripts/summarize_capture.py")
 # both stdlib-pure by contract (loaded standalone, no jax/numpy):
 tsummary = _load("tsummary", "magicsoup_tpu/telemetry/summary.py")
@@ -363,6 +364,91 @@ def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
     assert pub(3.5)["spawn_cells"]["value"] == 3.5
     # ... and a slower later window does NOT degrade the record
     assert pub(4.5)["spawn_cells"]["value"] == 3.5
+
+
+def test_genome_ops_result_row_format():
+    # the per-(op, backend, size) JSON contract summarize_capture folds
+    # into BASELINE.json["published"]["genome_ops"]
+    row = genome_ops.result_row(
+        "mutate", [0.2, 0.4], n_cells=8_000,
+        genome_size=1_000, backend="token",
+    )
+    assert row["metric"] == "genome_ops.mutate (8000 cells, 1000 nt, token)"
+    assert row["op"] == "mutate"
+    assert row["value"] == 0.3
+    assert row["unit"] == "s"  # seconds per op: LOWER is better
+    assert row["sd"] == 0.1
+    assert row["repeats"] == 2
+    assert row["n_cells"] == 8_000
+    assert row["genome_size"] == 1_000
+    assert row["backend"] == "token"
+    # the row is a bench-driver result line too (metric + value)
+    assert bench._is_result_line(json.dumps(row))
+
+
+def _genome_row(
+    op: str, backend: str, n: int, value: float, **extra
+) -> str:
+    row = {
+        "metric": f"genome_ops.{op} ({n} cells, 1000 nt, {backend})",
+        "op": op,
+        "value": value,
+        "unit": "s",
+        "sd": 0.01,
+        "repeats": 3,
+        "n_cells": n,
+        "genome_size": 1_000,
+        "backend": backend,
+        **extra,
+    }
+    return json.dumps(row)
+
+
+def test_summarize_genome_ops_per_point_rows(tmp_path):
+    # keyed "{op}.{backend}.{n_cells}" so the string/token pair at each
+    # size sits side by side; last clean row per point wins, error rows
+    # never enter
+    (tmp_path / "genome_ops.log").write_text(
+        _genome_row("mutate", "string", 8_000, 1.2)
+        + "\n"
+        + _genome_row("mutate", "token", 8_000, 0.9)
+        + "\n"
+        + _genome_row("mutate", "token", 8_000, 0.3)
+        + "\n"
+        + _genome_row(
+            "translate", "token", 8_000, 0.0, error="backend not ready"
+        )
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    gops = summary["genome_ops"]
+    assert gops["mutate.string.8000"]["value"] == 1.2
+    assert gops["mutate.token.8000"]["value"] == 0.3  # last clean wins
+    assert "translate.token.8000" not in gops  # error row dropped
+
+
+def test_publish_genome_ops_lower_is_better(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(value: float) -> dict:
+        cap = tmp_path / f"cap-{value}"
+        cap.mkdir(exist_ok=True)
+        (cap / "genome_ops.log").write_text(
+            _genome_row("mutate", "token", 8_000, value) + "\n"
+        )
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        pub_map = json.loads(baseline.read_text())["published"]
+        return pub_map["genome_ops"]
+
+    assert pub(0.9)["mutate.token.8000"]["value"] == 0.9
+    # seconds are lower-is-better: 0.3 replaces 0.9 ...
+    assert pub(0.3)["mutate.token.8000"]["value"] == 0.3
+    # ... and a slower later window does NOT degrade the record
+    out = pub(0.6)
+    assert out["mutate.token.8000"]["value"] == 0.3
+    assert out["mutate.token.8000"]["capture_dir"].endswith("cap-0.3")
 
 
 def _telemetry_lines(phase_ms: list[float], *, bad_counter: bool = False) -> str:

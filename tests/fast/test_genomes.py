@@ -1,0 +1,360 @@
+"""
+Device-resident genome tests: token codec round-trip properties, the
+jitted mutation/recombination kernels' distribution sanity against the
+host string engine at matched rates, GenomeStore invariants (PAD
+discipline, capacity regrow, pickling), World backend equivalence and
+conversion, the schema-1 -> 2 checkpoint migration onto the token
+backend, the graftcheck token-store audit lanes, and the fleet
+no-decode census.
+"""
+import pickle
+import random
+
+import numpy as np
+
+import pytest
+
+import magicsoup_tpu as ms
+from magicsoup_tpu import genomes as G
+from magicsoup_tpu.util import random_genome
+
+_MA = ms.Molecule("gnm-test-a", 10 * 1e3, diffusivity=0.5, permeability=0.2)
+_MB = ms.Molecule("gnm-test-b", 8 * 1e3, half_life=100_000)
+_MOLS = [_MA, _MB]
+
+
+def _chem() -> ms.Chemistry:
+    return ms.Chemistry(molecules=_MOLS, reactions=[([_MA], [_MB])])
+
+
+def _world(**kwargs) -> ms.World:
+    defaults = {"chemistry": _chem(), "map_size": 16, "seed": 42}
+    defaults.update(kwargs)
+    return ms.World(**defaults)
+
+
+def _genomes(n: int, s: int, seed: int) -> list[str]:
+    rng = random.Random(seed)
+    return [random_genome(s=s, rng=rng) for _ in range(n)]
+
+
+# ------------------------------------------------------- codec properties
+def test_encode_decode_roundtrip_properties():
+    rng = random.Random(1)
+    # variable lengths, an empty genome, and one at exactly the cap
+    seqs = [random_genome(s=rng.randrange(0, 200), rng=rng) for _ in range(64)]
+    seqs[3] = ""
+    cap = G.length_capacity(max(len(s) for s in seqs))
+    seqs[7] = random_genome(s=cap, rng=rng)
+    tokens, lengths = G.encode_genomes(seqs, length_cap=cap)
+    assert tokens.shape == (len(seqs), cap) and tokens.dtype == np.int8
+    assert [int(x) for x in lengths] == [len(s) for s in seqs]
+    assert G.decode_tokens(tokens, lengths) == seqs
+    # PAD discipline: live region in 0..3, everything past a row's
+    # length is PAD exactly
+    col = np.arange(cap)[None, :]
+    in_len = col < lengths[:, None]
+    assert ((tokens >= 0) & (tokens <= 3))[in_len].all()
+    assert (tokens[~in_len] == G.PAD).all()
+
+
+def test_encode_rejects_non_tcga_and_oversize():
+    with pytest.raises(ValueError, match="non-TCGA"):
+        G.encode_genomes(["TCGX"])
+    with pytest.raises(ValueError, match="length_cap"):
+        G.encode_genomes(["T" * 100], length_cap=64)
+
+
+def test_length_capacity_is_pow2_with_floor():
+    assert G.length_capacity(1) == 64  # minimum rung
+    assert G.length_capacity(64) == 64
+    assert G.length_capacity(65) == 128
+    assert G.length_capacity(1000) == 1024
+
+
+def test_token_hashes_key_content_not_slot_or_capacity():
+    a, la = G.encode_genomes(["TCGA", "TTTT"], length_cap=64)
+    b, lb = G.encode_genomes(["GGGG", "TCGA", ""], length_cap=128)
+    ha = G.token_hashes(a, la)
+    hb = G.token_hashes(b, lb)
+    assert ha[0] == hb[1]  # same content, different slot AND capacity
+    assert ha[0] != ha[1]
+    assert hb[2] != hb[0]  # empty genome hashes distinctly
+
+
+# ------------------------------------------------------ kernel distribution
+def test_point_mutation_kernel_rate_matches_host_engine():
+    # lambda = 1 mutation per genome on both engines: the changed-row
+    # fraction must land in the same loose band as the host engine's
+    seqs = _genomes(400, 500, seed=2)
+    tokens, lengths = G.encode_genomes(seqs, length_cap=512)
+    _, _, changed = G.point_mutations_tokens(tokens, lengths, p=2e-3, seed=5)
+    frac_token = float(np.asarray(changed).mean())
+    frac_host = len(ms.point_mutations(seqs, p=2e-3, seed=5)) / len(seqs)
+    for frac in (frac_token, frac_host):
+        assert 0.5 < frac < 0.75  # ~63% expected, generous bounds
+    assert abs(frac_token - frac_host) < 0.15
+
+
+def test_point_mutation_kernel_indel_length_direction():
+    seqs = _genomes(200, 400, seed=3)
+    tokens, lengths = G.encode_genomes(seqs, length_cap=512)
+    # all deletions -> lengths shrink on every changed row
+    _, dl, dc = G.point_mutations_tokens(
+        tokens, lengths, p=1e-2, p_indel=1.0, p_del=1.0, seed=11
+    )
+    dl, dc = np.asarray(dl), np.asarray(dc)
+    assert dc.sum() > 150
+    assert (dl[dc] < lengths[dc]).all()
+    # all insertions -> lengths grow (capacity-clamped, never above G)
+    _, il, ic = G.point_mutations_tokens(
+        tokens, lengths, p=1e-2, p_indel=1.0, p_del=0.0, seed=11
+    )
+    il, ic = np.asarray(il), np.asarray(ic)
+    assert (il[ic] > lengths[ic]).all()
+    assert (il <= 512).all()
+    # substitutions only -> lengths identical
+    _, sl, _ = G.point_mutations_tokens(
+        tokens, lengths, p=1e-2, p_indel=0.0, seed=11
+    )
+    assert np.array_equal(np.asarray(sl), lengths)
+
+
+def test_point_mutation_kernel_seed_determinism():
+    seqs = _genomes(50, 200, seed=4)
+    tokens, lengths = G.encode_genomes(seqs, length_cap=256)
+    a = G.point_mutations_tokens(tokens, lengths, p=1e-2, seed=9)
+    b = G.point_mutations_tokens(tokens, lengths, p=1e-2, seed=9)
+    c = G.point_mutations_tokens(tokens, lengths, p=1e-2, seed=10)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+    assert not all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, c)
+    )
+
+
+def test_recombination_kernel_conserves_pair_length():
+    seqs = _genomes(200, 100, seed=5)
+    tokens, lengths = G.encode_genomes(seqs, length_cap=256)
+    pairs = np.arange(200, dtype=np.int64).reshape(-1, 2)
+    _, out_l, changed = G.recombinations_tokens(
+        tokens, lengths, pairs, p=1e-2, seed=13
+    )
+    out_l, changed = np.asarray(out_l), np.asarray(changed)
+    assert changed.sum() > 100  # ~86% of pairs fire at p=1e-2 over 200 bp
+    for a, b in pairs:
+        assert out_l[a] + out_l[b] == lengths[a] + lengths[b]
+    # untouched rows keep their exact content
+    assert (out_l[~changed] == lengths[~changed]).all()
+
+
+def test_string_replay_wrapper_is_deterministic_and_kernel_backed():
+    # the --genome smoke's equivalence pin rests on this wrapper running
+    # the SAME kernel at an explicit (cap, G) shape
+    seqs = _genomes(30, 150, seed=6)
+    r1 = G.point_mutations_strings(
+        seqs, p=1e-2, seed=21, cap=64, length_cap=256, det=True
+    )
+    r2 = G.point_mutations_strings(
+        seqs, p=1e-2, seed=21, cap=64, length_cap=256, det=True
+    )
+    assert r1 == r2 and len(r1) > 0
+    assert all(0 <= i < len(seqs) for _, i in r1)
+    # a different cap is a different PRNG draw shape -> different stream
+    r3 = G.point_mutations_strings(
+        seqs, p=1e-2, seed=21, cap=128, length_cap=256, det=True
+    )
+    assert r1 != r3
+
+
+# ------------------------------------------------------------- GenomeStore
+def test_store_set_rows_and_decode_roundtrip():
+    store = G.GenomeStore(capacity=16)
+    seqs = _genomes(10, 120, seed=7)
+    store.set_rows(list(range(10)), seqs)
+    assert store.decoded(10) == seqs
+    assert store.decode_row(3) == seqs[3]
+    # dead rows stay zero-length PAD rows
+    host_t, host_l = store.host_arrays()
+    assert (host_l[10:] == 0).all()
+    assert (host_t[10:] == G.PAD).all()
+
+
+def test_store_copy_rows_permute_and_regrow():
+    store = G.GenomeStore(capacity=8)
+    seqs = _genomes(4, 100, seed=8)
+    store.set_rows([0, 1, 2, 3], seqs)
+    store.copy_rows([0, 2], [4, 5])  # division inheritance
+    assert store.decoded(6) == seqs + [seqs[0], seqs[2]]
+    # compaction: keep rows 1, 4, 5 in that order
+    perm = np.array([1, 4, 5, 0, 2, 3, 6, 7])
+    store.permute(perm, n_keep=3)
+    assert store.decoded(3) == [seqs[1], seqs[0], seqs[2]]
+    host_t, host_l = store.host_arrays()
+    assert (host_l[3:] == 0).all() and (host_t[3:] == G.PAD).all()
+    # growth along both axes preserves content
+    store.grow_capacity(32)
+    store.ensure_length_cap(512)
+    assert store.capacity == 32 and store.length_cap == 512
+    assert store.decoded(3) == [seqs[1], seqs[0], seqs[2]]
+
+
+def test_store_pickle_roundtrip_and_clone_shares_arrays():
+    store = G.GenomeStore(capacity=8)
+    seqs = _genomes(5, 80, seed=9)
+    store.set_rows(list(range(5)), seqs)
+    clone = store.clone()
+    assert clone.decoded(5) == seqs
+    restored = pickle.loads(pickle.dumps(store))
+    assert restored.decoded(5) == seqs
+    assert restored.capacity == store.capacity
+    # the clone shares device arrays until a mutator bumps it apart
+    clone.set_rows([5], ["TCGA"])
+    assert store.decoded(5) == seqs  # original unaffected
+
+
+# ------------------------------------------------------------ World layer
+def test_world_token_backend_matches_string_backend():
+    from magicsoup_tpu.check.differential import state_digest
+
+    seqs = _genomes(12, 150, seed=10)
+    ws = _world(genome_backend="string")
+    wt = _world(genome_backend="token")
+    for w in (ws, wt):
+        w.deterministic = True
+        w.spawn_cells(seqs)
+    assert list(wt.cell_genomes) == list(ws.cell_genomes)
+    assert state_digest(ws) == state_digest(wt)
+    # identical structural churn stays identical (storage equivalence)
+    pairs = [(seqs[0][:100], 2), (seqs[1] + "TCGA", 5)]
+    ws.update_cells(genome_idx_pairs=pairs)
+    wt.update_cells(genome_idx_pairs=pairs)
+    ws.divide_cells(cell_idxs=[0, 3])
+    wt.divide_cells(cell_idxs=[0, 3])
+    ws.kill_cells(cell_idxs=[1, 4])
+    wt.kill_cells(cell_idxs=[1, 4])
+    assert list(wt.cell_genomes) == list(ws.cell_genomes)
+    assert state_digest(ws) == state_digest(wt)
+
+
+def test_world_convert_genome_backend_roundtrip():
+    seqs = _genomes(8, 120, seed=11)
+    w = _world(genome_backend="string")
+    w.spawn_cells(seqs)
+    w.convert_genome_backend("token")
+    assert w.genome_backend == "token" and w.genome_store is not None
+    assert list(w.cell_genomes) == seqs
+    w.convert_genome_backend("string")
+    assert w.genome_backend == "string" and w.genome_store is None
+    assert list(w.cell_genomes) == seqs
+    with pytest.raises(ValueError, match="genome_backend"):
+        w.convert_genome_backend("parquet")
+
+
+def test_world_token_mutate_cells_seeded_and_updates_params():
+    def _run():
+        w = _world(genome_backend="token", seed=77)
+        w.deterministic = True
+        w.spawn_cells(_genomes(10, 300, seed=12))
+        w.mutate_cells(p=5e-3)
+        return list(w.cell_genomes)
+
+    g1, g2 = _run(), _run()
+    assert g1 == g2  # one ctor seed pins the whole mutation stream
+    assert g1 != _genomes(10, 300, seed=12)  # and mutations happened
+
+
+def test_audit_flags_corrupted_token_store():
+    from magicsoup_tpu.check import audit_world
+
+    w = _world(genome_backend="token")
+    w.spawn_cells(_genomes(6, 100, seed=13))
+    assert audit_world(w) == []
+    store = w.genome_store
+    tok, lens = (np.asarray(a).copy() for a in store.host_arrays())
+    tok[2, lens[2] + 1] = 0  # a base token beyond the row's length
+    lens[w.n_cells + 1] = 5  # a dead row claiming a genome length
+    store.apply(store._place(tok), store._place(lens))
+    codes = {v.code for v in audit_world(w)}
+    assert "token_pad_residue" in codes
+    assert "token_dead_residue" in codes
+
+
+# -------------------------------------------------- checkpoint migration
+def test_schema1_checkpoint_migrates_onto_token_backend(tmp_path, monkeypatch):
+    from magicsoup_tpu.guard import checkpoint as ckpt_mod
+    from magicsoup_tpu.guard import read_checkpoint, write_checkpoint
+    from magicsoup_tpu.guard.resume import restore_run, snapshot_run
+
+    w = _world(genome_backend="string", seed=31)
+    w.deterministic = True
+    seqs = _genomes(9, 140, seed=14)
+    w.spawn_cells(seqs)
+    path = tmp_path / "v1.msck"
+    monkeypatch.setattr(ckpt_mod, "SCHEMA_VERSION", 1)
+    write_checkpoint(path, snapshot_run(w, None), meta={"step": 0})
+    monkeypatch.undo()
+
+    payload, meta = read_checkpoint(path)
+    assert meta["migrated_from"] == 1
+    world, aux, meta2 = restore_run(path, genome_backend="token")
+    assert meta2["migrated_from"] == 1
+    assert aux is None
+    assert world.genome_backend == "token"
+    assert list(world.cell_genomes) == seqs
+    world.enzymatic_activity()  # the restored store steps
+
+
+def test_schema1_migration_rejects_garbled_world(tmp_path, monkeypatch):
+    from types import SimpleNamespace
+
+    from magicsoup_tpu.guard import CheckpointError, write_checkpoint
+    from magicsoup_tpu.guard import checkpoint as ckpt_mod
+    from magicsoup_tpu.guard import read_checkpoint
+
+    fake = SimpleNamespace(
+        genome_backend="string", cell_genomes=["TCGA"], n_cells=3
+    )
+    path = tmp_path / "bad.msck"
+    monkeypatch.setattr(ckpt_mod, "SCHEMA_VERSION", 1)
+    write_checkpoint(path, fake)
+    monkeypatch.undo()
+    with pytest.raises(CheckpointError) as e:
+        read_checkpoint(path)
+    assert e.value.check == "migrate"
+
+
+# -------------------------------------------------------- fleet no-decode
+def test_fleet_token_steady_state_decodes_nothing():
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.fleet import FleetScheduler
+
+    def _w(seed):
+        w = _world(genome_backend="token", seed=seed)
+        w.deterministic = True
+        w.spawn_cells(_genomes(6, 100, seed=seed))
+        return w
+
+    kw = dict(
+        mol_name="gnm-test-b",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=100,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=1,
+    )
+    fleet = FleetScheduler(block=2)
+    for seed in (3, 5):
+        fleet.admit(_w(seed), **kw)
+    fleet.step()
+    fleet.drain()
+    d0 = runtime.snapshot()["genome_decode_calls"]
+    for _ in range(3):
+        fleet.step()
+    fleet.drain()
+    assert runtime.snapshot()["genome_decode_calls"] == d0
+    fleet.flush()

@@ -66,6 +66,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl020_fetch_bypass.py", "GL020"),
         ("gl021_unprobed_boundary.py", "GL021"),
         ("gl022_untyped_escape.py", "GL022"),
+        ("gl023_host_genome.py", "GL023"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -100,6 +101,36 @@ def test_gl007_waivable_like_the_other_rules(tmp_path):
     p = tmp_path / "gl007_waived.py"
     p.write_text(waived)
     assert analyze([p]) == []
+
+
+def test_gl023_waivable_string_backend_fallback(tmp_path):
+    # the library's deliberate string-backend fallback sites waive with
+    # the standard inline annotation; pin that the machinery covers GL023
+    src = (FIXTURES / "gl023_host_genome.py").read_text()
+    waived = src.replace(
+        "g = world.cell_genomes[r]  # GL023: host genome list load in hot path",
+        "g = world.cell_genomes[r]  # graftlint: disable=GL023 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl023_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl023_scoped_to_stepper_fleet_serve(tmp_path):
+    # the SAME hot-path genome access is silent once the module stops
+    # being stepper-scoped: world.py itself OWNS the import/export
+    # boundary, so flagging every module would be noise
+    src = (FIXTURES / "gl023_host_genome.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import stepper"
+        "  # noqa: F401  (marks the module stepper-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl023_not_scoped.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL023"]) == []
 
 
 def test_gl009_scoped_to_mesh_aware_modules(tmp_path):
