@@ -50,6 +50,11 @@ Findings; registration at the bottom.
 |       | path                 | list access or per-cell string mutation    |
 |       |                      | engine calls in stepper/fleet/serve hot    |
 |       |                      | functions — tokens stay on device)         |
+| GL024 | per-group-dispatch-  | the fused-dispatch contract (no device     |
+|       | loop                 | dispatch call inside a `for ... group/     |
+|       |                      | sibling` loop in fleet/serve-scoped        |
+|       |                      | modules — dispatches route through the     |
+|       |                      | fusion planner, or carry a waiver)         |
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
@@ -223,6 +228,16 @@ RULE_INFO = {
         "device-resident packed token arrays; decoding them (or running "
         "the host string engine) on the hot path reintroduces the "
         "per-cell host work the token backend exists to delete",
+    ),
+    "GL024": (
+        "per-group-dispatch-loop",
+        "a device dispatch call inside a `for`-loop over rung groups / "
+        "sibling groups in a fleet- or serve-scoped module — each "
+        "iteration pays a full program launch (+ its own D2H fetch), "
+        "which is exactly the R-dispatches-per-megastep cost the "
+        "cross-rung fusion planner deletes; route the loop through "
+        "FleetScheduler._plan_fusion (one batched program per fused "
+        "set) or waive a deliberate per-group path",
     ),
 }
 # the graftrace concurrency rules keep their metadata next to their
@@ -1566,6 +1581,81 @@ def check_gl023(ctx: Context):
                     )
 
 
+# --------------------------------------------------------------- GL024
+#: device dispatch entry points: the per-rung and fused fleet programs
+#: plus the scheduler's `_dispatch_*` wrappers (the `_dispatch_` prefix
+#: is the scheduler's dispatch-path naming convention; the commit/retry
+#: helpers deliberately do not share it)
+_DISPATCH_LEAVES = {"fleet_step", "fused_fleet_step"}
+#: loop-name fragments that identify iteration over rung/sibling groups
+_GROUP_LOOP_NAMES = ("group", "sibling")
+
+
+def _loop_target_names(target) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return [n for e in target.elts for n in _loop_target_names(e)]
+    return []
+
+
+def check_gl024(ctx: Context):
+    """Device dispatches must not loop over rung groups.  A ``for``
+    loop whose target or iterable names groups/siblings and whose body
+    launches a device program (``fleet_step`` / ``fused_fleet_step`` /
+    a scheduler ``_dispatch_*`` method) pays one program launch AND one
+    physical fetch per iteration — the R-dispatches-per-megastep cost
+    on the serve critical path that the cross-rung fusion planner
+    exists to delete.  Loops over the PLANNER's output (an iterable
+    whose expression mentions ``plan``, e.g. ``self._plan_fusion(...)``)
+    are the sanctioned route and exempt; a deliberate per-group path
+    waives with ``# graftlint: disable=GL024``."""
+    fix = (
+        "route the dispatch through the fusion planner "
+        "(FleetScheduler._plan_fusion partitions the live groups; one "
+        "fused set dispatches as ONE batched program), or waive a "
+        "deliberate per-group dispatch with `# graftlint: disable=GL024`"
+    )
+    for f in ctx.files:
+        if not (_is_fleet_scoped(f) or _is_serve_scoped(f)):
+            continue
+        for loop in ast.walk(f.tree):
+            if not isinstance(loop, ast.For):
+                continue
+            names = " ".join(_loop_target_names(loop.target)).lower()
+            iter_chain = _attr_chain(
+                loop.iter.func
+                if isinstance(loop.iter, ast.Call)
+                else loop.iter
+            ).lower()
+            if not any(
+                frag in names or frag in iter_chain
+                for frag in _GROUP_LOOP_NAMES
+            ):
+                continue
+            if "plan" in iter_chain:
+                continue  # planner-routed: the sanctioned dispatch loop
+            for node in loop.body:
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    leaf = _attr_chain(call.func).rsplit(".", 1)[-1]
+                    if leaf in _DISPATCH_LEAVES or (
+                        leaf.startswith("_dispatch_")
+                        and leaf != "_dispatch_with_retry"
+                    ):
+                        yield _finding(
+                            "GL024",
+                            f,
+                            call,
+                            f"`{leaf}` dispatches a device program "
+                            "inside a per-group loop — R rung groups "
+                            "pay R launches + R fetches per megastep "
+                            "instead of one fused program",
+                            fix,
+                        )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1590,6 +1680,7 @@ CHECKERS = {
     "GL021": dataflow.check_gl021,
     "GL022": dataflow.check_gl022,
     "GL023": check_gl023,
+    "GL024": check_gl024,
 }
 
 

@@ -221,6 +221,11 @@ _restack_inserts = 0
 _restack_skipped = 0
 _attach_full = 0
 _attach_skipped = 0
+# fed by the FleetScheduler's dispatch paths: physical device program
+# launches vs. the rung groups they carried (fused dispatch launches
+# one program for many groups)
+_dispatches = 0
+_fused_groups = 0
 
 
 def note_restack(
@@ -237,6 +242,21 @@ def note_restack(
         _restack_full += full
         _restack_inserts += inserts
         _restack_skipped += skipped
+
+
+def note_dispatch(dispatches: int = 0, fused_groups: int = 0) -> None:
+    """Accumulate fleet device dispatches (called by the scheduler).
+
+    ``dispatches`` counts physical device program launches (one per
+    rung group, or ONE for a whole fused set), ``fused_groups`` counts
+    the rung groups those dispatches carried — the gating fused smoke
+    pins ``dispatches == megasteps`` while ``fused_groups`` still sums
+    to ``megasteps * n_groups``, which is the amortization the fusion
+    planner exists for."""
+    global _dispatches, _fused_groups
+    with _lock:
+        _dispatches += dispatches
+        _fused_groups += fused_groups
 
 
 def note_attach(full: int = 0, skipped: int = 0) -> None:
@@ -264,7 +284,8 @@ def snapshot() -> dict[str, int]:
     ``persistent_cache_misses``, ``phenotype_hits``,
     ``phenotype_misses``, ``phenotype_evictions``, ``restack_full``,
     ``restack_inserts``, ``restack_skipped``, ``attach_full``,
-    ``attach_skipped`` — plus the chaos/robustness contribution from
+    ``attach_skipped``, ``dispatches``, ``fused_groups`` — plus the
+    chaos/robustness contribution from
     ``guard.chaos.runtime_counters`` (``chaos_fired``, ``degraded``,
     and every ``note_counter`` key, so counted failures ride the same
     telemetry ``counters`` rows as everything else).
@@ -288,6 +309,8 @@ def snapshot() -> dict[str, int]:
             "restack_skipped": _restack_skipped,
             "attach_full": _attach_full,
             "attach_skipped": _attach_skipped,
+            "dispatches": _dispatches,
+            "fused_groups": _fused_groups,
             "genome_decode_calls": _genome_decode_calls,
             "genome_decode_rows": _genome_decode_rows,
         }
@@ -308,6 +331,7 @@ def reset_counters() -> None:
     global _pheno_hits, _pheno_misses, _pheno_evictions, _pheno_pickle_drops
     global _restack_full, _restack_inserts, _restack_skipped
     global _attach_full, _attach_skipped
+    global _dispatches, _fused_groups
     global _genome_decode_calls, _genome_decode_rows
     from magicsoup_tpu.guard import chaos as _chaos
 
@@ -324,6 +348,8 @@ def reset_counters() -> None:
         _restack_skipped = 0
         _attach_full = 0
         _attach_skipped = 0
+        _dispatches = 0
+        _fused_groups = 0
         _genome_decode_calls = 0
         _genome_decode_rows = 0
     _chaos.reset_counters()

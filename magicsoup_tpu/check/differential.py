@@ -19,6 +19,13 @@ host-side op implementations, and pinning the streams keeps a
 divergence report pointing at the device programs rather than at RNG
 consumption differences between drivers.
 
+The fleet axes extend the same contract to batched execution: a B=1
+fleet (``FLEET_PATHS``) and a cross-rung FUSED mixed fleet
+(``FUSED_PATHS``) drive the schedule world through the
+:class:`FleetScheduler`, and its digests must still match the solo
+stepper bit-for-bit — stacking worlds on a batch axis and fusing rung
+groups into one program are both pinned structurally invisible.
+
 A second axis crosses the first: every path re-runs with the world's
 genome backend flipped to device token arrays (``TOKEN_PATHS``).  The
 schedule's host-engine ops then operate through the string
@@ -47,6 +54,17 @@ PATHS = ("classic", "k1", "k4", "mesh2")
 #: fleet has its own gating smoke); tests/fast/test_fleet.py pins these
 #: against the solo digests per boundary.
 FLEET_PATHS = ("fleet1", "fleet4")
+
+#: cross-rung FUSED dispatch paths — the schedule world steps inside a
+#: mixed-rung fleet whose rung groups are merged into ONE batched
+#: program + ONE physical fetch per megastep.  ``fused2`` drives K=1
+#: under ``fusion="fleet"`` with one companion world on a DIFFERENT
+#: capacity rung (double map size); ``fused_fleet`` drives K=4 under
+#: ``fusion="auto"`` with two companions across rungs.  Digests must
+#: equal the solo reference bit-for-bit at every boundary — the fused
+#: program runs each rung's body at native shapes, so fusion is pinned
+#: to be structurally invisible to every world's trajectory.
+FUSED_PATHS = ("fused2", "fused_fleet")
 
 #: the token genome-backend axis: every base path re-run with the
 #: world's genomes held as device token arrays instead of host strings.
@@ -160,7 +178,7 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         return
     import magicsoup_tpu as ms
 
-    k = 4 if base in ("k4", "fleet4", "fleet3") else 1
+    k = 4 if base in ("k4", "fleet4", "fleet3", "fused_fleet") else 1
     kwargs = dict(
         mol_name="dfx-atp",
         kill_below=-1.0,
@@ -174,14 +192,23 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         p_recombination=0.0,
     )
     assert n_steps % k == 0
-    if path in FLEET_PATHS or path == "token_fleet3":
+    if path in FLEET_PATHS or path == "token_fleet3" or base in FUSED_PATHS:
         # B=1 fleet: same world, same kwargs, driven through the
         # scheduler's stacked program — digests must not move a bit.
         # token_fleet3 admits two companion token worlds alongside, so
-        # the schedule world steps from slot 0 of a B=3 group.
+        # the schedule world steps from slot 0 of a B=3 group.  The
+        # fused paths admit companions on a DIFFERENT capacity rung
+        # (double map size) so the schedule world steps inside a
+        # cross-rung fused dispatch.
         from magicsoup_tpu.fleet import FleetScheduler
 
-        fleet = FleetScheduler(block=4 if path == "token_fleet3" else 1)
+        if base in FUSED_PATHS:
+            fleet = FleetScheduler(
+                block=2,
+                fusion="fleet" if base == "fused2" else "auto",
+            )
+        else:
+            fleet = FleetScheduler(block=4 if path == "token_fleet3" else 1)
         lane = fleet.admit(world, **kwargs)
         companions = []
         if path == "token_fleet3":
@@ -194,6 +221,19 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
                 )
                 cw.deterministic = True
                 crng = random.Random(500 + j)
+                cw.spawn_cells(
+                    [ms.random_genome(s=200, rng=crng) for _ in range(4)]
+                )
+                companions.append(fleet.admit(cw, **kwargs))
+        elif base in FUSED_PATHS:
+            for j in range(1 if base == "fused2" else 2):
+                cw = ms.World(
+                    chemistry=world.chemistry,
+                    map_size=world.map_size * 2,
+                    seed=1500 + j,
+                )
+                cw.deterministic = True
+                crng = random.Random(700 + j)
                 cw.spawn_cells(
                     [ms.random_genome(s=200, rng=crng) for _ in range(4)]
                 )
@@ -225,10 +265,10 @@ def run_path(
     regression passes :func:`structural_digest` instead."""
     import magicsoup_tpu as ms
 
-    if path not in PATHS + FLEET_PATHS + TOKEN_PATHS:
+    if path not in PATHS + FLEET_PATHS + FUSED_PATHS + TOKEN_PATHS:
         raise ValueError(
             f"unknown path {path!r} "
-            f"(want one of {PATHS + FLEET_PATHS + TOKEN_PATHS})"
+            f"(want one of {PATHS + FLEET_PATHS + FUSED_PATHS + TOKEN_PATHS})"
         )
     if digest_fn is None:
         digest_fn = state_digest

@@ -45,6 +45,8 @@ __all__ = [
     "extract_world",
     "fleet_step",
     "fleet_step_program",
+    "fused_fleet_step",
+    "fused_step_program",
     "insert_world",
     "lane_consts",
     "stack_worlds",
@@ -206,6 +208,83 @@ def fleet_step(*args, **statics):
     the solo ``_megastep``/``_megastep_retained`` pair)."""
     fn = _fleet_step_donated if _donate_step_buffers() else _fleet_step_retained
     return fn(*args, **statics)
+
+
+# ------------------------------------------------------------------ #
+# cross-rung fused dispatch                                          #
+# ------------------------------------------------------------------ #
+
+
+def fused_step_program(states, params, rest, *, statics, k_env, rec_env):
+    """The raw (unjitted) CROSS-RUNG fused program: one device launch
+    advancing EVERY rung group of a fleet by one megastep.
+
+    Each rung runs :func:`fleet_step_program` — the exact per-group
+    body, at its NATIVE shapes and statics — inside one jit, so every
+    world's arithmetic (including its PRNG consumption, which is
+    shape-sensitive under threefry counter pairing) is bitwise what the
+    per-rung dispatch computes.  The capacity envelope applies ONLY to
+    the packed step records: each rung's ``(B_r, k_r, L_r)`` output is
+    zero-padded to the grow-only ``(k_env, rec_env)`` envelope and the
+    rungs are concatenated on the world axis, so the whole fleet's
+    records come back in ONE ``(sum B_r, k_env, rec_env)`` buffer = ONE
+    physical D2H fetch per megastep (the host crops each lane's native
+    ``(k_r, L_r)`` view back out — ``stepper.crop_fused_record``).
+
+    ``states`` / ``params`` are tuples of per-rung stacked pytrees (in
+    planner order; donated as one buffer set), ``rest`` is a matching
+    tuple of per-rung ``(consts, spawn_dense, spawn_valid, push_dense,
+    push_rows, div_budget, do_compact)`` — NOT donated, because the
+    consts and the cached empty spawn/push uploads are reused across
+    megasteps.  ``statics`` is a hashable tuple of per-rung
+    ``(det, max_div, n_rounds, k, use_pallas)`` tuples.
+    """
+    new_states, new_params, outs = [], [], []
+    for i, (det, max_div, n_rounds, k, use_pallas) in enumerate(statics):
+        consts, sd, sv, pd, pr, db, do = rest[i]
+        fs, fp, fo = fleet_step_program(
+            states[i],
+            params[i],
+            consts,
+            sd,
+            sv,
+            pd,
+            pr,
+            db,
+            do,
+            det=det,
+            max_div=max_div,
+            n_rounds=n_rounds,
+            k=k,
+            use_pallas=use_pallas,
+        )
+        fo = jnp.pad(
+            fo,
+            ((0, 0), (0, k_env - fo.shape[1]), (0, rec_env - fo.shape[2])),
+        )
+        new_states.append(fs)
+        new_params.append(fp)
+        outs.append(fo)
+    return tuple(new_states), tuple(new_params), jnp.concatenate(outs, axis=0)
+
+
+_FUSED_STATICS = ("statics", "k_env", "rec_env")
+
+_fused_step_donated = functools.partial(
+    jax.jit, static_argnames=_FUSED_STATICS, donate_argnums=(0, 1)
+)(fused_step_program)
+
+_fused_step_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of the fused step; donation races XLA:CPU async execution
+    jax.jit, static_argnames=_FUSED_STATICS
+)(fused_step_program)
+
+
+def fused_fleet_step(states, params, rest, **statics):
+    """Dispatch one fused fleet megastep (every rung group in ONE
+    program launch) through the backend-appropriate jit twin — same
+    donated/retained split as :func:`fleet_step`."""
+    fn = _fused_step_donated if _donate_step_buffers() else _fused_step_retained
+    return fn(states, params, rest, **statics)
 
 
 # ------------------------------------------------------------------ #

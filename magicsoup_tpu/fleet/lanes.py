@@ -38,6 +38,11 @@ class FleetLane(PipelinedStepper):
         self._fleet = None
         self._fleet_slot = None  # (group, slot index) while a member
         self._fleet_resident = False  # device truth lives in the stack
+        # fused-dispatch context of the lane's LAST dispatch (set by the
+        # scheduler's _dispatch_fused, cleared by _dispatch_group) —
+        # rides every guard row so a sentinel/invariant trip names the
+        # fused set it fired under
+        self._fused_tags: dict = {}
         super().__init__(world, **kwargs)
 
     # ------------------------------------------------------------ #
@@ -88,7 +93,11 @@ class FleetLane(PipelinedStepper):
     def _guard_row_extra(self) -> dict:
         if self._fleet_slot is not None:
             group, slot = self._fleet_slot
-            return {"fleet_slot": slot, "fleet_size": len(group.slots)}
+            return {
+                "fleet_slot": slot,
+                "fleet_size": len(group.slots),
+                **self._fused_tags,
+            }
         return {}
 
     def _handle_sentinel(self, out) -> None:

@@ -34,12 +34,29 @@ Stepping
     fleet program per group.  All member lanes share one physical fetch
     of the batched ``(B, k, record)`` output; each lane replays its own
     slice through the unchanged solo replay.
+
+Cross-rung fusion
+    A mixed fleet with R capacity rungs pays R dispatches + R physical
+    fetches per megastep on the per-rung path.  The fusion planner
+    (``fusion="rung"|"fleet"|"auto"``) collapses that to ONE batched
+    program + ONE physical fetch for a whole fused set of rungs: each
+    rung still runs its own program body at NATIVE shapes inside the
+    one jit (bit-identity is structural — no state is ever padded, so
+    shape-sensitive PRNG consumption is untouched), and only the packed
+    step records are padded to a fleet-wide grow-only ``(k_env,
+    rec_env)`` envelope and concatenated into one fetch buffer.
+    ``auto`` fuses only rungs whose padded records stay under the
+    ``fusion_waste`` slot-waste budget and falls back to per-rung
+    dispatch otherwise.  Warm admission into an existing envelope
+    compiles nothing (the fused signature is shape-stable); an envelope
+    bump is exactly one counted recompile for the whole fleet (pinned
+    in tests/fast/test_fleet.py via ``runtime.compile_count``).
 """
 from __future__ import annotations
 
 import threading
 from concurrent.futures import TimeoutError as _FuturesTimeout
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import numpy as np
@@ -47,6 +64,7 @@ import numpy as np
 from magicsoup_tpu.fleet.batch import (
     extract_world,
     fleet_step,
+    fused_fleet_step,
     insert_world,
     lane_consts,
     stack_worlds,
@@ -54,7 +72,8 @@ from magicsoup_tpu.fleet.batch import (
 )
 from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.fleet.lanes import FleetLane
-from magicsoup_tpu.stepper import _LazyFetch
+from magicsoup_tpu.guard import chaos as _chaos
+from magicsoup_tpu.stepper import _LazyFetch, crop_fused_record, record_length
 
 __all__ = ["FleetScheduler"]
 
@@ -134,6 +153,48 @@ class _SliceFetch:
         return self._shared.result(timeout=timeout)[self._slot]
 
 
+class _FusedSliceFetch:
+    """A lane's view of a cross-rung FUSED fetch: ``result()`` crops the
+    lane's native ``(k, record)`` megastep record back out of its
+    world-row of the envelope-padded fused buffer (the pad columns are
+    zeros the replay must never see)."""
+
+    __slots__ = ("_shared", "_row", "_k", "_length")
+
+    def __init__(self, shared: _SharedFetch, row: int, k: int, length: int):
+        self._shared = shared
+        self._row = row
+        self._k = k
+        self._length = length
+
+    def done(self) -> bool:
+        return self._shared.done()
+
+    def result(self, timeout=None):
+        return crop_fused_record(
+            self._shared.result(timeout=timeout)[self._row],
+            self._k,
+            self._length,
+        )
+
+
+class _GroupInputs(NamedTuple):
+    """One rung group's device inputs, densified and ready to dispatch —
+    the shared product of the per-rung and fused dispatch paths."""
+
+    first: FleetLane  # members[0]: statics / fetcher / retry source
+    lane_plans: dict  # slot -> _DispatchPlan
+    B: int  # slot count (padded group size)
+    cap: int  # cell capacity (q of every member dispatch)
+    maxp: int
+    maxd: int
+    k: int  # megastep (records per world per dispatch)
+    length: int  # native record length of this rung
+    statics: tuple  # (det, max_div, n_rounds, k, use_pallas)
+    rest: tuple  # (consts, spawn_dense, spawn_valid, push_dense,
+    #              push_rows, div_budget, do_compact)
+
+
 def _rung_key(lane: FleetLane) -> tuple:
     """Everything that feeds the compiled fleet program's shape/static
     signature.  Token capacities are deliberately EXCLUDED — they are
@@ -209,13 +270,36 @@ class FleetScheduler:
             a rung is full (same program shapes, zero new compiles);
             ``"double"`` keeps the legacy behavior of doubling the one
             group's slot count (a new shape — recompiles the rung).
+        fusion: Cross-rung dispatch fusion.  ``"rung"`` (default) keeps
+            the one-dispatch-one-fetch-PER-GROUP contract; ``"fleet"``
+            fuses every live group into ONE batched program + ONE
+            physical fetch per megastep; ``"auto"`` fuses greedily but
+            only while every fused member's padded step records stay
+            under the ``fusion_waste`` budget, falling back to per-rung
+            dispatch for outliers.
+        fusion_waste: Slot-waste budget for ``fusion="auto"``: the
+            largest tolerated fraction of a member's fetched record
+            envelope that is padding (``1 - (k*L)/(k_env*rec_env)``).
     """
 
-    def __init__(self, *, block: int = 4, grow: str = "pad"):
+    def __init__(
+        self,
+        *,
+        block: int = 4,
+        grow: str = "pad",
+        fusion: str = "rung",
+        fusion_waste: float = 0.5,
+    ):
         if block < 1:
             raise ValueError("block must be >= 1")
         if grow not in ("pad", "double"):
             raise ValueError('grow must be "pad" or "double"')
+        if fusion not in ("rung", "fleet", "auto"):
+            raise ValueError('fusion must be "rung", "fleet" or "auto"')
+        if not 0.0 <= float(fusion_waste) < 1.0:
+            raise ValueError("fusion_waste must be in [0, 1)")
+        self.fusion = fusion
+        self.fusion_waste = float(fusion_waste)
         self.block = 1 << (int(block) - 1).bit_length()  # round up to pow2
         self.grow = grow
         self.lanes: list[FleetLane] = []
@@ -225,6 +309,14 @@ class FleetScheduler:
         # sibling groups so they share program shapes; remembered past
         # group teardown so a re-created rung re-hits warm programs
         self._rung_caps: dict[tuple, tuple[int, int]] = {}
+        # cross-rung fusion state: the GROW-ONLY record envelope every
+        # fused fetch buffer is padded to (monotone max, so membership
+        # churn between known configurations re-hits warm signatures
+        # instead of bouncing shapes), and the warm fused-program
+        # signatures (per-rung shape tuples + envelope)
+        self._env_k = 0
+        self._env_rec = 0
+        self._fused_warm: set[tuple] = set()
         self._warden = None  # bound by fleet.warden.FleetWarden
 
     # ------------------------------------------------------------ #
@@ -301,7 +393,9 @@ class FleetScheduler:
 
     def step(self) -> None:
         """One fleet megastep: every world advances ``megastep`` fused
-        steps.  One dispatch + one fetch per rung group."""
+        steps.  One dispatch + one fetch per rung group — or per FUSED
+        SET of groups when the fusion planner merges rungs (one for the
+        whole fleet under ``fusion="fleet"``)."""
         if self._warden is not None:
             # evict tripped worlds / heal cooled-down ones / cadence
             # saves BEFORE any plan is prepared: membership must be
@@ -311,10 +405,70 @@ class FleetScheduler:
         for lane in list(self.lanes):
             plans[id(lane)] = lane._prepare_dispatch()
         self._place()
-        for siblings in list(self._groups.values()):
-            for group in list(siblings):
-                if group.members():
-                    self._dispatch_group(group, plans)
+        live = [
+            group
+            for siblings in list(self._groups.values())
+            for group in list(siblings)
+            if group.members()
+        ]
+        for fused_set in self._plan_fusion(live):
+            if len(fused_set) == 1:
+                self._dispatch_group(fused_set[0], plans)
+            else:
+                self._dispatch_fused(fused_set, plans)
+
+    def _plan_fusion(self, groups: list[_FleetGroup]) -> list[list]:
+        """Partition the live groups into fused dispatch sets.
+
+        ``"rung"`` returns singletons (the legacy per-group contract).
+        ``"fleet"`` returns one set.  ``"auto"`` packs greedily, largest
+        record footprint first, admitting a group into a set only while
+        EVERY member's padded-record waste — measured against the
+        grow-only envelope the merged set would fetch under — stays
+        within ``fusion_waste``.  Every dispatch below routes through
+        this planner (graftlint GL024 pins that no per-group dispatch
+        loop bypasses it)."""
+        if self.fusion == "rung" or len(groups) <= 1:
+            return [[g] for g in groups]
+        if self.fusion == "fleet":
+            return [list(groups)]
+        geo = {}
+        for g in groups:
+            _, first = g.members()[0]
+            geo[id(g)] = (
+                first.megastep,
+                record_length(
+                    first._cap, first.max_divisions, first.spawn_block
+                ),
+            )
+        order = sorted(
+            range(len(groups)),
+            key=lambda i: (-geo[id(groups[i])][0] * geo[id(groups[i])][1], i),
+        )
+        sets: list[list] = []
+        for i in order:
+            g = groups[i]
+            placed = False
+            for s in sets:
+                cand = s + [g]
+                k_env = max(
+                    self._env_k, max(geo[id(x)][0] for x in cand)
+                )
+                rec_env = max(
+                    self._env_rec, max(geo[id(x)][1] for x in cand)
+                )
+                envelope = k_env * rec_env
+                if all(
+                    geo[id(x)][0] * geo[id(x)][1]
+                    >= (1.0 - self.fusion_waste) * envelope
+                    for x in cand
+                ):
+                    s.append(g)
+                    placed = True
+                    break
+            if not placed:
+                sets.append([g])
+        return sets
 
     def drain(self) -> None:
         """Block until every lane's dispatched steps are replayed."""
@@ -519,10 +673,13 @@ class FleetScheduler:
     # batched dispatch                                             #
     # ------------------------------------------------------------ #
 
-    def _dispatch_group(self, group: _FleetGroup, plans: dict) -> None:
+    def _prepare_group_inputs(
+        self, group: _FleetGroup, plans: dict
+    ) -> _GroupInputs:
+        """Densify one (already stacked) group's device inputs — the
+        shared front half of the per-rung and fused dispatch paths."""
         import time as _time
 
-        self._ensure_stacked(group)
         members = group.members()
         _, first = members[0]
         B = len(group.slots)
@@ -616,27 +773,77 @@ class FleetScheduler:
             do_compact = jax.device_put(np.asarray(compacts, dtype=bool))
             group.compact_cache[compacts] = do_compact
 
-        vkey = (B, cap, maxp, maxd)
+        return _GroupInputs(
+            first=first,
+            lane_plans=lane_plans,
+            B=B,
+            cap=cap,
+            maxp=maxp,
+            maxd=maxd,
+            k=first.megastep,
+            length=record_length(cap, first.max_divisions, sb),
+            statics=(
+                bool(first.world.deterministic),
+                first.max_divisions,
+                first.n_rounds,
+                first.megastep,
+                bool(first.world.use_pallas),
+            ),
+            rest=(
+                group.consts,
+                spawn_dense,
+                spawn_valid,
+                push_dense,
+                push_rows,
+                dev_budget,
+                do_compact,
+            ),
+        )
+
+    @staticmethod
+    def _chaos_dispatch_site() -> None:
+        """Fire the armed graftchaos ``dispatch`` fault (if any) BEFORE
+        any donated buffer is touched, so a retried fleet dispatch
+        re-sends bit-identical inputs — same contract as the solo
+        ``PipelinedStepper.step`` probe."""
+        fault = _chaos.site("dispatch")
+        if fault is not None:
+            from magicsoup_tpu.guard.errors import TransientDispatchError
+
+            raise TransientDispatchError(
+                "injected fault: UNAVAILABLE: chaos dispatch fault "
+                f"#{fault.index}"
+            )
+
+    def _dispatch_group(self, group: _FleetGroup, plans: dict) -> None:
+        import time as _time
+
+        self._ensure_stacked(group)
+        gi = self._prepare_group_inputs(group, plans)
+        first = gi.first
+        det, max_div, n_rounds, k, use_pallas = gi.statics
+
+        vkey = (gi.B, gi.cap, gi.maxp, gi.maxd)
         cold = vkey not in group.warm
         t_dispatch0 = _time.perf_counter()
-        group.fstate, group.fparams, fouts = fleet_step(
-            group.fstate,
-            group.fparams,
-            group.consts,
-            spawn_dense,
-            spawn_valid,
-            push_dense,
-            push_rows,
-            dev_budget,
-            do_compact,
-            det=first.world.deterministic,
-            max_div=first.max_divisions,
-            n_rounds=first.n_rounds,
-            k=first.megastep,
-            use_pallas=first.world.use_pallas,
-        )
+
+        def _go():
+            self._chaos_dispatch_site()
+            return fleet_step(
+                group.fstate,
+                group.fparams,
+                *gi.rest,
+                det=det,
+                max_div=max_div,
+                n_rounds=n_rounds,
+                k=k,
+                use_pallas=use_pallas,
+            )
+
+        group.fstate, group.fparams, fouts = first._dispatch_with_retry(_go)
         t_dispatched = _time.perf_counter()
         group.warm.add(vkey)
+        _runtime.note_dispatch(dispatches=1, fused_groups=1)
 
         # one fetch for the whole group; lanes replay their slices
         fut = (
@@ -648,18 +855,115 @@ class FleetScheduler:
             fut,
             timeout=first._fetch_timeout,
             context={
-                "B": B,
-                "k": first.megastep,
-                "slots": [slot for slot, _ in members],
+                "B": gi.B,
+                "k": gi.k,
+                "slots": [slot for slot, _ in group.members()],
             },
         )
-        for slot, lane in members:
+        for slot, lane in group.members():
+            lane._fused_tags = {}
             lane._commit_dispatch(
-                lane_plans[slot],
+                gi.lane_plans[slot],
                 _SliceFetch(shared, slot),
-                q=cap,
+                q=gi.cap,
                 cold=cold,
                 t_dispatch0=t_dispatch0,
                 t_dispatched=t_dispatched,
-                extra_row={"fleet_slot": slot, "fleet_size": B},
+                extra_row={"fleet_slot": slot, "fleet_size": gi.B},
             )
+
+    def _dispatch_fused(self, fused_set: list, plans: dict) -> None:
+        """ONE batched program + ONE physical fetch for a whole fused
+        set of rung groups.  Every rung keeps its native shapes inside
+        the one jit (bit-identity is structural); only the packed step
+        records are padded to the grow-only ``(k_env, rec_env)``
+        envelope and concatenated, so the fleet's records come back in
+        a single ``(sum B_r, k_env, rec_env)`` buffer each lane crops
+        its native view out of."""
+        import time as _time
+
+        prepped = []
+        for group in fused_set:
+            self._ensure_stacked(group)
+            prepped.append(self._prepare_group_inputs(group, plans))
+
+        # grow-only envelope: monotone max, so membership churn between
+        # known configurations re-hits warm signatures.  A bump here is
+        # exactly one counted recompile (the fused program's).
+        self._env_k = max(self._env_k, max(p.k for p in prepped))
+        self._env_rec = max(self._env_rec, max(p.length for p in prepped))
+        k_env, rec_env = self._env_k, self._env_rec
+        statics = tuple(p.statics for p in prepped)
+        sig = (
+            tuple((p.B, p.cap, p.maxp, p.maxd, p.statics) for p in prepped),
+            k_env,
+            rec_env,
+        )
+        cold = sig not in self._fused_warm
+        states = tuple(g.fstate for g in fused_set)
+        params = tuple(g.fparams for g in fused_set)
+        rest = tuple(p.rest for p in prepped)
+        first = prepped[0].first
+        t_dispatch0 = _time.perf_counter()
+
+        def _go():
+            self._chaos_dispatch_site()
+            return fused_fleet_step(
+                states,
+                params,
+                rest,
+                statics=statics,
+                k_env=k_env,
+                rec_env=rec_env,
+            )
+
+        new_states, new_params, fouts = first._dispatch_with_retry(_go)
+        t_dispatched = _time.perf_counter()
+        self._fused_warm.add(sig)
+        _runtime.note_dispatch(dispatches=1, fused_groups=len(fused_set))
+        # NOTE: group.warm is deliberately untouched — it tracks the
+        # PER-RUNG program's warmth, which a fused dispatch neither
+        # exercises nor compiles
+        for group, fs, fp in zip(fused_set, new_states, new_params):
+            group.fstate, group.fparams = fs, fp
+
+        # ONE physical fetch for the whole fused set; each lane crops
+        # its native (k, record) view out of its world-row
+        fut = (
+            first._fetcher.submit(fouts)
+            if first._fetcher is not None
+            else _LazyFetch(fouts)
+        )
+        shared = _SharedFetch(
+            fut,
+            timeout=first._fetch_timeout,
+            context={
+                "fused_groups": len(fused_set),
+                "worlds": sum(p.B for p in prepped),
+                "envelope": [k_env, rec_env],
+            },
+        )
+        row_base = 0
+        fused_tags = {
+            "fused_groups": len(fused_set),
+            "envelope": [k_env, rec_env],
+        }
+        for group, p in zip(fused_set, prepped):
+            for slot, lane in group.members():
+                lane._fused_tags = dict(fused_tags)
+                lane._commit_dispatch(
+                    p.lane_plans[slot],
+                    _FusedSliceFetch(
+                        shared, row_base + slot, p.k, p.length
+                    ),
+                    q=p.cap,
+                    cold=cold,
+                    t_dispatch0=t_dispatch0,
+                    t_dispatched=t_dispatched,
+                    extra_row={
+                        "fleet_slot": slot,
+                        "fleet_size": p.B,
+                        **fused_tags,
+                    },
+                )
+            row_base += p.B

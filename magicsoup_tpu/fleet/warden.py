@@ -36,6 +36,15 @@ lands in the record of the dispatch that integrated it and is decoded
 up to L dispatches later; eviction happens at the next ``step()`` after
 the replay that tripped.  The quarantine window is therefore
 O((L+1) * K) steps — the healthy worlds never see any of it.
+
+Cross-rung fusion note: under ``FleetScheduler(fusion="fleet"|"auto")``
+several rung groups share one fused launch and one envelope fetch, but
+each lane still replays its NATIVE ``(k, record)`` slice (cropped out
+of the shared buffer before replay), so the per-slot sentinel /
+invariant flag views, the trip counters, and the eviction contract are
+unchanged — and warden telemetry rows inherit the lane's
+``fused_groups`` / ``envelope`` dispatch context, so a trip can be
+correlated with the fused launch that carried it.
 """
 from __future__ import annotations
 

@@ -17,11 +17,18 @@ Per tenant the ledger tracks:
   which is the honest multi-tenant cost model — the dispatch happened
   FOR each of them).
 - ``fetch_bytes`` — the tenant's share of the physical fetch traffic.
-  The fleet fetches ONE batched record per group megastep; the ledger
-  distributes each observed fetch-byte delta evenly across the tenants
-  stepped in that window (remainder to the first tenant in sorted
-  order, so the split is deterministic and the per-tenant numbers sum
-  EXACTLY to the process total).
+  The fleet fetches ONE batched record per group megastep — or, under
+  cross-rung fusion (``FleetScheduler(fusion="fleet"|"auto")``), ONE
+  envelope record for ALL fused groups; the ledger distributes each
+  observed fetch-byte delta evenly across the tenants stepped in that
+  window (remainder to the first tenant in sorted order, so the split
+  is deterministic and the per-tenant numbers sum EXACTLY to the
+  process total).  The even split is deliberately conservative for the
+  fused envelope: a small-rung tenant is billed the same share of the
+  shared record as its large-rung co-riders, which over-charges padding
+  rather than under-counting traffic — the conservation invariant
+  (shares sum exactly to the observed byte total, including
+  subset-stepped megasteps) is the contract the serve tests pin.
 - ``sentinel_trips`` / ``invariant_trips`` — health trips, folded as
   deltas of the lane's own counters so lane replacement (restore) never
   double-counts.

@@ -144,6 +144,11 @@ class FleetService:
         host/port: HTTP bind address (``port=0`` picks a free port;
             read it back from ``.port`` after :meth:`serve_http`).
         block: Fleet group slot count (see :class:`FleetScheduler`).
+        fusion: Cross-rung dispatch fusion mode passed through to the
+            scheduler (``"rung"`` | ``"fleet"`` | ``"auto"``): under
+            ``"fleet"``/``"auto"`` heterogeneous tenants share ONE
+            batched launch + ONE physical fetch per megastep, and the
+            accounting ledger splits the fused fetch bytes exactly.
         policy: Warden policy for tenant health trips.
         keep: Rolling retention per tenant checkpoint stream.
         compile_budget: Initial admission compile allowance
@@ -161,6 +166,7 @@ class FleetService:
         host: str = "127.0.0.1",
         port: int = 0,
         block: int = 4,
+        fusion: str = "rung",
         policy: str = "warn",
         keep: int = 3,
         compile_budget: int | None = None,
@@ -187,7 +193,7 @@ class FleetService:
             )
         self.dir = Path(directory)
         (self.dir / "worlds").mkdir(parents=True, exist_ok=True)
-        self.scheduler = FleetScheduler(block=block, grow="pad")
+        self.scheduler = FleetScheduler(block=block, grow="pad", fusion=fusion)
         self.warden = FleetWarden(
             self.scheduler,
             policy=policy,

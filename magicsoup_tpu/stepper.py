@@ -204,6 +204,28 @@ def record_length(
     )
 
 
+def crop_fused_record(records, k: int, length: int) -> np.ndarray:
+    """A lane's NATIVE ``(k, record)`` view out of one world-row of a
+    cross-rung fused fetch buffer.
+
+    The fused dispatch pads every rung's ``(B_r, k_r, L_r)`` records to
+    the fleet-wide grow-only ``(k_env, rec_env)`` envelope so the whole
+    fleet comes back in one physical fetch; the envelope lives ONLY in
+    that buffer — ``_unpack_outputs`` still asserts the exact
+    :func:`record_length` of the lane's own config, so the record-length
+    contract is enforced at native shapes on every replay.  ``records``
+    is one world's ``(k_env, rec_env)`` slice, ``k`` its megastep and
+    ``length`` its native record length; both must fit the envelope."""
+    arr = np.asarray(records)
+    if arr.shape[0] < k or arr.shape[1] < length:
+        raise ValueError(
+            f"fused record envelope {arr.shape} cannot hold a native "
+            f"({k}, {length}) megastep record — the grow-only envelope "
+            "contract was violated"
+        )
+    return arr[:k, :length]
+
+
 class DeviceState(NamedTuple):
     """All device-resident simulation state threaded step to step."""
 

@@ -29,6 +29,13 @@ Mesh-placed runs add optional keys: step rows carry ``tile_occupancy``
 to ``occupied`` — computed on device from the sharded occupancy map) and
 dispatch rows carry ``tiles``/``mesh_axis``.  Single-device rows omit
 them, so the schema is backward compatible.
+
+Fleet runs annotate dispatch rows with ``fleet_slot``/``fleet_size``;
+cross-rung FUSED dispatches additionally carry ``fused_groups`` (how
+many rung groups shared the one program launch, a positive int) and
+``envelope`` (``[k_env, rec_env]`` — the grow-only record envelope the
+shared fetch buffer was padded to).  Both are optional; when
+``envelope`` is present ``fused_groups`` must be too.
 """
 from __future__ import annotations
 
@@ -206,6 +213,34 @@ def validate_rows(rows: list[dict]) -> list[str]:
                 if not isinstance(ms, (int, float)) or ms < 0:
                     problems.append(
                         f"{where}: phase {name!r} timing {ms!r} invalid"
+                    )
+            # cross-rung fused dispatch tags (fleet.scheduler
+            # _dispatch_fused): how many rung groups shared this one
+            # program launch, and the grow-only [k_env, rec_env] record
+            # envelope its fetch buffer was padded to
+            fused = row.get("fused_groups")
+            if fused is not None and (
+                not isinstance(fused, int) or fused < 1
+            ):
+                problems.append(
+                    f"{where}: fused_groups must be a positive int, "
+                    f"got {fused!r}"
+                )
+            env = row.get("envelope")
+            if env is not None:
+                if (
+                    not isinstance(env, list)
+                    or len(env) != 2
+                    or any(not isinstance(v, int) or v < 1 for v in env)
+                ):
+                    problems.append(
+                        f"{where}: envelope must be [k_env, rec_env] "
+                        f"positive ints, got {env!r}"
+                    )
+                elif fused is None:
+                    problems.append(
+                        f"{where}: envelope without fused_groups — "
+                        "fused tags must travel together"
                     )
         elif kind == "counters":
             if not isinstance(row.get("counters"), dict):

@@ -43,7 +43,12 @@ sys.path.insert(0, str(REPO))
 # shared tiny workload (children only — imports stay lazy)          #
 # ----------------------------------------------------------------- #
 
-def _tiny_world(seed: int = 7):
+def _tiny_world(
+    seed: int = 7,
+    map_size: int = 8,
+    n_cells: int = 6,
+    genome_size: int = 80,
+):
     import random
 
     import magicsoup_tpu as ms
@@ -54,9 +59,11 @@ def _tiny_world(seed: int = 7):
     ]
     chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
     rng = random.Random(seed)
-    world = ms.World(chemistry=chem, map_size=8, seed=seed)
+    world = ms.World(chemistry=chem, map_size=map_size, seed=seed)
     world.deterministic = True
-    world.spawn_cells([ms.random_genome(s=80, rng=rng) for _ in range(6)])
+    world.spawn_cells(
+        [ms.random_genome(s=genome_size, rng=rng) for _ in range(n_cells)]
+    )
     return world
 
 
@@ -90,6 +97,48 @@ def _digest(world, st) -> str:
     smoke = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(smoke)
     return smoke._chaos_digest(world, st)
+
+
+def _fleet_digest(fleet) -> str:
+    # the canonical per-lane digest chain the fleet chaos smoke pins
+    # bit-identity with — import, don't re-derive
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_cmx_smoke", Path(__file__).resolve().parent / "smoke.py"
+    )
+    smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+    return smoke._fleet_digest(fleet)
+
+
+#: lane kwargs for the fused-fleet cells (chemistry-only: the rungs
+#: freeze, so the fused signature is stable under the fault schedule)
+_FUSED_KW = dict(
+    mol_name="cmx-atp",
+    kill_below=-1.0,
+    divide_above=1e30,
+    divide_cost=0.0,
+    target_cells=None,
+    genome_size=80,
+    lag=1,
+    p_mutation=0.0,
+    p_recombination=0.0,
+    megastep=2,
+)
+
+
+def _fused_fleet(**overrides):
+    """A MIXED-rung fused fleet: two tiny worlds on different capacity
+    rungs, one batched launch + one physical fetch per megastep."""
+    from magicsoup_tpu.fleet import FleetScheduler
+
+    kw = dict(_FUSED_KW)
+    kw.update(overrides)
+    fleet = FleetScheduler(block=2, fusion="fleet")
+    fleet.admit(_tiny_world(7), **kw)
+    fleet.admit(_tiny_world(11, map_size=16), **kw)
+    return fleet, kw
 
 
 def _tenant_spec(name: str, seed: int = 5) -> dict:
@@ -300,6 +349,103 @@ def cell_dispatch_exhausted(tmp: Path) -> dict:
             **_chaos_evidence(),
         }
     return {"state": "completed", "note": "retries absorbed every fault"}
+
+
+def cell_fused_dispatch_recovers(tmp: Path) -> dict:
+    """One transient dispatch fault on a FUSED mixed-rung launch inside
+    the retry budget: absorbed by the fleet's shared retry wrapper, and
+    EVERY co-fused tenant's trajectory stays bit-identical to the
+    unfaulted fleet run — the fault fires before donation, so the
+    retried fused launch re-sends the same inputs and a fault on one
+    launch cannot poison the healthy rungs sharing it."""
+    fleet, _kw = _fused_fleet(dispatch_retries=2)
+    for _ in range(4):
+        fleet.step()
+    fleet.flush()
+    retries = sum(l.stats["dispatch_retries"] for l in fleet.lanes)
+    return {
+        "state": "recovered",
+        "digest": _fleet_digest(fleet),
+        "dispatch_retries": retries,
+        "worlds": len(fleet.lanes),
+        **_chaos_evidence(),
+    }
+
+
+def cell_fused_restack_sigkill(tmp: Path) -> dict:
+    """SIGKILL a fused-fleet victim right after an envelope-growing
+    admission (new rung -> record envelope bump) lands in an atomic
+    fleet checkpoint: the resumed fleet must replay the rest of the
+    schedule BIT-identical to an uninterrupted baseline."""
+    import signal  # noqa: F401  (documents the kill mode; kill() is SIGKILL)
+    import subprocess as sp
+
+    from magicsoup_tpu.fleet import FleetScheduler
+    from magicsoup_tpu.fleet.persist import restore_fleet
+
+    # uninterrupted baseline: the same schedule straight through.  The
+    # newcomer runs megastep=4 against the incumbents' 2, so its
+    # admission bumps the fused record envelope's k axis
+    fleet, kw = _fused_fleet()
+    for _ in range(2):
+        fleet.step()
+    env_before = (fleet._env_k, fleet._env_rec)
+    kw4 = dict(kw, megastep=4)
+    fleet.admit(_tiny_world(13, map_size=16, n_cells=12, genome_size=120), **kw4)
+    for _ in range(3):
+        fleet.step()
+    fleet.flush()
+    baseline_digest = _fleet_digest(fleet)
+    envelope_grew = (fleet._env_k, fleet._env_rec) > env_before
+
+    # victim grandchild: same schedule, checkpoints one step after the
+    # envelope bump, then keeps stepping until we SIGKILL it
+    env = dict(os.environ)
+    env.pop("MAGICSOUP_CHAOS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = sp.Popen(
+        [
+            sys.executable,
+            str(Path(__file__).resolve()),
+            "--fused-victim",
+            str(tmp),
+        ],
+        stdout=sp.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    killed = False
+    try:
+        for line in proc.stdout:
+            if "checkpointed" in line:
+                proc.kill()  # SIGKILL, mid post-checkpoint stepping
+                killed = True
+                break
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+    # resume from the victim's checkpoint and finish the schedule
+    resumed = FleetScheduler(block=2, fusion="fleet")
+    _lanes, meta = restore_fleet(
+        tmp / "fused_fleet.ck",
+        resumed,
+        lambda i: kw4 if i == 2 else kw,
+    )
+    for _ in range(2):
+        resumed.step()
+    resumed.flush()
+    return {
+        "state": "recovered",
+        "digest": _fleet_digest(resumed),
+        "baseline_digest": baseline_digest,
+        "killed": killed,
+        "envelope_grew": envelope_grew,
+        "resumed_from": meta.get("step"),
+        **_chaos_evidence(),
+    }
 
 
 def cell_fetch_watchdog(tmp: Path) -> dict:
@@ -552,6 +698,24 @@ def _v_dispatch_recovers(out, base):
     return p
 
 
+def _v_fused_sigkill(out, base):
+    # self-contained digest pair: the cell runs its own uninterrupted
+    # baseline in-process (the kill is a real signal, not a chaos spec)
+    p = []
+    if not out.get("killed"):
+        p.append("victim was never SIGKILLed")
+    if not out.get("envelope_grew"):
+        p.append("admission never grew the record envelope")
+    if out.get("digest") != out.get("baseline_digest"):
+        p.append(
+            "resumed fused fleet digest differs from the uninterrupted "
+            "baseline"
+        )
+    if out.get("resumed_from") != 3:
+        p.append(f"checkpoint step {out.get('resumed_from')!r} != 3")
+    return p
+
+
 def _v_telemetry(out, base):
     p = _v_digest_equal(out, base)
     rec = out.get("recorder", {})
@@ -649,6 +813,14 @@ CELLS: dict[str, dict] = {
         spec="dispatch:transient@1x0", expect="raised",
         verify=_v_typed("TransientDispatchError"),
     ),
+    "fused_dispatch_recovers": dict(
+        spec="dispatch:transient@2x1", expect="recovered",
+        verify=_v_dispatch_recovers, baseline=True, gate=True,
+    ),
+    "fused_restack_sigkill": dict(
+        spec="", expect="recovered",
+        verify=_v_fused_sigkill,
+    ),
     "fetch_watchdog": dict(
         spec="fetch:delay:1.0@1x1", expect="raised",
         verify=_v_typed("WatchdogTimeout"),
@@ -683,6 +855,26 @@ CELLS: dict[str, dict] = {
 # ----------------------------------------------------------------- #
 # child / parent drivers                                            #
 # ----------------------------------------------------------------- #
+
+def fused_victim_child(out: Path) -> None:
+    """The ``fused_restack_sigkill`` victim: fused fleet, envelope-
+    growing admission, atomic checkpoint, marker, then step until
+    killed."""
+    from magicsoup_tpu.fleet.persist import save_fleet
+
+    fleet, kw = _fused_fleet()
+    for _ in range(2):
+        fleet.step()
+    kw4 = dict(kw, megastep=4)
+    fleet.admit(_tiny_world(13, map_size=16, n_cells=12, genome_size=120), **kw4)
+    fleet.step()
+    fleet.flush()
+    save_fleet(out / "fused_fleet.ck", fleet, step=3, meta={"step": 3})
+    print(json.dumps({"event": "checkpointed", "step": 3}), flush=True)
+    for _ in range(10_000):  # SIGKILLed from the parent mid-loop
+        fleet.step()
+    fleet.flush()
+
 
 def run_cell_child(name: str) -> None:
     fn = globals()[f"cell_{name}"]
@@ -781,6 +973,7 @@ def run_matrix(names: list[str], timeout: float) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cell", help=argparse.SUPPRESS)
+    ap.add_argument("--fused-victim", default="", help=argparse.SUPPRESS)
     ap.add_argument("--gate", action="store_true",
                     help="run only the fast GATING subset")
     ap.add_argument("--only", default="",
@@ -791,6 +984,9 @@ def main() -> None:
     ap.add_argument("--out", default="", help="also write the matrix here")
     args = ap.parse_args()
 
+    if args.fused_victim:
+        fused_victim_child(Path(args.fused_victim))
+        return
     if args.cell:
         run_cell_child(args.cell)
         return

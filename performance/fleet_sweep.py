@@ -18,6 +18,15 @@ Worlds are chemistry-only (selection disabled) and identically
 constructed so all B share ONE capacity rung — a single compiled
 variant, a single group dispatch, zero admission compiles.  BENCH_NOTES
 records the measured sweep.
+
+``--mixed-rungs`` benches the cross-rung fusion plane instead: R
+capacity rungs (map size doubling per rung) x B worlds per rung, each
+point measured under ``fusion="rung"`` (R launches + R fetches per
+megastep) and ``fusion="fleet"`` (ONE fused launch + ONE physical
+fetch).  The fused row carries ``speedup`` over the per-rung row; the
+capture lands in ``fleet_fused.log`` and
+``scripts/summarize_capture.py`` folds it into
+``published["fleet_fused"]`` keyed ``R{rungs}B{b}``, best-value-wins.
 """
 import argparse
 import json
@@ -32,6 +41,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bs", default="1,4,16,64", help="comma-separated fleet sizes")
     ap.add_argument("--ks", default="1,4", help="comma-separated K values")
+    ap.add_argument(
+        "--mixed-rungs",
+        action="store_true",
+        help="bench fused vs per-rung dispatch across rung-count x B",
+    )
+    ap.add_argument(
+        "--rungs",
+        default="2,3",
+        help="comma-separated rung counts for --mixed-rungs",
+    )
     ap.add_argument("--n-cells", type=int, default=64)
     ap.add_argument("--map-size", type=int, default=32)
     ap.add_argument("--genome-size", type=int, default=300)
@@ -83,9 +102,12 @@ def main() -> None:
     ]
     chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
 
-    def _world(seed):
-        w = ms.World(chemistry=chem, map_size=args.map_size, seed=seed)
+    def _world(seed, map_size=None):
+        w = ms.World(
+            chemistry=chem, map_size=map_size or args.map_size, seed=seed
+        )
         # identical genome streams -> identical token caps -> one rung
+        # per map size
         rng = random.Random(args.seed)
         w.spawn_cells(
             [
@@ -95,23 +117,78 @@ def main() -> None:
         )
         return w
 
+    _admit_kw = dict(
+        mol_name="fsw-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+    )
+
+    if args.mixed_rungs:
+        rungs = sorted({int(r) for r in args.rungs.split(",")})
+        k = ks[0]  # the mixed sweep holds K fixed (first of --ks)
+        n_disp = max(1, -(-args.steps // k))
+        for n_rungs in rungs:
+            for b in bs:
+                per_world = {}
+                for mode in ("rung", "fleet"):
+                    fleet = FleetScheduler(block=b, fusion=mode)
+                    for r in range(n_rungs):
+                        msz = args.map_size * (2**r)
+                        for i in range(b):
+                            fleet.admit(
+                                _world(
+                                    args.seed + 100 * r + i, map_size=msz
+                                ),
+                                megastep=k,
+                                **_admit_kw,
+                            )
+                    for _ in range(max(args.warmup, 2)):
+                        fleet.step()
+                    fleet.drain()
+                    t0 = time.perf_counter()
+                    for _ in range(n_disp):
+                        fleet.step()
+                    fleet.drain()
+                    dt = (time.perf_counter() - t0) / (n_disp * k)
+                    fleet.flush()
+                    per_world[mode] = 1.0 / dt
+                    fused = mode == "fleet"
+                    row = {
+                        "metric": (
+                            f"fleet {'fused' if fused else 'per-rung'} "
+                            f"R={n_rungs} B={b} per-world steps/sec "
+                            f"({args.n_cells} cells, base map "
+                            f"{args.map_size}, {jax.default_backend()})"
+                        ),
+                        "value": round(per_world[mode], 4),
+                        "unit": "steps/s",
+                        "rungs": n_rungs,
+                        "fleet_size": b,
+                        "worlds": b * n_rungs,
+                        "fused": fused,
+                        "megastep": k,
+                        "dispatches": n_disp,
+                        "ms_per_step": round(dt * 1e3, 2),
+                        "backend": jax.default_backend(),
+                    }
+                    if fused:
+                        row["speedup"] = round(
+                            per_world["fleet"] / per_world["rung"], 4
+                        )
+                    print(json.dumps(row), flush=True)
+        return
+
     for k in ks:
         for b in bs:
             fleet = FleetScheduler(block=b)
             for i in range(b):
-                fleet.admit(
-                    _world(args.seed + i),
-                    mol_name="fsw-atp",
-                    kill_below=-1.0,
-                    divide_above=1e30,
-                    divide_cost=0.0,
-                    target_cells=None,
-                    genome_size=args.genome_size,
-                    lag=1,
-                    megastep=k,
-                    p_mutation=0.0,
-                    p_recombination=0.0,
-                )
+                fleet.admit(_world(args.seed + i), megastep=k, **_admit_kw)
             for _ in range(max(args.warmup, 2)):
                 fleet.step()
             fleet.drain()

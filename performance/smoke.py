@@ -34,6 +34,13 @@ lanes on every dispatch row), the warm steady state must pass
 ``hot_path_guard(compile_budget=0)``, and the fetch census must show
 exactly ONE host fetch per rung group per megastep (no per-world D2H).
 
+``--fused`` runs the cross-rung fusion smoke (GATING): B=4 det-mode
+worlds across two capacity rungs under ``fusion="fleet"`` — the warm
+steady state must pass ``hot_path_guard(compile_budget=0)`` while the
+``runtime.snapshot()`` censuses count exactly ONE device dispatch and
+ONE physical fetch per megastep for the WHOLE fleet (``fused_groups``
+bills both rungs into the single launch).
+
 ``--fleet-chaos`` runs the graftwarden smoke (GATING): a B=3 det fleet
 under ``policy="heal"`` has world 1 NaN-poisoned mid-run — only that
 world may be evicted, it must heal from its own rolling checkpoint
@@ -125,6 +132,8 @@ def main() -> None:
     )
     # graftfleet smoke (see fleet_main below)
     ap.add_argument("--fleet", action="store_true")
+    # cross-rung fused dispatch smoke (see fused_main below)
+    ap.add_argument("--fused", action="store_true")
     # device-resident-genome smoke (see genome_main below)
     ap.add_argument("--genome", action="store_true")
     # graftwarden fault-isolation smoke (see fleet_chaos_main below)
@@ -142,6 +151,8 @@ def main() -> None:
         return differential_main(args)
     if args.fleet:
         return fleet_main(args)
+    if args.fused:
+        return fused_main(args)
     if args.genome:
         return genome_main(args)
     if args.fleet_chaos:
@@ -848,6 +859,137 @@ def fleet_main(args) -> None:
     )
     if problems:
         raise SystemExit("fleet smoke FAILED: " + "; ".join(problems))
+
+
+def fused_main(args) -> None:
+    """GATING cross-rung fusion smoke: B=4 det-mode worlds across TWO
+    capacity rungs under ``fusion="fleet"``.
+
+    Gates, in order: the warm steady state must pass
+    ``hot_path_guard(compile_budget=0)``; the ``runtime.snapshot()``
+    dispatch census must count exactly ONE device dispatch per megastep
+    for the WHOLE fleet (with ``fused_groups`` billing both rungs into
+    that single launch); and the fetch census must count exactly ONE
+    physical D2H transfer per megastep — the per-rung fetches of the
+    ``--fleet`` smoke collapse into one shared envelope record.
+    """
+    import os
+
+    os.environ.setdefault("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.fleet import FleetScheduler
+    from magicsoup_tpu.telemetry import fetch_stats
+
+    mols = [
+        ms.Molecule("fsd-a", 10e3),
+        ms.Molecule("fsd-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+
+    def _world(seed, map_size):
+        w = ms.World(chemistry=chem, map_size=map_size, seed=seed)
+        w.deterministic = True
+        rng = random.Random(99)  # same genomes -> same token rung
+        w.spawn_cells(
+            [
+                ms.random_genome(s=args.genome_size, rng=rng)
+                for _ in range(args.n_cells)
+            ]
+        )
+        return w
+
+    kw = dict(
+        mol_name="fsd-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=args.megastep,
+    )
+    fleet = FleetScheduler(block=2, fusion="fleet")
+    lanes = [
+        fleet.admit(_world(7, args.map_size), **kw),
+        fleet.admit(_world(11, args.map_size), **kw),
+        # double map size -> a different capacity rung, its own group
+        fleet.admit(_world(13, args.map_size * 2), **kw),
+        fleet.admit(_world(17, args.map_size * 2), **kw),
+    ]
+
+    for _ in range(args.warmup + 1):
+        fleet.step()
+    fleet.drain()
+    n_groups = len(fleet._groups)
+
+    problems = []
+    f0 = fetch_stats()["fetches"]
+    base = runtime.snapshot()
+    t0 = time.perf_counter()
+    try:
+        with runtime.hot_path_guard(compile_budget=0):
+            for _ in range(args.steps):
+                fleet.step()
+            fleet.drain()
+    except runtime.CompileBudgetExceeded as e:
+        problems.append(str(e))
+    dt = time.perf_counter() - t0
+    fetches = fetch_stats()["fetches"] - f0
+    snap = runtime.snapshot()
+    dispatches = snap["dispatches"] - base["dispatches"]
+    fused_groups = snap["fused_groups"] - base["fused_groups"]
+    fleet.flush()
+
+    if n_groups != 2:
+        problems.append(f"expected 2 rung groups, got {n_groups}")
+    if dispatches != args.steps:
+        problems.append(
+            f"dispatch census: {dispatches} dispatches for {args.steps} "
+            f"megasteps (want exactly ONE fused launch per megastep)"
+        )
+    if fused_groups != args.steps * n_groups:
+        problems.append(
+            f"fused_groups census: {fused_groups} for {args.steps} "
+            f"megasteps x {n_groups} rungs (every rung must ride the "
+            f"fused launch)"
+        )
+    if fetches != args.steps:
+        problems.append(
+            f"fetch census: {fetches} fetches for {args.steps} megasteps "
+            f"(want exactly ONE shared envelope fetch per megastep)"
+        )
+    per_world = args.steps * args.megastep / dt if dt > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"fused dispatch smoke (B={len(lanes)} worlds, "
+                    f"{n_groups} rungs, cpu)"
+                ),
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "per_world_steps_per_s": round(per_world, 4),
+                "dispatches_per_megastep": dispatches / max(args.steps, 1),
+                "fetches_per_megastep": fetches / max(args.steps, 1),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("fused smoke FAILED: " + "; ".join(problems))
 
 
 def genome_main(args) -> None:

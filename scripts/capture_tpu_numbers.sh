@@ -124,6 +124,7 @@ run multichip       1800 python performance/mesh_sweep.py --devices 1,2,4,8 --pl
 # The B=1 vs B=16 per-world ratio IS the dispatch-amortization number
 # the graftfleet batch axis exists for.
 run fleet           1800 python performance/fleet_sweep.py --platform ''
+run fleet_fused     1800 python performance/fleet_sweep.py --mixed-rungs --bs 1,4,16 --platform ''
 run check           1200 python performance/check.py
 # string engine vs device token kernels per (op, backend, size): one
 # JSON row per point that summarize_capture publishes under

@@ -197,6 +197,23 @@ def summarize(outdir: Path) -> dict:
                 continue  # keep an existing clean row over a later error
             points[key] = r
         summary["fleet"] = points
+    # performance/fleet_sweep.py --mixed-rungs rows: the cross-rung
+    # fusion capture.  The FUSED row per (rungs, B) point is the
+    # headline (it carries "speedup" over its per-rung twin); keyed
+    # "R{r}B{b}", same last-clean-row rule
+    fused_rows = [
+        r
+        for r in _json_lines(outdir / "fleet_fused.log")
+        if r.get("fused") and "rungs" in r and "value" in r
+    ]
+    if fused_rows:
+        fpoints: dict = {}
+        for r in fused_rows:
+            key = f"R{r['rungs']}B{r['fleet_size']}"
+            if "error" in r and "error" not in fpoints.get(key, {"error": 1}):
+                continue  # keep an existing clean row over a later error
+            fpoints[key] = r
+        summary["fleet_fused"] = fpoints
     reps = [r for r in _json_lines(outdir / "bitrepro.log") if "result" in r]
     if reps:
         summary["bitrepro"] = reps[-1]
@@ -316,6 +333,24 @@ def publish(summary: dict) -> None:
             ):
                 continue
             pub_fleet[point] = {**entry, "capture_dir": summary["capture_dir"]}
+            merged = True
+    fused = summary.get("fleet_fused")
+    if fused:
+        pub_fused = published.setdefault("fleet_fused", {})
+        for point, entry in fused.items():
+            if "error" in entry:
+                continue
+            # per-(rungs,B)-point best-value-wins, same metric-match
+            # rule: a changed mixed-rung workload renames the metric
+            # and must overwrite rather than chase a stale record
+            prev = pub_fused.get(point)
+            if (
+                isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
+                and prev.get("value", 0) >= entry.get("value", 0)
+            ):
+                continue
+            pub_fused[point] = {**entry, "capture_dir": summary["capture_dir"]}
             merged = True
     tel = summary.get("telemetry")
     # per-phase dispatch timings (p50/p95) live next to check_ops: both

@@ -53,6 +53,13 @@ python performance/smoke.py --chaos
 # fleet_size lanes on every dispatch row.  Exits nonzero on any
 # violation.
 python performance/smoke.py --fleet
+# cross-rung fused dispatch smoke (GATING): B=4 det-mode worlds across
+# two capacity rungs under fusion="fleet" — the warm steady state must
+# pass hot_path_guard(compile_budget=0) while the runtime.snapshot()
+# censuses count exactly ONE device dispatch + ONE physical fetch per
+# megastep for the WHOLE fleet (fused_groups bills both rungs into the
+# single launch).  Exits nonzero on any violation.
+python performance/smoke.py --fused
 # device-resident-genome smoke (GATING): a token-backed and a
 # string-backed det-mode world drive the same seeded
 # mutate -> recombinate -> translate -> divide schedule (the string
@@ -93,9 +100,11 @@ python performance/smoke.py --serve
 # graftchaos campaign gate (GATING): the fast subset of the chaos
 # matrix (performance/chaos_matrix.py) — checkpoint ENOSPC mid-save
 # (counted, next save lands, no torn file), torn-write walk-back,
-# checkpoint-read EIO (typed CheckpointError check="io"), and the serve
-# command queue rejecting with 503 + Retry-After — each cell in a
+# checkpoint-read EIO (typed CheckpointError check="io"), a transient
+# dispatch fault under a FUSED mixed-rung launch (absorbed, every
+# co-fused tenant bit-identical), and the serve command queue
+# rejecting with 503 + Retry-After — each cell in a
 # timeout-bounded child process, each required to terminate in exactly
 # its contract state (recovered | degraded | raised).  Exits nonzero on
-# any contract violation; the full 14-cell matrix runs with no flag.
+# any contract violation; the full 16-cell matrix runs with no flag.
 python performance/chaos_matrix.py --gate

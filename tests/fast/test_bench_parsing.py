@@ -347,6 +347,89 @@ def test_publish_fleet_best_value_per_point(tmp_path, monkeypatch):
     assert out["B1K1"]["capture_dir"].endswith("cap-a")
 
 
+def _fused_row(
+    r: int,
+    b: int,
+    value: float,
+    *,
+    fused: bool = True,
+    error: str | None = None,
+) -> str:
+    row = {
+        "metric": (
+            f"fleet {'fused' if fused else 'per-rung'} R={r} B={b} "
+            f"per-world steps/sec (64 cells, base map 32, tpu)"
+        ),
+        "value": value,
+        "unit": "steps/s",
+        "rungs": r,
+        "fleet_size": b,
+        "worlds": r * b,
+        "fused": fused,
+        "megastep": 1,
+    }
+    if fused:
+        row["speedup"] = 1.5
+    if error is not None:
+        row["error"] = error
+    return json.dumps(row)
+
+
+def test_summarize_fleet_fused_per_point_rows(tmp_path):
+    # performance/fleet_sweep.py --mixed-rungs prints a per-rung row
+    # AND a fused row per (rungs, B) point; the summary keys the FUSED
+    # rows "R{r}B{b}" (they carry the speedup over their per-rung
+    # twin), last clean row per point wins, per-rung rows are raw data
+    (tmp_path / "fleet_fused.log").write_text(
+        _fused_row(2, 4, 30.0, fused=False)
+        + "\n"
+        + _fused_row(2, 4, 45.0)
+        + "\n"
+        + _fused_row(3, 4, 0.0, error="oom")
+        + "\n"
+        + _fused_row(3, 4, 28.0)
+        + "\n"
+        + _fused_row(3, 16, 0.0, error="tunnel dropped")
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    fused = summary["fleet_fused"]
+    assert fused["R2B4"]["value"] == 45.0  # the fused row, not per-rung
+    assert fused["R2B4"]["speedup"] == 1.5
+    assert fused["R3B4"]["value"] == 28.0 and "error" not in fused["R3B4"]
+    # error-only point: the error survives into the summary (visibility)
+    assert fused["R3B16"]["error"] == "tunnel dropped"
+
+
+def test_publish_fleet_fused_best_value_per_point(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(rows: list[str], tag: str) -> dict:
+        cap = tmp_path / f"cap-{tag}"
+        cap.mkdir(exist_ok=True)
+        (cap / "fleet_fused.log").write_text("\n".join(rows) + "\n")
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]["fleet_fused"]
+
+    out = pub([_fused_row(2, 4, 45.0), _fused_row(3, 4, 28.0)], "a")
+    assert out["R2B4"]["value"] == 45.0 and out["R3B4"]["value"] == 28.0
+    out = pub(
+        [
+            _fused_row(2, 4, 40.0),
+            _fused_row(3, 4, 33.0),
+            _fused_row(3, 16, 0.0, error="tunnel dropped"),
+        ],
+        "b",
+    )
+    assert out["R2B4"]["value"] == 45.0  # best record kept
+    assert out["R3B4"]["value"] == 33.0  # upgraded
+    assert "R3B16" not in out  # error never published
+    assert out["R3B4"]["capture_dir"].endswith("cap-b")
+    assert out["R2B4"]["capture_dir"].endswith("cap-a")
+
+
 def test_publish_check_ops_lower_is_better(tmp_path, monkeypatch):
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(json.dumps({"published": {}}) + "\n")

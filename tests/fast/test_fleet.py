@@ -16,6 +16,13 @@ contracts from the module docstring, pinned.
 
 Plus the placement edges (retire -> solo, managed ``step()`` refusal)
 and the world-axis sharded program's det-mode equality.
+
+4. **cross-rung fusion** — a mixed-rung fleet under
+   ``fusion="fleet"``/``"auto"`` costs ONE dispatch + ONE physical
+   fetch per megastep for the WHOLE fleet, every world stays
+   bit-identical to its solo run (the fused program runs each rung's
+   body at native shapes), warm admission compiles nothing, and
+   envelope growth is exactly one counted recompile.
 """
 import json
 import random
@@ -333,6 +340,177 @@ def test_restack_and_attach_counters():
     snap = runtime.snapshot()
     assert snap["attach_full"] == base["attach_full"]
     assert snap["attach_skipped"] == base["attach_skipped"] + 3
+
+
+# ------------------------------------------------- cross-rung fusion
+@pytest.mark.parametrize(
+    "fused_path,solo_path", [("fused2", "k1"), ("fused_fleet", "k4")]
+)
+def test_fused_fleet_matches_solo_per_boundary(fused_path, solo_path):
+    """The differential fused axes: the schedule world steps inside a
+    MIXED-rung fused fleet (companions on a double-sized map) and its
+    boundary digests still equal the plain solo stepper's — fusion is
+    structurally invisible to every tenant's trajectory."""
+    solo = differential.run_path(solo_path)
+    fused = differential.run_path(fused_path)
+    for i, (want, got) in enumerate(zip(solo, fused)):
+        assert want == got, (
+            f"{fused_path} forked from {solo_path} at boundary "
+            f"{differential.BOUNDARIES[i]}"
+        )
+
+
+def test_fused_mixed_fleet_each_world_matches_solo():
+    """Tentpole acceptance: every world of a B=4 two-rung fused fleet
+    is bit-identical to its own solo run, while the whole fleet costs
+    ONE dispatch per megastep (``fused_groups`` bills the rung bodies
+    batched inside each launch)."""
+    spec = ((7, 16), (11, 16), (17, 32), (23, 32))
+    n_megasteps = 3
+
+    solo_prints = []
+    for s, m in spec:
+        st = PipelinedStepper(_world(seed=s, map_size=m), **_KW_CHEM)
+        for _ in range(n_megasteps):
+            st.step()
+        solo_prints.append(_fingerprint(st.world, st))
+
+    fleet = FleetScheduler(block=2, fusion="fleet")
+    lanes = [
+        fleet.admit(_world(seed=s, map_size=m), **_KW_CHEM) for s, m in spec
+    ]
+    base = runtime.snapshot()
+    for _ in range(n_megasteps):
+        fleet.step()
+    fleet.drain()
+    snap = runtime.snapshot()
+    assert snap["dispatches"] - base["dispatches"] == n_megasteps
+    assert snap["fused_groups"] - base["fused_groups"] == n_megasteps * 2
+    for i, lane in enumerate(lanes):
+        _assert_identical(
+            solo_prints[i],
+            _fingerprint(lane.world, lane),
+            label=f"world {i}: ",
+        )
+
+
+@pytest.fixture(scope="module")
+def fused_fleet():
+    """A warm MIXED-rung fused fleet: rung 16 full (two members), rung
+    32 holding one member plus a padded free slot (what makes warm
+    fused admission real).  ``fusion="fleet"`` pins the steady state to
+    one batched program + one physical fetch per megastep."""
+    fleet = FleetScheduler(block=2, fusion="fleet")
+    for s, m in ((7, 16), (11, 16), (17, 32)):
+        fleet.admit(_world(seed=s, map_size=m, genome_rng=99), **_KW_CHEM)
+    for _ in range(4):
+        fleet.step()
+    fleet.drain()
+    return fleet
+
+
+def test_fused_warm_admission_compiles_nothing(fused_fleet):
+    """Admitting into a warm rung's free slot leaves the fused
+    signature untouched — group shapes, envelope, and statics are all
+    unchanged, so admit + the next two fused steps compile ZERO new
+    programs.  Seed 21 matters: genome translation runs through the
+    WORLD-seeded genetics tables, so the shared genome list must land
+    within the warm rung's token limits (maxp 8, maxd 2) for this to
+    be a warm admission rather than a statics-growing one."""
+    before = runtime.compile_count()
+    lane = fused_fleet.admit(
+        _world(seed=21, map_size=32, genome_rng=99), **_KW_CHEM
+    )
+    fused_fleet.step()
+    fused_fleet.step()
+    fused_fleet.drain()
+    assert runtime.compile_count() - before == 0
+    assert len(fused_fleet._groups) == 2
+    assert lane._fleet_slot is not None
+
+
+def test_fused_one_dispatch_one_fetch_per_megastep(fused_fleet):
+    """The fused census: B=4 worlds across TWO rungs cost ONE device
+    dispatch and ONE sanctioned D2H transfer per megastep — not one
+    per rung group."""
+    assert len(fused_fleet.lanes) == 4
+    fused_fleet.drain()
+    before_fetch = fetch_stats()["fetches"]
+    base = runtime.snapshot()
+    for _ in range(4):
+        fused_fleet.step()
+    fused_fleet.drain()
+    snap = runtime.snapshot()
+    assert fetch_stats()["fetches"] - before_fetch == 4
+    assert snap["dispatches"] - base["dispatches"] == 4
+    assert snap["fused_groups"] - base["fused_groups"] == 8
+
+
+def test_fused_steady_state_passes_hot_path_guard(fused_fleet):
+    """Once the fused signature is warm, mixed-rung stepping compiles
+    nothing and makes no implicit transfers."""
+    fused_fleet.drain()
+    with runtime.hot_path_guard(compile_budget=0):
+        fused_fleet.step()
+        fused_fleet.step()
+        fused_fleet.drain()
+
+
+def test_fused_telemetry_rows_validate(fused_fleet, tmp_path):
+    """Fused dispatch rows pass the schema gate and carry the fusion
+    lineage: how many rung groups shared the launch, and the record
+    envelope the shared fetch was padded to."""
+    lane = fused_fleet.lanes[0]
+    path = tmp_path / "fused.jsonl"
+    lane.telemetry.attach(path)
+    try:
+        fused_fleet.step()
+        fused_fleet.step()
+        fused_fleet.drain()
+        lane.telemetry.flush()
+    finally:
+        lane.telemetry.detach()
+    rows = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line.strip()
+    ]
+    assert validate_rows(rows) == []
+    dispatch = [r for r in rows if r.get("type") == "dispatch"]
+    assert dispatch, "no dispatch rows emitted"
+    for row in dispatch:
+        assert row["fused_groups"] == 2
+        k_env, rec_env = row["envelope"]
+        assert k_env >= _KW_CHEM["megastep"]
+        assert rec_env > 0
+
+
+def test_fused_envelope_growth_one_recompile():
+    """Acceptance: a NEW rung joining a fused fleet bumps the grow-only
+    record envelope and costs exactly ONE counted recompile — the fused
+    program at its new signature.  Every per-shape program for the
+    incoming rung is pre-warmed through a throwaway fleet (jit caches
+    are process-global), so the fused program is the only cold
+    artifact left."""
+    warm = FleetScheduler(block=2)
+    warm.admit(_world(seed=31, map_size=64, genome_rng=99), **_KW_CHEM)
+    for _ in range(2):
+        warm.step()
+    warm.drain()
+
+    fleet = FleetScheduler(block=2, fusion="fleet")
+    for s, m in ((7, 16), (11, 32)):
+        fleet.admit(_world(seed=s, map_size=m, genome_rng=99), **_KW_CHEM)
+    for _ in range(3):
+        fleet.step()
+    fleet.drain()
+
+    before = runtime.compile_count()
+    fleet.admit(_world(seed=37, map_size=64, genome_rng=99), **_KW_CHEM)
+    fleet.step()
+    fleet.step()
+    fleet.drain()
+    assert runtime.compile_count() - before == 1
 
 
 # --------------------------------------------------- world-axis mesh

@@ -229,6 +229,38 @@ def test_budget_pause_is_trajectory_invisible(tmp_path):
     )
 
 
+def test_accounting_conserves_fused_fetch_bytes(tmp_path):
+    """Under cross-rung fusion the whole fleet's megastep is ONE
+    physical envelope fetch; the ledger's even split must still sum
+    EXACTLY to the process byte total — including a subset-stepped
+    megastep where only one tenant holds budget and rides the launch
+    alone."""
+    svc = _service(tmp_path / "srv", fusion="fleet")
+    svc._execute("create", _spec("alpha", seed=7))
+    # double map size -> a different capacity rung, co-fused with alpha
+    svc._execute("create", _spec("beta", seed=11, map_size=32))
+    svc._execute("step", {"tenant": "alpha", "megasteps": 2})
+    svc._execute("step", {"tenant": "beta", "megasteps": 2})
+    _drain(svc)
+    # subset-stepped megastep: only alpha holds budget
+    svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+    _drain(svc)
+
+    acct = svc._execute("accounting", {})
+    rows = acct["rows"]
+    assert validate_rows(rows) == []
+    assert [r["tenant"] for r in rows] == ["alpha", "beta"]
+    # steps: alpha 3 megasteps x k=2, beta 2 x 2
+    assert acct["total_steps"] == 10 == sum(r["steps"] for r in rows)
+    # the conservation invariant: per-tenant shares of the fused
+    # envelope fetches sum EXACTLY to the process total, nothing
+    # dropped on the megastep beta sat out
+    assert acct["total_fetch_bytes"] == sum(
+        r["fetch_bytes"] for r in rows
+    )
+    assert all(r["fetch_bytes"] > 0 for r in rows)
+
+
 # --------------------------------------------------------- admission
 def test_admission_budget_queue_and_warm_rung(tmp_path):
     svc = _service(tmp_path / "srv", compile_budget=0)
