@@ -56,6 +56,13 @@ MODULES = {
         " runtime counter snapshots, profiler tracing, and the"
         " `python -m magicsoup_tpu.telemetry summarize` CLI."
     ),
+    "magicsoup_tpu.telemetry.metrics": (
+        "graftpulse live metrics: the stdlib-pure thread-safe registry"
+        " behind `GET /metrics` (Prometheus exposition-format 0.0.4),"
+        " the exposition parser, and the commit-to-fetch-ready device"
+        " time census the serve ledger bills per-tenant `device_us`"
+        " from."
+    ),
     "magicsoup_tpu.guard": (
         "graftguard fault tolerance: crash-safe checkpoints,"
         " deterministic resume, health sentinels, watchdogs, and the"

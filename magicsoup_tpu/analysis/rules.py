@@ -55,6 +55,11 @@ Findings; registration at the bottom.
 |       |                      | sibling` loop in fleet/serve-scoped        |
 |       |                      | modules — dispatches route through the     |
 |       |                      | fusion planner, or carry a waiver)         |
+| GL025 | bare-clock-in-hot-   | the graftpulse measurement plane (no bare  |
+|       | path                 | `time.time()`/`perf_counter()` readings in |
+|       |                      | stepper/fleet/serve hot functions unless   |
+|       |                      | the measurement routes into the recorder   |
+|       |                      | span API or the metrics registry)          |
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
@@ -238,6 +243,18 @@ RULE_INFO = {
         "cross-rung fusion planner deletes; route the loop through "
         "FleetScheduler._plan_fusion (one batched program per fused "
         "set) or waive a deliberate per-group path",
+    ),
+    "GL025": (
+        "bare-clock-in-hot-path",
+        "a bare `time.time()` / `time.perf_counter()` / "
+        "`time.monotonic()` reading inside a stepper-, fleet-, or "
+        "serve-scoped hot function whose measurement never routes into "
+        "the telemetry plane — timings taken on the hot path and kept "
+        "in local state are invisible to the recorder spans, the "
+        "graftpulse metrics registry, and therefore to `/metrics`; "
+        "route the reading through the recorder span API "
+        "(TelemetryRecorder.note) or the metrics registry (observe / "
+        "note_device_time), or waive a deliberate local timing",
     ),
 }
 # the graftrace concurrency rules keep their metadata next to their
@@ -1656,6 +1673,95 @@ def check_gl024(ctx: Context):
                         )
 
 
+# --------------------------------------------------------------- GL025
+#: attribute chains that read a wall/monotonic clock; covers the repo's
+#: idioms (`time.perf_counter()`, `import time as _time`, and the bare
+#: from-import forms). `time.sleep` et al. are not readings.
+_BARE_CLOCK_CHAINS = {
+    "time.time",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "_time.time",
+    "_time.monotonic",
+    "_time.perf_counter",
+    "perf_counter",
+    "perf_counter_ns",
+    "monotonic",
+    "monotonic_ns",
+}
+#: call leaves that carry a measurement into the telemetry plane: the
+#: recorder span API (`note`, `span`), the graftpulse registry
+#: (`observe`, plus the device census `note_device_time` and the
+#: commit-to-fetch-ready bracket constructor `_device_ready`), and the
+#: dispatch-row drain (`take_dispatch`).  A hot function containing one
+#: of these is routing its clock readings, not hoarding them.
+_CLOCK_ROUTING_LEAVES = {
+    "note",
+    "span",
+    "observe",
+    "note_device_time",
+    "_device_ready",
+    "take_dispatch",
+}
+
+
+def _routes_clock_readings(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            leaf = _attr_chain(node.func).rsplit(".", 1)[-1]
+            if leaf in _CLOCK_ROUTING_LEAVES:
+                return True
+    return False
+
+
+def check_gl025(ctx: Context):
+    """Clock readings on the hot path must feed the telemetry plane.
+    A bare ``time.time()`` / ``perf_counter()`` / ``monotonic()`` in a
+    stepper-, fleet-, or serve-scoped hot function is a measurement the
+    operator can never see: it costs a syscall on the step loop's
+    critical path and then dies in a local, bypassing the recorder
+    spans and the graftpulse registry that ``/metrics`` exposes.  A
+    function that also calls the span/registry route
+    (:data:`_CLOCK_ROUTING_LEAVES`, nested closures included) is
+    exempt — its readings land in telemetry.  Deliberate local timings
+    (e.g. a deadline check) waive with
+    ``# graftlint: disable=GL025``."""
+    fix = (
+        "route the measurement into the telemetry plane: bracket the "
+        "reading with TelemetryRecorder.note(phase, dt) or feed a "
+        "registry histogram/census (MetricsRegistry.observe, "
+        "telemetry.metrics.note_device_time), or waive a deliberate "
+        "local timing with `# graftlint: disable=GL025`"
+    )
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        if not (
+            _is_stepper_scoped(f)
+            or _is_fleet_scoped(f)
+            or _is_serve_scoped(f)
+        ):
+            continue
+        if _routes_clock_readings(rec.node):
+            continue
+        for node in ast.walk(rec.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain in _BARE_CLOCK_CHAINS:
+                yield _finding(
+                    "GL025",
+                    f,
+                    node,
+                    f"`{chain}()` in hot function `{rec.qualname}` takes "
+                    "a clock reading that never reaches the telemetry "
+                    "plane — invisible to recorder spans and /metrics",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1681,6 +1787,7 @@ CHECKERS = {
     "GL022": dataflow.check_gl022,
     "GL023": check_gl023,
     "GL024": check_gl024,
+    "GL025": check_gl025,
 }
 
 

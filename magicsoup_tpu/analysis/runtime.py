@@ -291,9 +291,11 @@ def snapshot() -> dict[str, int]:
     telemetry ``counters`` rows as everything else).
     """
     install()
-    # lazy import, and strictly runtime -> chaos: guard.chaos never
-    # imports this module, so the counter merge cannot cycle
+    # lazy imports, and strictly runtime -> chaos / runtime -> metrics:
+    # neither guard.chaos nor telemetry.metrics (stdlib-pure) imports
+    # this module, so the counter merges cannot cycle
     from magicsoup_tpu.guard import chaos as _chaos
+    from magicsoup_tpu.telemetry import metrics as _metrics
 
     with _lock:
         out = {
@@ -315,6 +317,10 @@ def snapshot() -> dict[str, int]:
             "genome_decode_rows": _genome_decode_rows,
         }
     out.update(_chaos.runtime_counters())
+    # graftpulse device-time census (device_time_us/device_dispatches):
+    # fed by the stepper/fleet fetch-ready callbacks, billed per-tenant
+    # by serve.accounting, scraped via GET /metrics
+    out.update(_metrics.device_time_stats())
     return out
 
 
@@ -334,6 +340,7 @@ def reset_counters() -> None:
     global _dispatches, _fused_groups
     global _genome_decode_calls, _genome_decode_rows
     from magicsoup_tpu.guard import chaos as _chaos
+    from magicsoup_tpu.telemetry import metrics as _metrics
 
     with _lock:
         _count = 0
@@ -353,3 +360,4 @@ def reset_counters() -> None:
         _genome_decode_calls = 0
         _genome_decode_rows = 0
     _chaos.reset_counters()
+    _metrics.reset_device_time()

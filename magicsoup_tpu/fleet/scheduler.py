@@ -74,10 +74,33 @@ from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.fleet.lanes import FleetLane
 from magicsoup_tpu.guard import chaos as _chaos
 from magicsoup_tpu.stepper import _LazyFetch, crop_fused_record, record_length
+from magicsoup_tpu.telemetry import metrics as _metrics
 
 __all__ = ["FleetScheduler"]
 
 _OOB_ROW = np.iinfo(np.int32).max
+
+
+def _device_ready(t_dispatched: float, lanes):
+    """graftpulse device-time bracket for a SHARED fleet dispatch: the
+    fetch-ready callback closes the commit-to-fetch-ready span once —
+    one process census entry per physical launch (conservation is per
+    physical dispatch) — and notes the full span as the ``"device"``
+    phase on every rider lane's recorder, mirroring the solo stepper's
+    ``_device_ready``.  Fires on the fetch worker thread before any
+    lane's ``result()`` returns, so ``drain()`` implies a settled
+    census."""
+    import time as _time
+
+    recorders = tuple(lane.telemetry for lane in lanes)
+
+    def _ready():
+        dt = _time.perf_counter() - t_dispatched
+        _metrics.note_device_time(dt)
+        for rec in recorders:
+            rec.note("device", dt)
+
+    return _ready
 
 
 class _SharedFetch:
@@ -815,6 +838,7 @@ class FleetScheduler:
                 f"#{fault.index}"
             )
 
+    # graftlint: hot
     def _dispatch_group(self, group: _FleetGroup, plans: dict) -> None:
         import time as _time
 
@@ -845,11 +869,18 @@ class FleetScheduler:
         group.warm.add(vkey)
         _runtime.note_dispatch(dispatches=1, fused_groups=1)
 
-        # one fetch for the whole group; lanes replay their slices
+        # one fetch for the whole group; lanes replay their slices.
+        # graftpulse device-time bracket: ONE census entry per physical
+        # dispatch; every member lane's recorder gets the full span (the
+        # shared program ran FOR each of them — same cost model as the
+        # shared `dispatches` counter)
+        ready = _device_ready(
+            t_dispatched, [lane for _, lane in group.members()]
+        )
         fut = (
-            first._fetcher.submit(fouts)
+            first._fetcher.submit(fouts, on_ready=ready)
             if first._fetcher is not None
-            else _LazyFetch(fouts)
+            else _LazyFetch(fouts, on_ready=ready)
         )
         shared = _SharedFetch(
             fut,
@@ -872,6 +903,7 @@ class FleetScheduler:
                 extra_row={"fleet_slot": slot, "fleet_size": gi.B},
             )
 
+    # graftlint: hot
     def _dispatch_fused(self, fused_set: list, plans: dict) -> None:
         """ONE batched program + ONE physical fetch for a whole fused
         set of rung groups.  Every rung keeps its native shapes inside
@@ -928,11 +960,17 @@ class FleetScheduler:
             group.fstate, group.fparams = fs, fp
 
         # ONE physical fetch for the whole fused set; each lane crops
-        # its native (k, record) view out of its world-row
+        # its native (k, record) view out of its world-row.  Device
+        # time: one census entry for the one fused launch, the full
+        # span noted on every rider lane's recorder
+        ready = _device_ready(
+            t_dispatched,
+            [lane for group in fused_set for _, lane in group.members()],
+        )
         fut = (
-            first._fetcher.submit(fouts)
+            first._fetcher.submit(fouts, on_ready=ready)
             if first._fetcher is not None
-            else _LazyFetch(fouts)
+            else _LazyFetch(fouts, on_ready=ready)
         )
         shared = _SharedFetch(
             fut,

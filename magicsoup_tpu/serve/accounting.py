@@ -29,6 +29,16 @@ Per tenant the ledger tracks:
   rather than under-counting traffic — the conservation invariant
   (shares sum exactly to the observed byte total, including
   subset-stepped megasteps) is the contract the serve tests pin.
+- ``device_us`` — the tenant's share of measured device time, integer
+  microseconds.  The graftpulse fetch-ready callback measures each
+  physical dispatch's commit-to-fetch-ready wall span
+  (:func:`magicsoup_tpu.telemetry.metrics.note_device_time` — the sync
+  point the pipeline already pays for, zero new work); the ledger
+  distributes each observed delta over the tenants stepped in that
+  window with EXACTLY the fetch_bytes discipline (even split, remainder
+  to the first in sorted order), so per-tenant shares sum exactly to
+  the process's measured total — including under cross-rung fusion and
+  subset-stepped megasteps.
 - ``sentinel_trips`` / ``invariant_trips`` — health trips, folded as
   deltas of the lane's own counters so lane replacement (restore) never
   double-counts.
@@ -49,6 +59,7 @@ _COUNTER_FIELDS = (
     "megasteps",
     "dispatches",
     "fetch_bytes",
+    "device_us",
     "sentinel_trips",
     "invariant_trips",
 )
@@ -64,6 +75,7 @@ class TenantAccount:
     megasteps: int = 0
     dispatches: int = 0
     fetch_bytes: int = 0
+    device_us: int = 0
     sentinel_trips: int = 0
     invariant_trips: int = 0
     # last-seen lane counters (trips are folded as deltas so a lane
@@ -115,6 +127,19 @@ class AccountingLedger:
         for i, tid in enumerate(tenants):
             self._accounts[tid].fetch_bytes += share + (rem if i == 0 else 0)
 
+    def charge_device_time(self, tenants, us: int) -> None:
+        """Distribute ``us`` microseconds of measured device time over
+        the tenants stepped in this window — the fetch_bytes split
+        (even, remainder to the first in sorted order), so per-tenant
+        shares sum EXACTLY to the measured total."""
+        us = int(us)
+        tenants = sorted(tenants)
+        if us <= 0 or not tenants:
+            return
+        share, rem = divmod(us, len(tenants))
+        for i, tid in enumerate(tenants):
+            self._accounts[tid].device_us += share + (rem if i == 0 else 0)
+
     def sync_trips(self, tenant: str, sentinel: int, invariant: int) -> None:
         """Fold the lane's trip counters in as deltas vs last seen."""
         acct = self._accounts[tenant]
@@ -154,3 +179,6 @@ class AccountingLedger:
 
     def total_fetch_bytes(self) -> int:
         return sum(a.fetch_bytes for a in self._accounts.values())
+
+    def total_device_us(self) -> int:
+        return sum(a.device_us for a in self._accounts.values())

@@ -244,6 +244,8 @@ def _route(method: str, path: str, body) -> tuple[str, dict]:
     parts = [p for p in path.split("?", 1)[0].split("/") if p]
     if parts == ["healthz"] and method == "GET":
         return "health", {}
+    if parts == ["metrics"] and method == "GET":
+        return "metrics", {}
     if parts == ["counters"] and method == "GET":
         return "counters", {}
     if parts == ["accounting"] and method == "GET":
@@ -300,14 +302,28 @@ def make_handler(service):
         def _reply(
             self, status: int, obj, *, retry_after: float | None = None
         ) -> None:
-            blob = (json.dumps(obj) + "\n").encode()
+            self._send(
+                status,
+                (json.dumps(obj) + "\n").encode(),
+                "application/json",
+                retry_after=retry_after,
+            )
+
+        def _send(
+            self,
+            status: int,
+            blob: bytes,
+            content_type: str,
+            *,
+            retry_after: float | None = None,
+        ) -> None:
             fault = _chaos.site("serve.response")
             if fault is not None and fault.kind == "malformed":
                 # truncated non-JSON body with honest framing: the
                 # client's json parse fails, not its socket read
                 blob = b'{"chaos": malformed' + b"\n"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(blob)))
             if retry_after is not None:
                 self.send_header("Retry-After", f"{retry_after:g}")
@@ -336,6 +352,16 @@ def make_handler(service):
                     # served from the loop's published snapshot, not the
                     # command queue: liveness must not queue behind work
                     self._reply(200, service.health())
+                    return
+                if name == "metrics":
+                    # same queue-bypass rule as /healthz: a scrape reads
+                    # the published registry + process counters, never
+                    # the single-writer loop (GL017-clean)
+                    from magicsoup_tpu.telemetry.metrics import CONTENT_TYPE
+
+                    self._send(
+                        200, service.metrics_text().encode(), CONTENT_TYPE
+                    )
                     return
                 self._reply(200, service.submit(name, payload))
             except ServeError as exc:

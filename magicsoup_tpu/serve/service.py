@@ -109,13 +109,20 @@ def tenant_digest(lane) -> str:
 
 @dataclass
 class _Command:
-    """One queued request: the loop fills result/error and sets done."""
+    """One queued request: the loop fills result/error and sets done.
+
+    ``t_enqueue`` (monotonic) is stamped at submit: the loop folds the
+    enqueue-to-done span into the per-command latency histogram, and
+    the handler-side ``/healthz``/``/metrics`` edges report the oldest
+    pending command's age from it — a wedged loop is visible the
+    moment its queue stops draining, not only after the 504."""
 
     name: str
     payload: dict
     done: threading.Event = field(default_factory=threading.Event)
     result: dict | None = None
     error: Exception | None = None
+    t_enqueue: float = 0.0
 
 
 @dataclass
@@ -130,6 +137,95 @@ class _Tenant:
     budget: int = 0  # megasteps requested but not yet served
     megasteps: int = 0  # tenant megasteps served (the cadence clock)
     cadence: int = 0  # checkpoint every N tenant megasteps (0 = manual)
+
+
+#: latency histogram bounds (seconds) shared by the tick-duration and
+#: per-command latency families — fixed buckets, so scrape output is
+#: structurally stable across runs
+_LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+#: per-tenant ledger counters exposed as /metrics families, with the
+#: ledger field each one is pinned to (device time renders as ms)
+_TENANT_FAMILIES = (
+    ("magicsoup_tenant_steps_total", "steps", "World steps served"),
+    ("magicsoup_tenant_megasteps_total", "megasteps", "Tenant megasteps served"),
+    ("magicsoup_tenant_dispatches_total", "dispatches", "Device dispatches the tenant rode"),
+    ("magicsoup_tenant_fetch_bytes_total", "fetch_bytes", "Tenant share of physical D2H fetch bytes"),
+    ("magicsoup_tenant_device_ms_total", "device_us", "Tenant share of measured device time (milliseconds)"),
+)
+
+#: runtime-counter keys that are NOT monotone (current state, not a
+#: running total) — exposed as gauges instead of counters
+_RUNTIME_GAUGE_KEYS = ("degraded",)
+
+
+def _build_metrics(reg):
+    """Declare every /metrics family up front (graftpulse registry) —
+    fixed families mean the exposition's HELP/TYPE structure is stable
+    across restarts, which the format-pinning tests rely on."""
+    reg.counter(
+        "magicsoup_device_ms_total",
+        "Total measured device time, commit to fetch-ready (milliseconds)",
+    )
+    reg.counter(
+        "magicsoup_device_dispatches_total",
+        "Physical device dispatches timed by the device census",
+    )
+    reg.counter(
+        "magicsoup_megasteps_total", "Tenant megasteps served by the loop"
+    )
+    reg.counter(
+        "magicsoup_scrapes_total", "GET /metrics scrapes served"
+    )
+    reg.counter(
+        "magicsoup_runtime_total",
+        "Process runtime counters (compiles, caches, restack/attach, "
+        "fetch census) keyed by counter name",
+        ("counter",),
+    )
+    for name, _, help_text in _TENANT_FAMILIES:
+        reg.counter(name, help_text, ("tenant",))
+    reg.gauge("magicsoup_tenants", "Admitted tenants")
+    reg.gauge("magicsoup_queued_tenants", "Creates parked in the admission queue")
+    reg.gauge("magicsoup_lost_tenants", "Registered but unrecoverable tenants")
+    reg.gauge(
+        "magicsoup_backlog_megasteps", "Requested megasteps not yet served"
+    )
+    reg.gauge(
+        "magicsoup_worlds",
+        "Worlds per warden state (active/suspended/quarantined/...)",
+        ("state",),
+    )
+    reg.gauge(
+        "magicsoup_degraded",
+        "Counted degradation events per subsystem (0 = recovered)",
+        ("subsystem",),
+    )
+    reg.gauge(
+        "magicsoup_runtime_gauge",
+        "Non-monotone runtime counters (current state) by name",
+        ("counter",),
+    )
+    reg.gauge(
+        "magicsoup_command_queue_depth",
+        "Commands waiting in the single-writer loop's queue (read-time)",
+    )
+    reg.gauge(
+        "magicsoup_oldest_command_age_seconds",
+        "Age of the oldest pending command (read-time; 0 when idle)",
+    )
+    reg.histogram(
+        "magicsoup_tick_seconds",
+        "Scheduler-loop tick duration",
+        _LATENCY_BUCKETS,
+    )
+    reg.histogram(
+        "magicsoup_command_latency_seconds",
+        "Command enqueue-to-done latency",
+        _LATENCY_BUCKETS,
+        ("command",),
+    )
+    return reg
 
 
 class FleetService:
@@ -219,9 +315,24 @@ class FleetService:
         self._warm_rungs: set[tuple] = set()
         self._last_stepped: list[str] = []
         from magicsoup_tpu.telemetry import fetch_stats
+        from magicsoup_tpu.telemetry import metrics as _pulse
 
         self._fetch_seen = int(fetch_stats()["fetch_bytes"])
         self._fetch_carry = 0
+        # graftpulse device-time attribution: same delta-rebase
+        # discipline as fetch_bytes — the census is process-global, so
+        # only deltas observed during THIS service's windows are billed
+        self._device_seen = int(
+            _pulse.device_time_stats()["device_time_us"]
+        )
+        self._device_carry = 0
+        self._metrics = _build_metrics(_pulse.MetricsRegistry())
+        self._degraded_seen: set[str] = set()
+        self._world_states_seen: set[str] = set()
+        # pending commands by identity -> enqueue time (monotonic);
+        # handler threads insert before put, the loop removes at done —
+        # the read-time source of oldest-pending-command age
+        self._inflight: dict[int, float] = {}
 
         self._commands: queue.Queue[_Command] = queue.Queue(maxsize=64)
         # queue backpressure: consecutive rejections widen the
@@ -314,6 +425,7 @@ class FleetService:
                     # tenants' final checkpoints or the registry write
                     self._cadence_save_failed(t, exc)
         self._settle_fetch()
+        self._settle_device()
         self._write_registry()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -331,6 +443,8 @@ class FleetService:
             except queue.Empty:
                 break
             cmd.error = api.ServeError(503, "service stopped")
+            with self._edge_lock:
+                self._inflight.pop(id(cmd), None)
             cmd.done.set()
 
     # ------------------------------------------------------------ #
@@ -343,6 +457,7 @@ class FleetService:
         if self._stop.is_set() or self._stopped.is_set():
             raise api.ServeError(503, "service is stopping")
         cmd = _Command(name, dict(payload or {}))
+        cmd.t_enqueue = time.monotonic()
         try:
             fault = _chaos.site("serve.queue")
             if fault is not None:
@@ -352,6 +467,8 @@ class FleetService:
                     time.sleep(float(fault.arg or 0.0))
                 else:  # "full"
                     raise queue.Full
+            with self._edge_lock:
+                self._inflight[id(cmd)] = cmd.t_enqueue
             self._commands.put_nowait(cmd)
         except queue.Full:
             # graceful backpressure: fail FAST with a typed 503 and a
@@ -359,6 +476,7 @@ class FleetService:
             # 503'd with no hint — under sustained pressure handler
             # threads piled up toward the 504 timeout instead)
             with self._edge_lock:
+                self._inflight.pop(id(cmd), None)
                 self._queue_full_streak += 1
                 hint = self._retry_backoff.delay(
                     min(self._queue_full_streak, 8)
@@ -388,10 +506,70 @@ class FleetService:
             raise cmd.error
         return cmd.result
 
+    def _edge_stats(self) -> tuple[int, float]:
+        """Read-time command-queue depth and oldest-pending-command age
+        (seconds; 0 when nothing is pending).  Computed from the edge's
+        own bookkeeping, NOT the published snapshot — a wedged loop
+        stops publishing, so these must stay live for /healthz and
+        /metrics to show the wedge before the 504 does."""
+        now = time.monotonic()
+        with self._edge_lock:
+            oldest = min(self._inflight.values(), default=None)
+        age = 0.0 if oldest is None else max(0.0, now - oldest)
+        return self._commands.qsize(), age
+
     def health(self) -> dict:
-        """The loop's last published snapshot (never blocks on work)."""
+        """The loop's last published snapshot (never blocks on work),
+        plus the live command-queue depth and oldest-pending age."""
         with self._health_lock:
-            return dict(self._health)
+            snap = dict(self._health)
+        depth, age = self._edge_stats()
+        snap["queue_depth"] = depth
+        snap["oldest_command_age_s"] = round(age, 3)
+        return snap
+
+    def metrics_text(self) -> str:
+        """Render the Prometheus exposition (GET /metrics).  Handler-
+        thread safe and GL017-clean by the /healthz rule: everything
+        here reads the loop's published registry state, process-global
+        counters, or the edge's own locks — never the command queue.
+        Serve with :data:`telemetry.metrics.CONTENT_TYPE`."""
+        from magicsoup_tpu.telemetry import runtime_counters
+
+        reg = self._metrics
+        counters = runtime_counters()
+        reg.set(
+            "magicsoup_device_ms_total",
+            counters.get("device_time_us", 0) / 1000.0,
+        )
+        reg.set(
+            "magicsoup_device_dispatches_total",
+            counters.get("device_dispatches", 0),
+        )
+        for key in sorted(counters):
+            if key in ("device_time_us", "device_dispatches"):
+                continue
+            if key in _RUNTIME_GAUGE_KEYS:
+                reg.set("magicsoup_runtime_gauge", counters[key], counter=key)
+            else:
+                reg.set("magicsoup_runtime_total", counters[key], counter=key)
+        # degraded subsystems: every subsystem ever seen keeps a series
+        # (0 after recovery), so scrapes see the recovery edge instead
+        # of a vanishing series
+        degraded = _chaos.degraded_states()
+        self._degraded_seen.update(degraded)
+        for subsystem in sorted(self._degraded_seen):
+            state = degraded.get(subsystem)
+            reg.set(
+                "magicsoup_degraded",
+                0 if state is None else int(state["count"]),
+                subsystem=subsystem,
+            )
+        depth, age = self._edge_stats()
+        reg.set("magicsoup_command_queue_depth", depth)
+        reg.set("magicsoup_oldest_command_age_seconds", round(age, 3))
+        reg.inc("magicsoup_scrapes_total")
+        return reg.render()
 
     # ------------------------------------------------------------ #
     # the scheduler loop (single writer)                           #
@@ -399,6 +577,18 @@ class FleetService:
 
     @owned_by("scheduler-loop")
     def _tick(self) -> None:
+        # tick duration routes through the graftpulse registry (the
+        # scheduler-loop instrumentation /metrics serves); idle ticks
+        # count too — a tick that only waited is still loop liveness
+        t0 = time.monotonic()
+        try:
+            self._tick_body()
+        finally:
+            self._metrics.observe(
+                "magicsoup_tick_seconds", time.monotonic() - t0
+            )
+
+    def _tick_body(self) -> None:
         self._drain_commands()
         self._admit_pending()
         self._reconcile()
@@ -436,6 +626,7 @@ class FleetService:
             stepped.append(t.tenant)
         self._last_stepped = stepped
         self._settle_fetch()
+        self._settle_device()
         for t in runnable:
             if t.cadence and t.megasteps % t.cadence == 0:
                 try:
@@ -502,6 +693,14 @@ class FleetService:
                 cmd.result = self._execute(cmd.name, cmd.payload)
             except Exception as exc:  # graftlint: disable=GL013 delivered to the requesting client, loop must survive
                 cmd.error = exc
+            with self._edge_lock:
+                self._inflight.pop(id(cmd), None)
+            if cmd.t_enqueue:
+                self._metrics.observe(
+                    "magicsoup_command_latency_seconds",
+                    max(0.0, time.monotonic() - cmd.t_enqueue),
+                    command=cmd.name,
+                )
             cmd.done.set()
 
     def _admit_pending(self) -> None:
@@ -527,6 +726,22 @@ class FleetService:
             self.ledger.charge_fetch(self._last_stepped, self._fetch_carry)
             self._fetch_carry = 0
 
+    def _settle_device(self) -> None:
+        """Distribute newly measured device time (µs) over the tenants
+        that stepped most recently — the fetch_bytes delta-rebase
+        discipline, so per-tenant ``device_us`` sums exactly to the
+        process census delta observed across this service's windows."""
+        from magicsoup_tpu.telemetry import metrics as _pulse
+
+        total = int(_pulse.device_time_stats()["device_time_us"])
+        self._device_carry += max(0, total - self._device_seen)
+        self._device_seen = total
+        if self._device_carry and self._last_stepped:
+            self.ledger.charge_device_time(
+                self._last_stepped, self._device_carry
+            )
+            self._device_carry = 0
+
     @owned_by("scheduler-loop")
     def _publish_health(self) -> None:
         statuses = {}
@@ -548,6 +763,34 @@ class FleetService:
         }
         with self._health_lock:
             self._health = snap
+        self._publish_metrics(snap)
+
+    @owned_by("scheduler-loop")
+    def _publish_metrics(self, snap: dict) -> None:
+        """Feed the loop-owned /metrics families (ledger counters,
+        warden-state world counts, service gauges) from the state the
+        loop just published.  Handler threads only ever ADD read-time
+        series on top (queue depth, runtime counters) — the single
+        writer of fleet-derived series is the loop, and the registry's
+        lock makes the concurrent render safe."""
+        reg = self._metrics
+        reg.set("magicsoup_tenants", snap["tenants"])
+        reg.set("magicsoup_queued_tenants", snap["queued"])
+        reg.set("magicsoup_lost_tenants", len(snap["lost"]))
+        reg.set("magicsoup_backlog_megasteps", snap["backlog"])
+        reg.set("magicsoup_megasteps_total", snap["megasteps"])
+        states: dict[str, int] = {}
+        for status in snap["worlds"].values():
+            states[status] = states.get(status, 0) + 1
+        self._world_states_seen.update(states)
+        for state in sorted(self._world_states_seen):
+            reg.set("magicsoup_worlds", states.get(state, 0), state=state)
+        for row in self.ledger.rows():
+            for name, field_, _ in _TENANT_FAMILIES:
+                value = row[field_]
+                if field_ == "device_us":
+                    value = value / 1000.0
+                reg.set(name, value, tenant=row["tenant"])
 
     # ------------------------------------------------------------ #
     # commands                                                     #
@@ -813,10 +1056,15 @@ class FleetService:
         bytes sum to the process's physical fetch total)."""
         self.scheduler.drain()
         self._settle_fetch()
+        # drain implies every fetch-ready callback has fired (they run
+        # before any result() returns), so the device census is settled
+        # and the rows' device_us sums exactly to total_device_us
+        self._settle_device()
         return {
             "rows": self.ledger.rows(),
             "total_steps": self.ledger.total_steps(),
             "total_fetch_bytes": self.ledger.total_fetch_bytes(),
+            "total_device_us": self.ledger.total_device_us(),
         }
 
     def _cmd_counters(self, payload: dict) -> dict:

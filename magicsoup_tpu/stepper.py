@@ -1008,23 +1008,34 @@ class _Fetcher(_Worker):
     def __init__(self):
         super().__init__(name="ms-stepper-fetch")
 
-    def submit(self, arr):
-        from functools import partial
-
+    def submit(self, arr, on_ready=None):
         # through the sanctioned explicit-transfer boundary (GL005):
-        # survives jax.transfer_guard("disallow") in guarded test runs
-        return super().submit(partial(_fetch_host, arr))
+        # survives jax.transfer_guard("disallow") in guarded test runs.
+        # ``on_ready`` fires on the worker thread the moment the fetch
+        # resolves — the graftpulse device-time bracket closes here,
+        # riding the sync point the pipeline already pays for (no new
+        # block_until_ready, no extra D2H)
+        def _fetch():
+            value = _fetch_host(arr)
+            if on_ready is not None:
+                on_ready()
+            return value
+
+        return super().submit(_fetch)
 
 
 class _LazyFetch:
     """Inline stand-in for a fetch Future on backends without a worker
     thread (CPU): resolves on the replay thread, exactly the pre-worker
-    semantics."""
+    semantics.  The ``on_ready`` device-time callback fires once, on
+    first ``result()`` — on this path the bracket closes at replay
+    rather than transfer-done, an upper bound that still conserves."""
 
-    __slots__ = ("_arr",)
+    __slots__ = ("_arr", "_on_ready")
 
-    def __init__(self, arr):
+    def __init__(self, arr, on_ready=None):
         self._arr = arr
+        self._on_ready = on_ready
 
     def done(self) -> bool:
         try:
@@ -1033,7 +1044,11 @@ class _LazyFetch:
             return True
 
     def result(self, timeout=None):
-        return _fetch_host(self._arr)
+        value = _fetch_host(self._arr)
+        if self._on_ready is not None:
+            self._on_ready, cb = None, self._on_ready
+            cb()
+        return value
 
 
 class _Pending(NamedTuple):
@@ -1667,9 +1682,9 @@ class PipelinedStepper:
         t_dispatched = _time.perf_counter()
         self._note_warm(q, compact)
         out_fut = (
-            self._fetcher.submit(out)
+            self._fetcher.submit(out, on_ready=self._device_ready(t_dispatched))
             if self._fetcher is not None
-            else _LazyFetch(out)
+            else _LazyFetch(out, on_ready=self._device_ready(t_dispatched))
         )
         self._commit_dispatch(
             plan,
@@ -1680,6 +1695,29 @@ class PipelinedStepper:
             t_dispatched=t_dispatched,
         )
 
+    def _device_ready(self, t_dispatched: float):
+        """graftpulse device-time bracket: build the fetch-ready
+        callback that closes the commit-to-fetch-ready span of ONE
+        physical dispatch.  It feeds the process device-time census
+        (``telemetry.metrics.note_device_time`` — what graftserve
+        bills per-tenant ``device_us`` from) and this stepper's
+        recorder ``"device"`` phase window, so the span lands on the
+        NEXT dispatch row exactly like the fetch/replay spans do.
+        Fires on the fetch worker thread (or at first ``result()`` on
+        the CPU lazy path): zero extra sync, zero extra transfers."""
+        import time as _time
+
+        from magicsoup_tpu.telemetry import metrics as _metrics
+
+        recorder = self.telemetry
+
+        def _ready():
+            dt = _time.perf_counter() - t_dispatched
+            _metrics.note_device_time(dt)
+            recorder.note("device", dt)
+
+        return _ready
+
     def _prepare_dispatch(self) -> _DispatchPlan:
         """Host half of one dispatch: drain, growth/compaction decisions,
         spawn/push batch selection, token-capacity growth — everything
@@ -1688,6 +1726,7 @@ class PipelinedStepper:
         the fleet coordinator's batched densify."""
         import time as _time
 
+        # plan-carried reading: noted as step_ms at commit  # graftlint: disable=GL025
         t_start = _time.perf_counter()
         fetch0 = self._fetch_acc
         if self._quarantine_pending:
@@ -1779,10 +1818,12 @@ class PipelinedStepper:
         # grow token capacities for both, and only then densify — one
         # batch's protein-capacity growth must not invalidate the
         # other's already-built dense tensor
+        # plan-carried reading: the param_assembly span is noted at commit  # graftlint: disable=GL025
         t_asm0 = _time.perf_counter()
         spawn = self._spawn_queue[: self.spawn_block]
         self._spawn_queue = self._spawn_queue[len(spawn) :]
         has_spawn = len(spawn) > 0
+        # plan-carried reading: the spawn span is noted at commit  # graftlint: disable=GL025
         t_spawn0 = _time.perf_counter()
         spawn_entries = (
             self.world.phenotypes.lookup([g for g, _ in spawn])

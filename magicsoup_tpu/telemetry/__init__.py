@@ -10,6 +10,12 @@ Three layers, documented in their modules:
   accounting fed by ``util.fetch_host``.
 - :mod:`.summary` — stdlib-pure JSONL parsing/validation/aggregation
   (shared by the CLI and ``scripts/summarize_capture.py``).
+- :mod:`.metrics` — graftpulse: the stdlib-pure live metrics registry
+  (Prometheus text exposition for ``GET /metrics``) and the
+  ``note_device_time``/``device_time_stats`` device-time census the
+  serve ledger bills per-tenant ``device_us`` from.
+- :mod:`.trace` — recorder JSONL -> Chrome trace-event JSON
+  (``python -m magicsoup_tpu.telemetry trace in.jsonl out.json``).
 - ``python -m magicsoup_tpu.telemetry summarize run.jsonl`` — per-phase
   p50/p95 and counter deltas from a recorded run.
 
@@ -26,17 +32,29 @@ from magicsoup_tpu.telemetry.recorder import (
     runtime_counters,
     trace_window,
 )
+from magicsoup_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    device_time_stats,
+    note_device_time,
+    parse_exposition,
+)
 from magicsoup_tpu.telemetry.summary import (
     read_jsonl,
     summarize_rows,
     validate_rows,
 )
+from magicsoup_tpu.telemetry.trace import rows_to_trace
 
 __all__ = [
+    "MetricsRegistry",
     "TelemetryRecorder",
     "TelemetrySnapshot",
+    "device_time_stats",
     "fetch_stats",
+    "note_device_time",
     "note_fetch",
+    "parse_exposition",
+    "rows_to_trace",
     "runtime_counters",
     "trace_window",
     "read_jsonl",

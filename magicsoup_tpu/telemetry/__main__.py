@@ -3,15 +3,18 @@ CLI for graftscope telemetry files::
 
     python -m magicsoup_tpu.telemetry summarize run.jsonl [--json]
     python -m magicsoup_tpu.telemetry validate run.jsonl
+    python -m magicsoup_tpu.telemetry trace run.jsonl run.trace.json
 
 ``summarize`` prints per-phase p50/p95 timings and counter deltas
 (``--json`` for the machine-readable aggregate); ``validate`` exits
-nonzero listing every schema problem.  Both run schema validation —
-``summarize`` also fails on an invalid file so the CI smoke can gate on
-its exit code alone.
+nonzero listing every schema problem; ``trace`` converts recorder span
+rows to Chrome trace-event JSON (load in ``chrome://tracing`` or
+Perfetto — lanes follow the graftrace ownership roles, timeline is
+synthetic; see :mod:`.trace`).  All three run schema validation, so
+the CI smoke can gate on exit codes alone.
 
-Imports stay stdlib-only (``summary`` module): summarizing a capture
-never initializes a jax backend.
+Imports stay stdlib-only (``summary``/``trace`` modules): processing a
+capture never initializes a jax backend.
 """
 import argparse
 import json
@@ -23,6 +26,7 @@ from magicsoup_tpu.telemetry.summary import (
     summarize_rows,
     validate_rows,
 )
+from magicsoup_tpu.telemetry.trace import rows_to_trace
 
 
 def main(argv=None) -> int:
@@ -33,6 +37,11 @@ def main(argv=None) -> int:
     p_sum.add_argument("--json", action="store_true", dest="as_json")
     p_val = sub.add_parser("validate", help="schema-check a JSONL file")
     p_val.add_argument("path")
+    p_tr = sub.add_parser(
+        "trace", help="convert spans to Chrome trace-event JSON"
+    )
+    p_tr.add_argument("path")
+    p_tr.add_argument("out")
     args = ap.parse_args(argv)
 
     try:
@@ -47,6 +56,16 @@ def main(argv=None) -> int:
         return 1
     if args.cmd == "validate":
         print(f"{args.path}: {len(rows)} rows, schema OK")
+        return 0
+    if args.cmd == "trace":
+        doc = rows_to_trace(rows)
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+        print(
+            f"{args.out}: {len(doc['traceEvents'])} events from "
+            f"{doc['otherData']['dispatches']} dispatches"
+        )
         return 0
     summary = summarize_rows(rows)
     if args.as_json:

@@ -22,7 +22,9 @@ Row schema (one JSON object per line; ``type`` discriminates):
   spent since the previous dispatch row.
 - ``accounting`` — one per tenant from the graftserve ledger
   (``serve.accounting.TenantAccount.row``): ``tenant``, ``world``, and
-  the six non-negative usage counters in ``ACCOUNTING_COUNTER_KEYS``.
+  the non-negative usage counters in ``ACCOUNTING_COUNTER_KEYS``
+  (steps/megasteps/dispatches, fetch bytes, device microseconds, and
+  health trips).
 
 Mesh-placed runs add optional keys: step rows carry ``tile_occupancy``
 (per-map-row-tile occupied pixel counts, one int per mesh tile, summing
@@ -68,6 +70,7 @@ ACCOUNTING_COUNTER_KEYS = (
     "megasteps",
     "dispatches",
     "fetch_bytes",
+    "device_us",
     "sentinel_trips",
     "invariant_trips",
 )

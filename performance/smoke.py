@@ -64,6 +64,19 @@ service restarted on the same directory must re-adopt every tenant and
 finish the SAME request schedule with digests BIT-identical to the
 uninterrupted baseline's.
 
+``--metrics`` runs the graftpulse live-metrics smoke (GATING): a
+loopback ``python -m magicsoup_tpu.serve`` child serves two det-mode
+tenants; ``GET /metrics`` must return exposition-format 0.0.4 text
+under the pinned content type, every counter must be monotone across a
+double scrape, the per-tenant ``device_ms`` series must sum exactly to
+the accounting rows' ``device_us`` bill (which must itself be
+conserved against ``total_device_us``), a warm steady-state megastep
+between the scrapes must compile ZERO new programs with metrics armed,
+and ``/healthz`` must carry the live ``queue_depth`` /
+``oldest_command_age_s`` fields.  The final scrape is left in the
+smoke directory as ``metrics.prom`` (the file
+``scripts/summarize_capture.py`` folds into ``summary["metrics"]``).
+
 ``--genome`` runs the device-resident-genome smoke (GATING): a
 string-backed and a token-backed det-mode world drive the SAME seeded
 mutate -> recombinate -> translate -> divide schedule — the string world
@@ -140,6 +153,8 @@ def main() -> None:
     ap.add_argument("--fleet-chaos", action="store_true")
     # graftserve multi-tenant serving smoke (see serve_main below)
     ap.add_argument("--serve", action="store_true")
+    # graftpulse live-metrics smoke (see metrics_main below)
+    ap.add_argument("--metrics", action="store_true")
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
@@ -159,6 +174,8 @@ def main() -> None:
         return fleet_chaos_main(args)
     if args.serve:
         return serve_main(args)
+    if args.metrics:
+        return metrics_main(args)
 
     import jax
 
@@ -2025,6 +2042,227 @@ def serve_main(args) -> None:
     )
     if problems:
         raise SystemExit("serve smoke FAILED: " + "; ".join(problems))
+
+
+def metrics_main(args) -> None:
+    """Gate the graftpulse metrics plane against a live loopback serve
+    child (see the module docstring's ``--metrics`` paragraph).  The
+    parent stays stdlib-pure: telemetry/metrics.py is loaded by file
+    path for the exposition parser, and every fleet touch happens
+    inside the ``python -m magicsoup_tpu.serve`` child."""
+    import importlib.util
+    import os
+    import signal
+    import urllib.request
+
+    base = Path(tempfile.mkdtemp(prefix="msoup-metrics-"))
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["MAGICSOUP_TPU_DETERMINISTIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MAGICSOUP_COMPILE_CACHE_DIR"] = str(base / "xla-cache")
+    problems: list[str] = []
+    k = args.megastep
+
+    spec = importlib.util.spec_from_file_location(
+        "_tmetrics", repo / "magicsoup_tpu" / "telemetry" / "metrics.py"
+    )
+    pulse = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pulse)
+
+    def _spec(tenant, seed):
+        return {
+            "tenant": tenant,
+            "seed": seed,
+            "map_size": args.map_size,
+            "n_cells": args.n_cells,
+            "genome_size": args.genome_size,
+            "chemistry": {
+                "molecules": [
+                    {"name": "sv-a", "energy": 10000.0},
+                    {"name": "sv-atp", "energy": 8000.0,
+                     "half_life": 100000},
+                ],
+                "reactions": [[["sv-a"], ["sv-atp"]]],
+            },
+            "stepper": {"mol_name": "sv-atp", "megastep": k},
+        }
+
+    def _req(port, method, path, body=None, timeout=600):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def _scrape(port):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            return ctype, resp.read().decode("utf-8")
+
+    def _wait_megasteps(port, tid, target, timeout_s=600):
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            _s, obs = _req(port, "GET", f"/tenants/{tid}")
+            if obs.get("megasteps", -1) >= target:
+                return
+            time.sleep(0.1)
+        problems.append(f"{tid} never reached {target} megasteps")
+
+    log = open(base / "serve.log", "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "magicsoup_tpu.serve",
+            "--dir",
+            str(base / "svc"),
+            "--port",
+            "0",
+        ],
+        env=env,
+        cwd=str(repo),
+        stdout=subprocess.PIPE,
+        stderr=log,
+        text=True,
+    )
+    scrape2 = None
+    try:
+        ready = None
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("{") and '"ready"' in line:
+                ready = json.loads(line)
+                break
+        if ready is None:
+            raise SystemExit(
+                "metrics smoke FAILED: serve child exited before its "
+                f"ready line (see {base}/serve.log)"
+            )
+        port = ready["port"]
+
+        # warm phase: two tenants, two megasteps each
+        for tid, seed in (("m1", 7), ("m2", 11)):
+            status, out = _req(port, "POST", "/tenants", _spec(tid, seed))
+            if status != 200 or out.get("status") != "active":
+                problems.append(f"create {tid} -> {status} {out}")
+        for tid in ("m1", "m2"):
+            _req(port, "POST", f"/tenants/{tid}/step", {"megasteps": 2})
+        for tid in ("m1", "m2"):
+            _wait_megasteps(port, tid, 2)
+
+        ctype, text1 = _scrape(port)
+        if ctype != pulse.CONTENT_TYPE:
+            problems.append(
+                f"/metrics content type {ctype!r} != {pulse.CONTENT_TYPE!r}"
+            )
+        p1 = pulse.parse_exposition(text1)
+        compiles1 = pulse.sample_value(
+            p1, "magicsoup_runtime_total", counter="compiles"
+        )
+
+        # warm steady-state megastep between the scrapes: one more
+        # megastep per tenant must compile NOTHING with metrics armed
+        for tid in ("m1", "m2"):
+            _req(port, "POST", f"/tenants/{tid}/step", {"megasteps": 1})
+        for tid in ("m1", "m2"):
+            _wait_megasteps(port, tid, 3)
+        _s, acct = _req(port, "GET", "/accounting")
+
+        ctype2, text2 = _scrape(port)
+        scrape2 = text2
+        p2 = pulse.parse_exposition(text2)
+
+        # every counter family is monotone across the double scrape
+        for name, kind in p1["types"].items():
+            if kind != "counter":
+                continue
+            for s in (s for s in p1["samples"] if s["name"] == name):
+                later = pulse.sample_value(p2, name, **s["labels"])
+                if later is None or later < s["value"]:
+                    problems.append(
+                        f"counter {name}{s['labels']} not monotone: "
+                        f"{s['value']} -> {later}"
+                    )
+        s1 = pulse.sample_value(p1, "magicsoup_scrapes_total")
+        s2 = pulse.sample_value(p2, "magicsoup_scrapes_total")
+        if s2 != s1 + 1:
+            problems.append(f"scrapes_total {s1} -> {s2}, want +1")
+        compiles2 = pulse.sample_value(
+            p2, "magicsoup_runtime_total", counter="compiles"
+        )
+        if compiles2 != compiles1:
+            problems.append(
+                f"warm steady-state megastep compiled "
+                f"{compiles2 - compiles1} new program(s) with metrics "
+                "armed (want 0)"
+            )
+
+        # device-time conservation: rows -> total -> tenant series
+        rows = acct["rows"]
+        total_us = acct["total_device_us"]
+        if total_us <= 0:
+            problems.append(f"total_device_us={total_us}, want > 0")
+        if sum(r["device_us"] for r in rows) != total_us:
+            problems.append("accounting device_us rows not conserved")
+        tenant_ms = {
+            s["labels"]["tenant"]: s["value"]
+            for s in p2["samples"]
+            if s["name"] == "magicsoup_tenant_device_ms_total"
+        }
+        want_ms = {r["tenant"]: r["device_us"] / 1000.0 for r in rows}
+        for tid, ms in want_ms.items():
+            got = tenant_ms.get(tid)
+            if got is None or abs(got - ms) > 1e-6:
+                problems.append(
+                    f"tenant device_ms {tid}: exposition {got} != "
+                    f"accounting {ms}"
+                )
+        device_ms = pulse.sample_value(p2, "magicsoup_device_ms_total")
+        if device_ms is None or device_ms * 1000.0 + 0.5 < total_us:
+            problems.append(
+                f"device census {device_ms}ms < billed {total_us}us"
+            )
+
+        # /healthz carries the live edge-queue fields
+        _s, health = _req(port, "GET", "/healthz")
+        for key in ("queue_depth", "oldest_command_age_s"):
+            if key not in health:
+                problems.append(f"/healthz missing {key}")
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            problems.append("serve child ignored SIGTERM")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        if scrape2 is not None:
+            # the capture artifact summarize_capture.py folds into
+            # summary["metrics"]
+            (base / "metrics.prom").write_text(scrape2)
+
+    print(
+        json.dumps(
+            {
+                "metric": "metrics smoke (graftpulse /metrics, cpu)",
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "scrape": str(base / "metrics.prom"),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("metrics smoke FAILED: " + "; ".join(problems))
 
 
 if __name__ == "__main__":

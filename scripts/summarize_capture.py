@@ -32,6 +32,13 @@ _TELEMETRY_SUMMARY_SRC = (
     / "telemetry"
     / "summary.py"
 )
+# source of the stdlib-pure graftpulse exposition parser, same contract
+_TELEMETRY_METRICS_SRC = (
+    Path(__file__).resolve().parents[1]
+    / "magicsoup_tpu"
+    / "telemetry"
+    / "metrics.py"
+)
 
 # harness log -> key in BASELINE.json "published"
 _BENCH_LOGS = {
@@ -87,6 +94,44 @@ def _telemetry_summary(path: Path) -> dict | None:
         # carry WHY so publish() can refuse it
         out["error"] = "; ".join(problems[:5])
     return out
+
+
+def _metrics_summary(path: Path) -> dict | None:
+    """Fold a capture's final ``/metrics`` scrape (``metrics.prom``,
+    written by ``performance/smoke.py --metrics`` and the serve capture
+    harnesses) into the headline graftpulse numbers.  Loads
+    telemetry/metrics.py by FILE PATH (stdlib-pure by contract) for the
+    same no-jax reason as the telemetry fold."""
+    if not path.exists():
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_msoup_telemetry_metrics", _TELEMETRY_METRICS_SRC
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        parsed = mod.parse_exposition(path.read_text(errors="replace"))
+    except ValueError as e:
+        # an unparseable scrape is a capture outcome, not a measurement
+        return {"error": str(e)}
+    return {
+        "families": len(parsed["types"]),
+        "device_ms_total": mod.sample_value(
+            parsed, "magicsoup_device_ms_total"
+        ),
+        "device_dispatches_total": mod.sample_value(
+            parsed, "magicsoup_device_dispatches_total"
+        ),
+        "megasteps_total": mod.sample_value(parsed, "magicsoup_megasteps_total"),
+        "scrapes_total": mod.sample_value(parsed, "magicsoup_scrapes_total"),
+        "tenant_device_ms": {
+            s["labels"]["tenant"]: s["value"]
+            for s in parsed["samples"]
+            if s["name"] == "magicsoup_tenant_device_ms_total"
+        },
+    }
 
 
 def summarize(outdir: Path) -> dict:
@@ -225,6 +270,9 @@ def summarize(outdir: Path) -> dict:
     tel = _telemetry_summary(outdir / "telemetry.jsonl")
     if tel is not None:
         summary["telemetry"] = tel
+    mtx = _metrics_summary(outdir / "metrics.prom")
+    if mtx is not None:
+        summary["metrics"] = mtx
     return summary
 
 

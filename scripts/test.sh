@@ -97,6 +97,16 @@ python performance/smoke.py --differential
 # and finish the schedule with digests BIT-identical to the
 # uninterrupted baseline's.  Exits nonzero on any violation.
 python performance/smoke.py --serve
+# graftpulse live-metrics smoke (GATING): a loopback serve child is
+# double-scraped over HTTP — GET /metrics must return exposition-format
+# 0.0.4 text under the pinned content type, every counter family must
+# be monotone across the scrapes, the per-tenant device_ms series must
+# sum exactly to the accounting rows' device_us bill (itself conserved
+# against total_device_us), a warm steady-state megastep between the
+# scrapes must compile ZERO new programs with metrics armed, and
+# /healthz must carry the live queue_depth / oldest_command_age_s
+# fields.  Exits nonzero on any violation.
+python performance/smoke.py --metrics
 # graftchaos campaign gate (GATING): the fast subset of the chaos
 # matrix (performance/chaos_matrix.py) — checkpoint ENOSPC mid-save
 # (counted, next save lands, no torn file), torn-write walk-back,

@@ -579,6 +579,45 @@ def test_summarize_folds_telemetry_jsonl(tmp_path):
     assert "telemetry" not in summarize_capture.summarize(empty)
 
 
+def test_summarize_folds_metrics_scrape(tmp_path):
+    (tmp_path / "metrics.prom").write_text(
+        "# HELP magicsoup_device_ms_total Device time.\n"
+        "# TYPE magicsoup_device_ms_total counter\n"
+        "magicsoup_device_ms_total 148.916\n"
+        "# HELP magicsoup_device_dispatches_total Dispatches.\n"
+        "# TYPE magicsoup_device_dispatches_total counter\n"
+        "magicsoup_device_dispatches_total 3\n"
+        "# HELP magicsoup_megasteps_total Megasteps.\n"
+        "# TYPE magicsoup_megasteps_total counter\n"
+        "magicsoup_megasteps_total 4\n"
+        "# HELP magicsoup_scrapes_total Scrapes.\n"
+        "# TYPE magicsoup_scrapes_total counter\n"
+        "magicsoup_scrapes_total 2\n"
+        "# HELP magicsoup_tenant_device_ms_total Per-tenant bill.\n"
+        "# TYPE magicsoup_tenant_device_ms_total counter\n"
+        'magicsoup_tenant_device_ms_total{tenant="t1"} 124.789\n'
+        'magicsoup_tenant_device_ms_total{tenant="t2"} 24.127\n'
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    mtx = summary["metrics"]
+    assert "error" not in mtx
+    assert mtx["families"] == 5
+    assert mtx["device_ms_total"] == 148.916
+    assert mtx["device_dispatches_total"] == 3
+    assert mtx["megasteps_total"] == 4
+    assert mtx["scrapes_total"] == 2
+    assert mtx["tenant_device_ms"] == {"t1": 124.789, "t2": 24.127}
+    # absent scrape -> key absent, not an empty stub
+    empty = tmp_path / "no-metrics"
+    empty.mkdir()
+    assert "metrics" not in summarize_capture.summarize(empty)
+    # an unparseable scrape is a capture outcome, not a measurement
+    broken = tmp_path / "broken"
+    broken.mkdir()
+    (broken / "metrics.prom").write_text("magicsoup_device_ms_total oops\n")
+    assert "error" in summarize_capture.summarize(broken)["metrics"]
+
+
 def test_publish_telemetry_refuses_invalid_stream(tmp_path, monkeypatch):
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(json.dumps({"published": {}}) + "\n")
@@ -626,7 +665,7 @@ def test_accounting_row_validation_rejects_malformed():
     good = {
         "type": "accounting", "tenant": "alpha", "world": 0,
         "steps": 8, "megasteps": 2, "dispatches": 2, "fetch_bytes": 1024,
-        "sentinel_trips": 0, "invariant_trips": 0,
+        "device_us": 2048, "sentinel_trips": 0, "invariant_trips": 0,
     }
     assert tsummary.validate_rows([good]) == []
     for broken, needle in [
@@ -634,6 +673,7 @@ def test_accounting_row_validation_rejects_malformed():
         ({**good, "world": "zero"}, "world"),
         ({k: v for k, v in good.items() if k != "steps"}, "steps"),
         ({**good, "fetch_bytes": -1}, "fetch_bytes"),
+        ({**good, "device_us": -1}, "device_us"),
         ({**good, "dispatches": 1.5}, "dispatches"),
     ]:
         problems = tsummary.validate_rows([broken])

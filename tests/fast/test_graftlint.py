@@ -68,6 +68,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl022_untyped_escape.py", "GL022"),
         ("gl023_host_genome.py", "GL023"),
         ("gl024_group_loop.py", "GL024"),
+        ("gl025_bare_clock.py", "GL025"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -162,6 +163,52 @@ def test_gl024_planner_routed_loop_is_sanctioned(tmp_path):
         "        batch.fused_fleet_step(group_set, inputs)\n"
     )
     assert analyze([p], rules=["GL024"]) == []
+
+
+def test_gl025_waivable_deliberate_local_timing(tmp_path):
+    # a deliberate local timing (a deadline check, a plan-carried span
+    # start noted at commit) waives with the standard inline
+    # annotation; pin that the machinery covers GL025
+    src = (FIXTURES / "gl025_bare_clock.py").read_text()
+    waived = src.replace(
+        "# GL025: clock reading hoarded in local state",
+        "# graftlint: disable=GL025 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl025_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl025_routing_call_exempts_function(tmp_path):
+    # the SAME reading is sanctioned once the function routes its
+    # measurement into the telemetry plane — that is the fix the rule
+    # asks for, so the fixed form must lint clean
+    src = (FIXTURES / "gl025_bare_clock.py").read_text()
+    routed = src.replace(
+        "    return out",
+        "    rec.note('step', world.last_step_s)\n    return out",
+    )
+    assert routed != src
+    p = tmp_path / "gl025_routed.py"
+    p.write_text(routed)
+    assert analyze([p], rules=["GL025"]) == []
+
+
+def test_gl025_scoped_to_stepper_fleet_serve(tmp_path):
+    # the SAME hot-path reading is silent once the module stops being
+    # stepper-scoped: a bench harness timing its own wall clock is not
+    # on the step loop, so flagging every module would be noise
+    src = (FIXTURES / "gl025_bare_clock.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import stepper"
+        "  # noqa: F401  (marks the module stepper-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl025_not_scoped.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL025"]) == []
 
 
 def test_gl023_scoped_to_stepper_fleet_serve(tmp_path):

@@ -1,0 +1,287 @@
+"""
+Tests for graftpulse (:mod:`magicsoup_tpu.telemetry.metrics`) and its
+serve integration: the exposition format is pinned byte-for-byte (a
+scrape config written against one release must parse every later one),
+per-tenant ``device_us`` attribution is exactly conserved against the
+device census under fleet fusion with subset-stepped megasteps, and
+``/metrics`` stays correct while chaos has subsystems degraded.
+
+The service-level tests drive :class:`FleetService` in process with
+manual ``_tick()`` calls (the ``test_serve`` idiom): deterministic,
+single-threaded, no sockets.
+"""
+import math
+
+import pytest
+
+from magicsoup_tpu.guard import chaos
+from magicsoup_tpu.serve import FleetService
+from magicsoup_tpu.serve import api
+from magicsoup_tpu.telemetry import metrics as pulse
+
+
+def _spec(tenant, *, seed=7, **over):
+    spec = {
+        "tenant": tenant,
+        "seed": seed,
+        "map_size": 16,
+        "n_cells": 8,
+        "genome_size": 200,
+        "chemistry": {
+            "molecules": [
+                {"name": "sv-a", "energy": 10000.0},
+                {"name": "sv-atp", "energy": 8000.0, "half_life": 100000},
+            ],
+            "reactions": [[["sv-a"], ["sv-atp"]]],
+        },
+        "stepper": {"mol_name": "sv-atp", "megastep": 2},
+    }
+    spec.update(over)
+    return spec
+
+
+def _drain(svc, max_ticks=200):
+    for _ in range(max_ticks):
+        if not any(t.budget > 0 for t in svc._tenants.values()):
+            svc._tick()
+            return
+        svc._tick()
+    raise AssertionError("budgets did not drain")
+
+
+def _service(path, **kw):
+    kw.setdefault("block", 2)
+    kw.setdefault("idle_wait", 0.001)
+    return FleetService(path, **kw)
+
+
+# ------------------------------------------------- registry + format
+def test_content_type_pinned():
+    # the exact exposition-format 0.0.4 content type Prometheus expects
+    assert pulse.CONTENT_TYPE == "text/plain; version=0.0.4; charset=utf-8"
+
+
+def test_exposition_text_pinned_byte_for_byte():
+    reg = pulse.MetricsRegistry()
+    reg.counter("demo_total", "A demo counter.")
+    reg.gauge("demo_depth", "A demo gauge.", label_names=("lane",))
+    reg.histogram("demo_seconds", "A demo histogram.", buckets=(0.1, 1.0))
+    reg.inc("demo_total", 3)
+    reg.set("demo_depth", 2, lane="b")
+    reg.set("demo_depth", 1.5, lane="a")
+    reg.observe("demo_seconds", 0.05)
+    reg.observe("demo_seconds", 4.0)
+    assert reg.render() == (
+        "# HELP demo_total A demo counter.\n"
+        "# TYPE demo_total counter\n"
+        "demo_total 3\n"
+        "# HELP demo_depth A demo gauge.\n"
+        "# TYPE demo_depth gauge\n"
+        'demo_depth{lane="a"} 1.5\n'
+        'demo_depth{lane="b"} 2\n'
+        "# HELP demo_seconds A demo histogram.\n"
+        "# TYPE demo_seconds histogram\n"
+        'demo_seconds_bucket{le="0.1"} 1\n'
+        'demo_seconds_bucket{le="1"} 1\n'
+        'demo_seconds_bucket{le="+Inf"} 2\n'
+        "demo_seconds_sum 4.05\n"
+        "demo_seconds_count 2\n"
+    )
+
+
+def test_label_escaping_roundtrips():
+    reg = pulse.MetricsRegistry()
+    reg.gauge("esc", "Escapes.", label_names=("v",))
+    hostile = 'back\\slash "quoted"\nnewline'
+    reg.set("esc", 1, v=hostile)
+    text = reg.render()
+    assert '\\\\' in text and '\\"' in text and "\\n" in text
+    assert "\nnewline" not in text  # the raw newline never hits the wire
+    parsed = pulse.parse_exposition(text)
+    assert pulse.sample_value(parsed, "esc", v=hostile) == 1
+
+
+def test_counter_discipline():
+    reg = pulse.MetricsRegistry()
+    reg.counter("mono_total", "Monotone.")
+    with pytest.raises(ValueError):
+        reg.inc("mono_total", -1)
+    # set() keeps the high-water mark: snapshot-fed counters stay
+    # monotone even when the source resets underneath
+    reg.set("mono_total", 10)
+    reg.set("mono_total", 4)
+    assert pulse.sample_value(
+        pulse.parse_exposition(reg.render()), "mono_total"
+    ) == 10
+    # re-declaring under a different type is a programming error
+    with pytest.raises(ValueError):
+        reg.gauge("mono_total", "Oops.")
+
+
+def test_metric_names_stable_across_restarts(tmp_path):
+    def families(svc):
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        return set(parsed["types"]), {
+            name: kind for name, kind in parsed["types"].items()
+        }
+
+    svc1 = _service(tmp_path / "a")
+    names1, types1 = families(svc1)
+    svc1._shutdown()
+    svc2 = _service(tmp_path / "b")
+    names2, types2 = families(svc2)
+    svc2._shutdown()
+    # a scrape config written against one process must survive the next
+    assert names1 == names2
+    assert types1 == types2
+    assert "magicsoup_device_ms_total" in names1
+    assert "magicsoup_command_queue_depth" in names1
+    assert "magicsoup_oldest_command_age_seconds" in names1
+
+
+# ------------------------------------------- device-time attribution
+def test_device_ms_conserved_under_fleet_fusion_subset_step(tmp_path):
+    svc = _service(tmp_path, fusion="fleet")
+    try:
+        svc._execute("create", _spec("alpha"))
+        svc._execute("create", _spec("beta", seed=9))
+        svc._execute("create", _spec("gamma", seed=11))
+        # subset-stepped megasteps: alpha runs alone first, then all
+        # three ride fused dispatches with different budgets
+        svc._execute("step", {"tenant": "alpha", "megasteps": 1})
+        _drain(svc)
+        svc._execute("step", {"tenant": "alpha", "megasteps": 2})
+        svc._execute("step", {"tenant": "beta", "megasteps": 2})
+        svc._execute("step", {"tenant": "gamma", "megasteps": 1})
+        _drain(svc)
+        acct = svc._cmd_accounting({})
+        total = acct["total_device_us"]
+        assert total > 0
+        # exact integer conservation: every measured microsecond is
+        # billed to exactly one tenant
+        assert sum(r["device_us"] for r in acct["rows"]) == total
+        assert {r["tenant"] for r in acct["rows"]} == {
+            "alpha", "beta", "gamma",
+        }
+        assert all(r["device_us"] > 0 for r in acct["rows"])
+        # the exposition's per-tenant family carries the same census
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        per_tenant = sum(
+            pulse.sample_value(
+                parsed, "magicsoup_tenant_device_ms_total", tenant=r["tenant"]
+            )
+            for r in acct["rows"]
+        )
+        assert math.isclose(per_tenant, total / 1000.0, abs_tol=1e-6)
+        assert pulse.sample_value(
+            parsed, "magicsoup_device_dispatches_total"
+        ) >= len(acct["rows"])
+    finally:
+        svc._shutdown()
+
+
+def test_metrics_scrape_is_monotone_and_counts_itself(tmp_path):
+    svc = _service(tmp_path)
+    try:
+        svc._execute("create", _spec("acme"))
+        svc._execute("step", {"tenant": "acme", "megasteps": 1})
+        _drain(svc)
+        p1 = pulse.parse_exposition(svc.metrics_text())
+        p2 = pulse.parse_exposition(svc.metrics_text())
+        for name, kind in p1["types"].items():
+            if kind != "counter":
+                continue
+            for s in p1["samples"]:
+                if s["name"] != name:
+                    continue
+                later = pulse.sample_value(p2, name, **s["labels"])
+                assert later is not None and later >= s["value"], name
+        assert (
+            pulse.sample_value(p2, "magicsoup_scrapes_total")
+            == pulse.sample_value(p1, "magicsoup_scrapes_total") + 1
+        )
+    finally:
+        svc._shutdown()
+
+
+# ------------------------------------------------- degraded + health
+def test_metrics_report_chaos_degraded_states(tmp_path):
+    svc = _service(tmp_path)
+    try:
+        chaos.note_degraded("checkpoint", "fixture")
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        assert pulse.sample_value(
+            parsed, "magicsoup_degraded", subsystem="checkpoint"
+        ) == 1
+        chaos.clear_degraded("checkpoint")
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        # recovered subsystems keep an explicit 0-valued series so
+        # alerting rules see the transition, not a vanished series
+        assert pulse.sample_value(
+            parsed, "magicsoup_degraded", subsystem="checkpoint"
+        ) == 0
+    finally:
+        chaos.clear_degraded("checkpoint")
+        svc._shutdown()
+
+
+def test_healthz_reports_queue_depth_and_oldest_age(tmp_path):
+    svc = _service(tmp_path)
+    try:
+        snap = svc.health()
+        assert snap["queue_depth"] == 0
+        assert snap["oldest_command_age_s"] == 0.0
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        assert pulse.sample_value(
+            parsed, "magicsoup_command_queue_depth"
+        ) == 0
+        assert pulse.sample_value(
+            parsed, "magicsoup_oldest_command_age_seconds"
+        ) == 0
+    finally:
+        svc._shutdown()
+
+
+def test_trace_export_lanes_and_synthetic_timeline():
+    from magicsoup_tpu.telemetry import rows_to_trace
+
+    rows = [
+        {"type": "meta", "version": 1, "wall": 1.0},
+        {"type": "step", "step": 0, "alive": 4, "occupied": 3},
+        {
+            "type": "dispatch",
+            "k": 2,
+            "phases": {
+                "dispatch": 1.5, "device": 2.0, "fetch": 0.4, "replay": 0.3,
+            },
+        },
+        {"type": "sentinel", "flags": 1, "step": 0, "policy": "warn"},
+        {
+            "type": "dispatch",
+            "k": 2,
+            "phases": {"dispatch": 1.0, "fetch": 0.2},
+        },
+    ]
+    doc = rows_to_trace(rows)
+    assert doc["otherData"]["synthetic_timeline"] is True
+    assert doc["otherData"]["dispatches"] == 2
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    # host phases ride the scheduler-loop lane, device/fetch the worker
+    lanes = {e["name"]: e["tid"] for e in spans}
+    assert lanes["dispatch"] == 1 and lanes["replay"] == 1
+    assert lanes["device"] == 2 and lanes["fetch"] == 2
+    # the second dispatch starts after the first lane's full extent
+    d1, d2 = [e for e in spans if e["name"] == "dispatch"]
+    assert d2["ts"] > d1["ts"] + d1["dur"]
+    # sentinel trips land as instant events on the telemetry-writer lane
+    (inst,) = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert inst["name"] == "sentinel" and inst["tid"] == 3
+    # population counters render as counter events
+    (ctr,) = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert ctr["args"] == {"alive": 4, "occupied": 3}
+
+
+def test_metrics_route_is_a_get_read():
+    assert api._route("GET", "/metrics", {}) == ("metrics", {})
+    with pytest.raises(Exception):
+        api._route("POST", "/metrics", {})
