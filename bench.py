@@ -257,7 +257,7 @@ def _child_main(args: argparse.Namespace) -> None:
         chemistry=CHEMISTRY,
         map_size=args.map_size,
         seed=args.seed,
-        use_pallas=args.pallas,
+        integrator="pallas" if args.pallas else None,
     )
     world.spawn_cells(
         [random_genome(s=args.genome_size, rng=rng) for _ in range(args.n_cells)]

@@ -157,6 +157,12 @@ MODULES = {
         "The reversible Michaelis-Menten integrator as pure jitted"
         " functions (fast and deterministic numeric modes)."
     ),
+    "magicsoup_tpu.ops.backends": (
+        "The integrator backend registry: named backends (`xla-fast`,"
+        " `xla-det`, `pallas`) with capability flags, the selection /"
+        " refusal logic behind `World(integrator=...)`, and the"
+        " `integrate()` dispatch the hot paths route through."
+    ),
     "magicsoup_tpu.ops.diffusion": (
         "Molecule-map physics kernels: diffusion, permeation,"
         " degradation."

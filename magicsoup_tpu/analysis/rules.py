@@ -60,6 +60,11 @@ Findings; registration at the bottom.
 |       |                      | stepper/fleet/serve hot functions unless   |
 |       |                      | the measurement routes into the recorder   |
 |       |                      | span API or the metrics registry)          |
+| GL026 | integrator-backend-  | the integrator backend plane (no direct    |
+|       | bypass               | `integrate_signals`/`integrate_signals_    |
+|       |                      | pallas` calls in stepper/fleet/serve hot   |
+|       |                      | functions — the kernel choice routes       |
+|       |                      | through ops.backends.integrate)            |
 
 GL015-GL017 are built on the graftrace thread-role model; see
 analysis/concurrency.py for the model and analysis/ownership.py for the
@@ -255,6 +260,19 @@ RULE_INFO = {
         "route the reading through the recorder span API "
         "(TelemetryRecorder.note) or the metrics registry (observe / "
         "note_device_time), or waive a deliberate local timing",
+    ),
+    "GL026": (
+        "integrator-backend-bypass",
+        "a direct `integrate_signals` / `integrate_signals_pallas` / "
+        "`_integrate_signals_jit` call inside a stepper-, fleet-, or "
+        "serve-scoped hot function — the integrator implementation is "
+        "selected by the backend registry (ops.backends: capability "
+        "flags, env/constructor resolution, the dispatch census), and a "
+        "hot-path call that names a kernel directly pins one "
+        "implementation, skips the capability checks, and invisibly "
+        "forks the selection logic the `World(integrator=...)` plane "
+        "exists to centralize; route through ops.backends.integrate "
+        "with the resolved backend name",
     ),
 }
 # the graftrace concurrency rules keep their metadata next to their
@@ -1762,6 +1780,61 @@ def check_gl025(ctx: Context):
                 )
 
 
+# --------------------------------------------------------------- GL026
+#: the integrator entry points a hot function must not name directly —
+#: the registry (`ops.backends.integrate`) is the one selection path
+_INTEGRATOR_LEAVES = {
+    "integrate_signals",
+    "integrate_signals_pallas",
+    "_integrate_signals_jit",
+}
+
+
+def check_gl026(ctx: Context):
+    """The integrator backend registry is the ONE selection path on the
+    hot path.  A stepper-, fleet-, or serve-scoped hot function that
+    calls ``integrate_signals`` / ``integrate_signals_pallas`` /
+    ``_integrate_signals_jit`` directly has hard-wired a kernel choice:
+    it bypasses the capability flags (det-able, mesh-able) the registry
+    enforces, the ``World(integrator=...)``/env resolution the operator
+    controls, and the per-backend dispatch census ``/metrics`` exposes.
+    Route through :func:`magicsoup_tpu.ops.backends.integrate` with the
+    resolved backend name (a jit-static string); a deliberate direct
+    call waives with ``# graftlint: disable=GL026``."""
+    fix = (
+        "route the call through the backend registry: "
+        "ops.backends.integrate(integrator, X, params) with the "
+        "resolved backend name threaded as a static argument "
+        "(World.integrator / ops.backends.resolve), or waive a "
+        "deliberate direct kernel call with "
+        "`# graftlint: disable=GL026`"
+    )
+    for key in sorted(ctx.hot):
+        rec = ctx.graph.functions[key]
+        f = rec.file
+        if not (
+            _is_stepper_scoped(f)
+            or _is_fleet_scoped(f)
+            or _is_serve_scoped(f)
+        ):
+            continue
+        for node in ast.walk(rec.node):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _attr_chain(node.func).rsplit(".", 1)[-1]
+            if leaf in _INTEGRATOR_LEAVES:
+                yield _finding(
+                    "GL026",
+                    f,
+                    node,
+                    f"`{leaf}()` in hot function `{rec.qualname}` "
+                    "names an integrator kernel directly — bypassing "
+                    "the backend registry's capability flags, "
+                    "selection plane, and dispatch census",
+                    fix,
+                )
+
+
 CHECKERS = {
     "GL001": check_gl001,
     "GL002": check_gl002,
@@ -1788,6 +1861,7 @@ CHECKERS = {
     "GL023": check_gl023,
     "GL024": check_gl024,
     "GL025": check_gl025,
+    "GL026": check_gl026,
 }
 
 

@@ -244,6 +244,24 @@ def note_restack(
         _restack_skipped += skipped
 
 
+# physical integrator program launches, keyed by backend name (the
+# ops.backends registry key).  ONE count per device dispatch that ran
+# the integrator — a megastep's k fused integrator calls count once,
+# and a fused fleet dispatch counts once per distinct backend across
+# its groups.  This is the census the batched-pallas acceptance pins
+# ("B worlds, ONE kernel") and the serve /metrics
+# magicsoup_integrator_dispatches_total{backend=...} family reads.
+_integrator_dispatches: dict[str, int] = {}
+
+
+def note_integrator_dispatch(backend: str, n: int = 1) -> None:
+    """Accumulate ``n`` physical integrator launches through ``backend``."""
+    with _lock:
+        _integrator_dispatches[backend] = (
+            _integrator_dispatches.get(backend, 0) + n
+        )
+
+
 def note_dispatch(dispatches: int = 0, fused_groups: int = 0) -> None:
     """Accumulate fleet device dispatches (called by the scheduler).
 
@@ -284,7 +302,9 @@ def snapshot() -> dict[str, int]:
     ``persistent_cache_misses``, ``phenotype_hits``,
     ``phenotype_misses``, ``phenotype_evictions``, ``restack_full``,
     ``restack_inserts``, ``restack_skipped``, ``attach_full``,
-    ``attach_skipped``, ``dispatches``, ``fused_groups`` — plus the
+    ``attach_skipped``, ``dispatches``, ``fused_groups``, one
+    ``integrator_dispatches_<backend>`` per integrator backend that has
+    dispatched — plus the
     chaos/robustness contribution from
     ``guard.chaos.runtime_counters`` (``chaos_fired``, ``degraded``,
     and every ``note_counter`` key, so counted failures ride the same
@@ -316,6 +336,10 @@ def snapshot() -> dict[str, int]:
             "genome_decode_calls": _genome_decode_calls,
             "genome_decode_rows": _genome_decode_rows,
         }
+        for name in sorted(_integrator_dispatches):
+            out[f"integrator_dispatches_{name}"] = _integrator_dispatches[
+                name
+            ]
     out.update(_chaos.runtime_counters())
     # graftpulse device-time census (device_time_us/device_dispatches):
     # fed by the stepper/fleet fetch-ready callbacks, billed per-tenant
@@ -359,5 +383,6 @@ def reset_counters() -> None:
         _fused_groups = 0
         _genome_decode_calls = 0
         _genome_decode_rows = 0
+        _integrator_dispatches.clear()
     _chaos.reset_counters()
     _metrics.reset_device_time()

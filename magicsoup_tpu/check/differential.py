@@ -82,6 +82,19 @@ TOKEN_PATHS = (
     "token_fleet3",
 )
 
+#: the integrator-backend axis: the schedule world constructed with
+#: ``integrator="pallas"`` (the VMEM-resident kernel, interpret mode on
+#: CPU) driven through the K=1 pipelined stepper.  The pallas backend is
+#: fast-mode only, so this path runs WITHOUT deterministic mode and is
+#: pinned by the committed golden STRUCTURAL digest rather than by
+#: bit-comparison against the det reference: selection is disabled and
+#: mutation rates are zero in the chem phases, so the structural
+#: trajectory (cells, positions, genomes, counters) must not depend on
+#: the integrator's float output at all — a pallas regression that
+#: perturbs structure (wrong shapes, NaNs tripping the sentinels,
+#: misrouted records) forks the digest.
+PALLAS_PATHS = ("pallas_k1",)
+
 #: chem-phase lengths between structural ops — multiples of 4 so the
 #: K=4 megastep divides every phase evenly
 PHASES = (4, 8, 4)
@@ -178,6 +191,8 @@ def _chem_phase(world, n_steps: int, path: str) -> None:
         return
     import magicsoup_tpu as ms
 
+    # pallas_k1 rides the K=1 stepper branch; the backend itself came in
+    # with the world (World(integrator="pallas") at construction)
     k = 4 if base in ("k4", "fleet4", "fleet3", "fused_fleet") else 1
     kwargs = dict(
         mol_name="dfx-atp",
@@ -265,10 +280,10 @@ def run_path(
     regression passes :func:`structural_digest` instead."""
     import magicsoup_tpu as ms
 
-    if path not in PATHS + FLEET_PATHS + FUSED_PATHS + TOKEN_PATHS:
+    known = PATHS + FLEET_PATHS + FUSED_PATHS + TOKEN_PATHS + PALLAS_PATHS
+    if path not in known:
         raise ValueError(
-            f"unknown path {path!r} "
-            f"(want one of {PATHS + FLEET_PATHS + FUSED_PATHS + TOKEN_PATHS})"
+            f"unknown path {path!r} (want one of {known})"
         )
     if digest_fn is None:
         digest_fn = state_digest
@@ -289,8 +304,12 @@ def run_path(
         seed=seed,
         mesh=mesh,
         genome_backend=backend,
+        integrator="pallas" if path in PALLAS_PATHS else None,
     )
-    world.deterministic = True
+    if path not in PALLAS_PATHS:
+        # pallas is fast-mode only (no bit-reproducible variant); its
+        # axis gates on the committed golden STRUCTURAL digest instead
+        world.deterministic = True
     digests: list[str] = []
 
     # op 0: seeded spawn

@@ -103,7 +103,7 @@ def fleet_step_program(
     max_div: int,
     n_rounds: int,
     k: int,
-    use_pallas: bool,
+    integrator: str,
 ) -> tuple[DeviceState, Any, jax.Array]:
     """The raw (unjitted) fleet program: scan the solo megastep over the
     world axis, then apply each world's traced maybe-compact.
@@ -139,7 +139,7 @@ def fleet_step_program(
             n_rounds=n_rounds,
             compact=False,
             q=cap,
-            use_pallas=use_pallas,
+            integrator=integrator,
             k=k,
             mesh=None,
         )
@@ -191,7 +191,7 @@ def fleet_step_program(
     return fstate, fparams, fouts
 
 
-_STATICS = ("det", "max_div", "n_rounds", "k", "use_pallas")
+_STATICS = ("det", "max_div", "n_rounds", "k", "integrator")
 
 _fleet_step_donated = functools.partial(
     jax.jit, static_argnames=_STATICS, donate_argnums=(0, 1)
@@ -237,10 +237,10 @@ def fused_step_program(states, params, rest, *, statics, k_env, rec_env):
     push_rows, div_budget, do_compact)`` — NOT donated, because the
     consts and the cached empty spawn/push uploads are reused across
     megasteps.  ``statics`` is a hashable tuple of per-rung
-    ``(det, max_div, n_rounds, k, use_pallas)`` tuples.
+    ``(det, max_div, n_rounds, k, integrator)`` tuples.
     """
     new_states, new_params, outs = [], [], []
-    for i, (det, max_div, n_rounds, k, use_pallas) in enumerate(statics):
+    for i, (det, max_div, n_rounds, k, integrator) in enumerate(statics):
         consts, sd, sv, pd, pr, db, do = rest[i]
         fs, fp, fo = fleet_step_program(
             states[i],
@@ -256,7 +256,7 @@ def fused_step_program(states, params, rest, *, statics, k_env, rec_env):
             max_div=max_div,
             n_rounds=n_rounds,
             k=k,
-            use_pallas=use_pallas,
+            integrator=integrator,
         )
         fo = jnp.pad(
             fo,

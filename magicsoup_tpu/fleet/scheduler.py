@@ -213,7 +213,7 @@ class _GroupInputs(NamedTuple):
     maxd: int
     k: int  # megastep (records per world per dispatch)
     length: int  # native record length of this rung
-    statics: tuple  # (det, max_div, n_rounds, k, use_pallas)
+    statics: tuple  # (det, max_div, n_rounds, k, integrator)
     rest: tuple  # (consts, spawn_dense, spawn_valid, push_dense,
     #              push_rows, div_budget, do_compact)
 
@@ -243,7 +243,7 @@ def _rung_key(lane: FleetLane) -> tuple:
         lane.max_divisions,
         lane.n_rounds,
         bool(lane.world.deterministic),
-        bool(lane.world.use_pallas),
+        str(lane.world.integrator),
     )
 
 
@@ -810,7 +810,7 @@ class FleetScheduler:
                 first.max_divisions,
                 first.n_rounds,
                 first.megastep,
-                bool(first.world.use_pallas),
+                str(first.world.integrator),
             ),
             rest=(
                 group.consts,
@@ -845,7 +845,7 @@ class FleetScheduler:
         self._ensure_stacked(group)
         gi = self._prepare_group_inputs(group, plans)
         first = gi.first
-        det, max_div, n_rounds, k, use_pallas = gi.statics
+        det, max_div, n_rounds, k, integrator = gi.statics
 
         vkey = (gi.B, gi.cap, gi.maxp, gi.maxd)
         cold = vkey not in group.warm
@@ -861,13 +861,16 @@ class FleetScheduler:
                 max_div=max_div,
                 n_rounds=n_rounds,
                 k=k,
-                use_pallas=use_pallas,
+                integrator=integrator,
             )
 
         group.fstate, group.fparams, fouts = first._dispatch_with_retry(_go)
         t_dispatched = _time.perf_counter()
         group.warm.add(vkey)
         _runtime.note_dispatch(dispatches=1, fused_groups=1)
+        # integrator census: the one batched program carried every
+        # world's integrator calls through this backend — ONE count
+        _runtime.note_integrator_dispatch(integrator)
 
         # one fetch for the whole group; lanes replay their slices.
         # graftpulse device-time bracket: ONE census entry per physical
@@ -953,6 +956,11 @@ class FleetScheduler:
         t_dispatched = _time.perf_counter()
         self._fused_warm.add(sig)
         _runtime.note_dispatch(dispatches=1, fused_groups=len(fused_set))
+        # integrator census: ONE count per distinct backend the fused
+        # program ran (a mixed-backend set is still one launch, but the
+        # per-backend attribution stays truthful)
+        for name in sorted({s[4] for s in statics}):
+            _runtime.note_integrator_dispatch(name)
         # NOTE: group.warm is deliberately untouched — it tracks the
         # PER-RUNG program's warmth, which a fused dispatch neither
         # exercises nor compiles

@@ -64,7 +64,7 @@ def shard_fleet(tree, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=None)
-def _build(mesh: Mesh, det, max_div, n_rounds, k, use_pallas, donate):
+def _build(mesh: Mesh, det, max_div, n_rounds, k, integrator, donate):
     spec = P(WORLD_AXIS)
 
     def body(*args):
@@ -74,7 +74,7 @@ def _build(mesh: Mesh, det, max_div, n_rounds, k, use_pallas, donate):
             max_div=max_div,
             n_rounds=n_rounds,
             k=k,
-            use_pallas=use_pallas,
+            integrator=integrator,
         )
         # the x64 tracing scope below widens the packed record's counter
         # lanes to i64; values are identical (int arithmetic is exact),
@@ -107,7 +107,7 @@ def sharded_fleet_step(
     max_div: int,
     n_rounds: int,
     k: int,
-    use_pallas: bool = False,
+    integrator: str = "xla-fast",
 ):
     """A jitted world-sharded fleet step for ``mesh`` with the given
     statics — same signature as the positional part of
@@ -120,6 +120,6 @@ def sharded_fleet_step(
         int(max_div),
         int(n_rounds),
         int(k),
-        bool(use_pallas),
+        str(integrator),
         _donate_step_buffers(),
     )

@@ -1,0 +1,241 @@
+"""
+Integrator backend plane: the ONE selection path for the MM integrator.
+
+The reversible-MM signal integrator is the per-step numeric core, and it
+has three implementations with different capabilities:
+
+- ``xla-fast`` — the log-space XLA path
+  (:func:`magicsoup_tpu.ops.integrate._integrate_signals_jit` with
+  ``det=False``).  Runs everywhere (mesh-sharded steps included), serves
+  the stacked fleet programs, Mosaic-safe by construction.
+- ``xla-det`` — the deterministic XLA path (``det=True``): detmath
+  fixed-order reductions, bit-reproducible across IEEE backends.  The
+  float64 accumulation has no Mosaic lowering, but XLA emulates f64 on
+  TPU so the backend itself runs everywhere.
+- ``pallas`` — the VMEM-resident Pallas kernel
+  (:mod:`magicsoup_tpu.ops.pallas_integrate`): fast-mode body only, no
+  SPMD partitioning rule (mesh-excluded), batched over a leading world
+  axis for fleet shapes.
+
+Historically the choice was plumbed as two ad-hoc bools (``det`` +
+``use_pallas``) with the capability rules scattered as ``raise``s in
+``world.py``.  This registry replaces that: each backend carries
+capability flags, :func:`resolve` maps every selection source (explicit
+``World(integrator=...)``, the ``MAGICSOUP_TPU_INTEGRATOR`` env var, the
+legacy ``use_pallas`` flag / ``MAGICSOUP_TPU_PALLAS`` env var, the
+numeric mode) onto a backend name and enforces the flags in one place,
+and :func:`integrate` is the trace-safe dispatcher the hot step bodies
+route through (graftlint GL026 flags hot-path calls that bypass it).
+
+The backend NAME is the static jit-cache key the step programs carry
+(``integrator=...`` static argument) — strings are hashable, and the
+name fully determines the traced integrator body.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+from typing import NamedTuple
+
+from magicsoup_tpu.ops.integrate import _integrate_signals_jit
+
+__all__ = [
+    "ENV_VAR",
+    "REGISTRY",
+    "IntegratorBackend",
+    "get_backend",
+    "integrate",
+    "integrator_fn",
+    "resolve",
+]
+
+#: env var naming a backend explicitly (same precedence as the
+#: ``World(integrator=...)`` argument, below it)
+ENV_VAR = "MAGICSOUP_TPU_INTEGRATOR"
+
+#: legacy opt-in env var for the Pallas kernel (kept working; resolves
+#: to the ``pallas`` backend)
+LEGACY_ENV_VAR = "MAGICSOUP_TPU_PALLAS"
+
+
+class IntegratorBackend(NamedTuple):
+    """One registered integrator backend and its capability flags.
+
+    ``det_able``: bit-reproducible across IEEE backends (may serve a
+    world in deterministic mode).  ``mesh_able``: has an SPMD
+    partitioning rule (may serve a mesh-sharded step).
+    ``fleet_batchable``: serves the stacked fleet megastep programs.
+    ``mosaic_safe``: every primitive in its body has a Mosaic lowering
+    (can compile for TPU without the XLA fallback path).
+    """
+
+    name: str
+    det_able: bool
+    mesh_able: bool
+    fleet_batchable: bool
+    mosaic_safe: bool
+
+
+REGISTRY: dict[str, IntegratorBackend] = {
+    b.name: b
+    for b in (
+        IntegratorBackend(
+            "xla-fast",
+            det_able=False,
+            mesh_able=True,
+            fleet_batchable=True,
+            mosaic_safe=True,
+        ),
+        IntegratorBackend(
+            "xla-det",
+            det_able=True,
+            mesh_able=True,
+            fleet_batchable=True,
+            # detmath accumulates in f64; XLA emulates it on TPU, Mosaic
+            # refuses it (the round-2 kernel crash — see
+            # ops/pallas_integrate.py history note)
+            mosaic_safe=False,
+        ),
+        IntegratorBackend(
+            "pallas",
+            det_able=False,
+            mesh_able=False,
+            fleet_batchable=True,
+            mosaic_safe=True,
+        ),
+    )
+}
+
+
+def get_backend(name: str) -> IntegratorBackend:
+    """Look up a backend by name; unknown names are a ``ValueError``."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown integrator backend {name!r} "
+            f"(want one of {sorted(REGISTRY)})"
+        ) from None
+
+
+def _refuse_mesh(backend: IntegratorBackend) -> None:
+    if backend.name == "pallas":
+        # the exact message the legacy use_pallas plumbing raised —
+        # callers (and tests) match on it
+        raise ValueError(
+            "use_pallas is not supported with a mesh: pallas_call has"
+            " no partitioning rule; the sharded step uses the XLA"
+            " integrator"
+        )
+    raise ValueError(
+        f"integrator backend {backend.name!r} is not supported with a"
+        " mesh (no SPMD partitioning rule)"
+    )
+
+
+def _refuse_det(backend: IntegratorBackend) -> None:
+    if backend.name == "pallas":
+        raise ValueError(
+            "use_pallas is not supported in deterministic mode: the"
+            " kernel has no bit-reproducible variant; unset"
+            " MAGICSOUP_TPU_DETERMINISTIC or use the XLA integrator"
+        )
+    raise ValueError(
+        f"integrator backend {backend.name!r} is not bit-reproducible:"
+        " deterministic mode needs a det-able backend"
+        " ('xla-det'); unset MAGICSOUP_TPU_DETERMINISTIC or pick one"
+    )
+
+
+def resolve(
+    integrator: str | None = None,
+    *,
+    use_pallas: bool | None = None,
+    deterministic: bool = False,
+    mesh=None,
+) -> tuple[str, bool]:
+    """Resolve every selection source onto one backend name.
+
+    Precedence: explicit ``integrator`` argument > ``MAGICSOUP_TPU_INTEGRATOR``
+    env var > legacy ``use_pallas`` flag > ``MAGICSOUP_TPU_PALLAS`` env
+    var > the numeric mode (``xla-det`` when deterministic, else
+    ``xla-fast``).  Capability flags are enforced HERE: an explicit
+    choice that violates one raises ``ValueError`` (the exact legacy
+    messages for pallas), an env-sourced choice that conflicts with a
+    mesh warns and falls back to the XLA path (the legacy
+    ``MAGICSOUP_TPU_PALLAS`` behavior).
+
+    Returns ``(name, pinned)`` — ``pinned`` is False when the name was
+    derived from the numeric mode only, so a caller tracking the choice
+    can keep following the mode (a world whose ``deterministic`` flag is
+    flipped later re-derives ``xla-det``/``xla-fast``).
+    """
+    if integrator is not None and use_pallas is not None:
+        if bool(use_pallas) != (get_backend(integrator).name == "pallas"):
+            raise ValueError(
+                f"integrator={integrator!r} conflicts with"
+                f" use_pallas={use_pallas!r}; pass only integrator="
+            )
+    choice = integrator
+    from_env = False
+    if choice is None:
+        env = os.environ.get(ENV_VAR, "")
+        if env:
+            choice, from_env = env, True
+    if choice is None and use_pallas is None:
+        if os.environ.get(LEGACY_ENV_VAR) == "1":
+            choice, from_env = "pallas", True
+    if choice is None and use_pallas:
+        choice = "pallas"
+    if choice is None:
+        return ("xla-det" if deterministic else "xla-fast", False)
+
+    backend = get_backend(choice)
+    if mesh is not None and not backend.mesh_able:
+        if from_env:
+            # env opt-ins never break a mesh-placed world — same
+            # behavior (and message) the legacy env plumbing had
+            warnings.warn(
+                f"{LEGACY_ENV_VAR}=1 is ignored for mesh-placed"
+                " worlds: the sharded step uses the XLA integrator"
+                if backend.name == "pallas" and not os.environ.get(ENV_VAR)
+                else f"{ENV_VAR}={backend.name} is ignored for"
+                " mesh-placed worlds: the sharded step uses the XLA"
+                " integrator"
+            )
+            return ("xla-det" if deterministic else "xla-fast", False)
+        _refuse_mesh(backend)
+    if deterministic and not backend.det_able:
+        _refuse_det(backend)
+    return (backend.name, True)
+
+
+@functools.lru_cache(maxsize=None)
+def integrator_fn(name: str):
+    """The backend's integrator as a plain ``(X, params) -> X1``
+    callable (trace-safe; cached per name).  The pallas backend runs
+    interpret mode automatically off-TPU so the same world works on CPU
+    tests and TPU runs."""
+    backend = get_backend(name)
+    if backend.name == "pallas":
+        import jax
+
+        from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+        interpret = jax.default_backend() != "tpu"
+        return functools.partial(integrate_signals_pallas, interpret=interpret)
+    det = backend.name == "xla-det"
+
+    def fn(X, params, _det=det):
+        return _integrate_signals_jit(X, params, _det)
+
+    return fn
+
+
+def integrate(name: str, X, params):
+    """Dispatch one integrator step through backend ``name`` — the
+    registry-routed spelling hot step bodies must use (graftlint GL026
+    flags direct ``integrate_signals``/``integrate_signals_pallas``
+    calls in stepper/fleet/serve-scoped hot functions)."""
+    return integrator_fn(name)(X, params)

@@ -39,8 +39,9 @@ Two numeric modes (the ``det`` static argument, default from
   check (`scripts/bitrepro.py`, BITREPRO.md) runs.  (The Pallas kernel
   runs the FAST mode with a ``mosaic_safe`` rewrite of the allosteric
   factor — detmath's float64 accumulation has no Mosaic lowering, which
-  is also why ``use_pallas`` and deterministic mode are mutually
-  exclusive; see :mod:`magicsoup_tpu.ops.pallas_integrate`.)
+  is why the backend registry (:mod:`magicsoup_tpu.ops.backends`)
+  marks the pallas backend ``det_able=False`` and refuses it under
+  deterministic mode; see :mod:`magicsoup_tpu.ops.pallas_integrate`.)
 
 Both modes implement the same math; all hand-math golden tests run in both.
 """
@@ -372,9 +373,11 @@ def integrate_signals(
     This is the pure-XLA implementation (exact reference parity including
     the batch-global equilibrium early-stop).  The VMEM-tiled Pallas
     variant lives in :mod:`magicsoup_tpu.ops.pallas_integrate` and is
-    selected per :class:`World` via ``use_pallas`` — never implicitly, so
-    sharded steps (where ``pallas_call`` has no partitioning rule) always
-    use this path.
+    selected per :class:`World` through the backend registry
+    (``World(integrator="pallas")`` / :mod:`magicsoup_tpu.ops.backends`)
+    — never implicitly, so sharded steps (where ``pallas_call`` has no
+    partitioning rule, ``mesh_able=False`` in the registry) always use
+    this path.
     """
     if det is None:
         det = default_deterministic()

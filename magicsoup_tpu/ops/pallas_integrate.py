@@ -37,14 +37,31 @@ kinetics.py:846-847, a GLOBAL ``torch.any`` over the whole batch) is
 evaluated per cell TILE here, decoupling cells in different tiles —
 strictly closer to the per-cell ideal the heuristic approximates.  The
 XLA path keeps the batch-global flag for exact reference parity, which
-is why the kernel is opt-in (``World(use_pallas=True)`` /
-``MAGICSOUP_TPU_PALLAS=1``) and why sharded steps (no partitioning rule
-for ``pallas_call``) always use the XLA path.
+is why the kernel is opt-in (``World(integrator="pallas")`` — the
+backend registry in :mod:`magicsoup_tpu.ops.backends` is the selection
+path) and why sharded steps (no partitioning rule for ``pallas_call``)
+always use the XLA path.  A consequence worth knowing when changing the
+tile table: the DEFAULT tile size is part of the kernel's observable
+numerics — cells early-stop with their tile-mates.
+
+**Batched world axis**: a rank-3 ``X`` of shape ``(B, cells, signals)``
+with params carrying the same leading axis runs a 2D grid
+``(B, cells // tile_c)`` — ONE kernel launch serves all B worlds of a
+fleet rung group.  Tiles never cross the world axis, so world ``w``'s
+output is bit-equal to its own ``B=1`` launch at the same ``tile_c``
+(pinned by test).
+
+**Tile table**: the default ``tile_c`` is the largest divisor of the
+cell capacity whose per-grid-step VMEM working set fits a configurable
+budget (``MAGICSOUP_TPU_PALLAS_VMEM_BUDGET`` bytes, default 8 MiB) —
+replacing the old ``gcd(c, 128)`` heuristic, whose degenerate case (an
+odd capacity -> ``tile_c=1`` -> one grid step PER CELL) is now a typed
+refusal instead of a silent pathological launch.
 
 ``interpret=True`` runs the kernel on CPU for tests.
 """
 import functools
-import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +72,88 @@ from magicsoup_tpu.ops.integrate import (
     CellParams,
     _integrate_part,
 )
+
+#: default per-grid-step VMEM working-set budget (bytes).  TPU cores
+#: have ~16 MiB of VMEM; half of it leaves headroom for Mosaic's own
+#: scratch and the next tile's prefetch window.
+DEFAULT_VMEM_BUDGET = 8 * 1024 * 1024
+
+#: f32 sublane tile height — a cell tile that is not a multiple of 8
+#: pads every (tile, p) / (tile, p, s) operand in registers on TPU
+_MIN_TILE = 8
+
+
+def vmem_budget() -> int:
+    """The configured VMEM working-set budget (bytes) for the default
+    tile table — ``MAGICSOUP_TPU_PALLAS_VMEM_BUDGET`` or the default."""
+    env = os.environ.get("MAGICSOUP_TPU_PALLAS_VMEM_BUDGET", "")
+    return int(env) if env else DEFAULT_VMEM_BUDGET
+
+
+def tile_vmem_bytes(tile_c: int, p: int, s: int) -> int:
+    """Resident VMEM bytes of one ``tile_c``-cell grid step.
+
+    Operands: X in + out ``(tile, s)`` f32; Ke/Kmf/Kmb/Vmax ``(tile,
+    p)`` f32; Kmr ``(tile, p, s)`` f32; N/Nf/Nb/A ``(tile, p, s)`` i16 —
+    plus two ``(tile, p, s)`` f32 live intermediates (the negative-guard
+    ``NV``/``F_prots`` tensors are the widest scratch the fast-mode body
+    materializes at once)."""
+    f32, i16 = 4, 2
+    per_row = (
+        2 * s * f32  # X in + out
+        + 4 * p * f32  # Ke, Kmf, Kmb, Vmax
+        + p * s * f32  # Kmr
+        + 4 * p * s * i16  # N, Nf, Nb, A
+        + 2 * p * s * f32  # live f32 intermediates
+    )
+    return tile_c * per_row
+
+
+def select_tile_c(
+    c: int, p: int, s: int, budget: int | None = None
+) -> int:
+    """The tile table: largest divisor of capacity ``c`` whose working
+    set (:func:`tile_vmem_bytes`) fits ``budget``, restricted to
+    sublane-aligned tiles (multiples of 8) — except that the whole
+    capacity is always an admissible single tile, so small or oddly
+    sized batches that fit VMEM outright still run in one grid step.
+
+    Raises ``ValueError`` when no admissible tile exists (e.g. an odd
+    capacity too big for one tile: its only aligned divisor would be the
+    degenerate ``tile_c=1``, one grid step per cell)."""
+    if budget is None:
+        budget = vmem_budget()
+    fitting = [
+        d
+        for d in range(1, c + 1)
+        if c % d == 0
+        and (d % _MIN_TILE == 0 or d == c)
+        and tile_vmem_bytes(d, p, s) <= budget
+    ]
+    if not fitting:
+        raise ValueError(
+            f"no usable pallas tile for capacity {c} (proteins={p},"
+            f" signals={s}): no sublane-aligned (multiple-of-{_MIN_TILE})"
+            f" divisor of {c} fits the {budget}-byte VMEM budget"
+            " (MAGICSOUP_TPU_PALLAS_VMEM_BUDGET); use a power-of-two"
+            " capacity, raise the budget, or use the XLA integrator"
+        )
+    return max(fitting)
+
+
+def _body(x, ke, kmf, kmb, kmr, vmax, n, nf, nb, a):
+    params = CellParams(
+        Ke=ke, Kmf=kmf, Kmb=kmb, Kmr=kmr, Vmax=vmax, N=n, Nf=nf, Nb=nb, A=a
+    )
+    X = x
+    for trim in TRIM_FACTORS:
+        # the SHARED fast-mode trim pass with the one Mosaic-safe
+        # sub-expression swap — fixes to the integrator apply here too
+        X = _integrate_part(
+            X, jnp.clip(params.Vmax * trim, min=0.0), params,
+            det=False, mosaic_safe=True,
+        )
+    return X
 
 
 def _kernel(
@@ -70,26 +169,48 @@ def _kernel(
     a_ref,
     out_ref,
 ):
-    params = CellParams(
-        Ke=ke_ref[:],
-        Kmf=kmf_ref[:],
-        Kmb=kmb_ref[:],
-        Kmr=kmr_ref[:],
-        Vmax=vmax_ref[:],
-        N=n_ref[:],
-        Nf=nf_ref[:],
-        Nb=nb_ref[:],
-        A=a_ref[:],
+    out_ref[:] = _body(
+        x_ref[:],
+        ke_ref[:],
+        kmf_ref[:],
+        kmb_ref[:],
+        kmr_ref[:],
+        vmax_ref[:],
+        n_ref[:],
+        nf_ref[:],
+        nb_ref[:],
+        a_ref[:],
     )
-    X = x_ref[:]
-    for trim in TRIM_FACTORS:
-        # the SHARED fast-mode trim pass with the one Mosaic-safe
-        # sub-expression swap — fixes to the integrator apply here too
-        X = _integrate_part(
-            X, jnp.clip(params.Vmax * trim, min=0.0), params,
-            det=False, mosaic_safe=True,
-        )
-    out_ref[:] = X
+
+
+def _kernel_batched(
+    x_ref,
+    ke_ref,
+    kmf_ref,
+    kmb_ref,
+    kmr_ref,
+    vmax_ref,
+    n_ref,
+    nf_ref,
+    nb_ref,
+    a_ref,
+    out_ref,
+):
+    # blocks carry a leading world axis of 1; squeeze it so the body is
+    # the EXACT rank-2 trim pass the solo kernel runs (bit-equal per
+    # world to a B=1 launch at the same tile_c)
+    out_ref[0] = _body(
+        x_ref[0],
+        ke_ref[0],
+        kmf_ref[0],
+        kmb_ref[0],
+        kmr_ref[0],
+        vmax_ref[0],
+        n_ref[0],
+        nf_ref[0],
+        nb_ref[0],
+        a_ref[0],
+    )
 
 
 # graftlint: disable=GL006 params is read-only; only the signal matrix is returned
@@ -107,29 +228,44 @@ def integrate_signals_pallas(
     Pallas-tiled equivalent of
     :func:`magicsoup_tpu.ops.integrate.integrate_signals` (fast mode).
 
-    ``tile_c`` is the number of cells per grid step (must divide the cell
-    capacity; defaults to 128 or the whole batch if smaller).  VMEM per
-    tile is ~tile_c * proteins * signals * 4 B * ~10 live tensors — with
-    the default 128 cells, 64 proteins, 12 signals that is ~4 MB.
+    ``X`` is ``(cells, signals)``, or ``(B, cells, signals)`` with every
+    ``params`` leaf carrying the same leading world axis — the batched
+    form runs a 2D grid ``(B, cells // tile_c)``, one launch for all B
+    worlds.  ``tile_c`` is the number of cells per grid step (must
+    divide the cell capacity; default from :func:`select_tile_c`, the
+    VMEM-budget tile table).
     """
-    c, s = X.shape
+    batched = X.ndim == 3
+    c, s = X.shape[-2], X.shape[-1]
+    p = params.Ke.shape[-1]
     if tile_c is None:
-        # largest power-of-two tile <= 128 that divides c (any batch size
-        # works; capacity pools are pow2 so they get the full 128)
-        tile_c = math.gcd(c, 128)
+        tile_c = select_tile_c(c, p, s)
     if c % tile_c != 0:
         raise ValueError(f"cell count {c} not divisible by tile_c={tile_c}")
-    p = params.Ke.shape[1]
 
-    cp = lambda i: (i, 0)  # noqa: E731
-    cps = lambda i: (i, 0, 0)  # noqa: E731
-    bs_cs = pl.BlockSpec((tile_c, s), cp)
-    bs_cp = pl.BlockSpec((tile_c, p), cp)
-    bs_cps = pl.BlockSpec((tile_c, p, s), cps)
+    if not batched:
+        cp = lambda i: (i, 0)  # noqa: E731
+        cps = lambda i: (i, 0, 0)  # noqa: E731
+        bs_cs = pl.BlockSpec((tile_c, s), cp)
+        bs_cp = pl.BlockSpec((tile_c, p), cp)
+        bs_cps = pl.BlockSpec((tile_c, p, s), cps)
+        kernel = _kernel
+        grid = (c // tile_c,)
+        out_shape = jax.ShapeDtypeStruct((c, s), X.dtype)
+    else:
+        B = X.shape[0]
+        bcp = lambda b, i: (b, i, 0)  # noqa: E731
+        bcps = lambda b, i: (b, i, 0, 0)  # noqa: E731
+        bs_cs = pl.BlockSpec((1, tile_c, s), bcp)
+        bs_cp = pl.BlockSpec((1, tile_c, p), bcp)
+        bs_cps = pl.BlockSpec((1, tile_c, p, s), bcps)
+        kernel = _kernel_batched
+        grid = (B, c // tile_c)
+        out_shape = jax.ShapeDtypeStruct((B, c, s), X.dtype)
 
     return pl.pallas_call(
-        _kernel,
-        grid=(c // tile_c,),
+        kernel,
+        grid=grid,
         in_specs=[
             bs_cs,  # X
             bs_cp,  # Ke
@@ -143,7 +279,7 @@ def integrate_signals_pallas(
             bs_cps,  # A
         ],
         out_specs=bs_cs,
-        out_shape=jax.ShapeDtypeStruct((c, s), X.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(
         X,

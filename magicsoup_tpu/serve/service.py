@@ -183,6 +183,12 @@ def _build_metrics(reg):
         "fetch census) keyed by counter name",
         ("counter",),
     )
+    reg.counter(
+        "magicsoup_integrator_dispatches_total",
+        "Physical integrator program launches per backend "
+        "(ops.backends registry name)",
+        ("backend",),
+    )
     for name, _, help_text in _TENANT_FAMILIES:
         reg.counter(name, help_text, ("tenant",))
     reg.gauge("magicsoup_tenants", "Admitted tenants")
@@ -548,6 +554,15 @@ class FleetService:
         )
         for key in sorted(counters):
             if key in ("device_time_us", "device_dispatches"):
+                continue
+            if key.startswith("integrator_dispatches_"):
+                # per-backend integrator census rides its own labeled
+                # family instead of the generic counter-name bag
+                reg.set(
+                    "magicsoup_integrator_dispatches_total",
+                    counters[key],
+                    backend=key[len("integrator_dispatches_"):],
+                )
                 continue
             if key in _RUNTIME_GAUGE_KEYS:
                 reg.set("magicsoup_runtime_gauge", counters[key], counter=key)

@@ -55,12 +55,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
+from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.analysis.ownership import owned_by
 from magicsoup_tpu.guard import chaos as _chaos
 from magicsoup_tpu.native import engine as _engine
+from magicsoup_tpu.ops import backends as _backends
 from magicsoup_tpu.ops import detmath as _detmath
 from magicsoup_tpu.ops import diffusion as _diff
-from magicsoup_tpu.ops.integrate import CellParams, _integrate_signals_jit
+from magicsoup_tpu.ops.integrate import CellParams
 from magicsoup_tpu.ops.params import (
     compact_rows,
     compute_cell_params,
@@ -356,7 +358,7 @@ def _step_body(
     n_rounds: int,
     compact: bool,
     q: int | None = None,
-    use_pallas: bool = False,
+    integrator: str = "xla-fast",
     mesh=None,
 ) -> tuple[DeviceState, CellParams, jax.Array]:
     """One fused workload step (spawn -> activity -> select -> kill ->
@@ -456,16 +458,9 @@ def _step_body(
         ext = mm[:, xs_q, ys_q].T  # (q, mols)
         params_q = jax.tree_util.tree_map(lambda t: t[:q], params)
         X0q = jnp.concatenate([cm[:q], ext], axis=1)
-        if use_pallas:
-            from magicsoup_tpu.ops.pallas_integrate import (
-                integrate_signals_pallas,
-            )
-
-            X1 = integrate_signals_pallas(
-                X0q, params_q, interpret=jax.default_backend() != "tpu"
-            )
-        else:
-            X1 = _integrate_signals_jit(X0q, params_q, det)
+        # registry-routed integrator dispatch (GL026: the backend name
+        # static is the ONLY selection axis; no ad-hoc kernel branching)
+        X1 = _backends.integrate(integrator, X0q, params_q)
         alive_q = alive[:q, None]
         cm = jax.lax.dynamic_update_slice_in_dim(
             cm, jnp.where(alive_q, X1[:, :n_mols], cm[:q]), 0, axis=0
@@ -728,7 +723,7 @@ def _step_body(
 _pipeline_step = functools.partial(
     jax.jit,
     static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+        "det", "max_div", "n_rounds", "compact", "q", "integrator",
         "mesh",
     ),
     donate_argnums=(0, 1),
@@ -745,7 +740,7 @@ _pipeline_step = functools.partial(
 _pipeline_step_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of _pipeline_step; donation races XLA:CPU async execution
     jax.jit,
     static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "q", "use_pallas",
+        "det", "max_div", "n_rounds", "compact", "q", "integrator",
         "mesh",
     ),
 )(_step_body)
@@ -761,7 +756,7 @@ def _donate_step_buffers() -> bool:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+        "det", "max_div", "n_rounds", "compact", "q", "integrator", "k",
         "mesh",
     ),
     donate_argnums=(0, 1),
@@ -789,7 +784,7 @@ def _megastep(
     n_rounds: int,
     compact: bool,
     q: int | None = None,
-    use_pallas: bool = False,
+    integrator: str = "xla-fast",
     k: int = 1,
     mesh=None,
 ) -> tuple[DeviceState, CellParams, jax.Array]:
@@ -832,7 +827,7 @@ def _megastep(
             n_rounds=n_rounds,
             compact=False,
             q=q,
-            use_pallas=use_pallas,
+            integrator=integrator,
             mesh=mesh,
         )
         return (state, params), out
@@ -870,7 +865,7 @@ def _megastep(
         n_rounds=n_rounds,
         compact=compact,
         q=q,
-        use_pallas=use_pallas,
+        integrator=integrator,
         mesh=mesh,
     )
     if outs is None:
@@ -884,7 +879,7 @@ def _megastep(
 _megastep_retained = functools.partial(  # graftlint: disable=GL006 CPU twin of _megastep; donation races XLA:CPU async execution
     jax.jit,
     static_argnames=(
-        "det", "max_div", "n_rounds", "compact", "q", "use_pallas", "k",
+        "det", "max_div", "n_rounds", "compact", "q", "integrator", "k",
         "mesh",
     ),
 )(_megastep.__wrapped__)
@@ -1673,13 +1668,16 @@ class PipelinedStepper:
                 n_rounds=self.n_rounds,
                 compact=compact,
                 q=q,
-                use_pallas=self.world.use_pallas,
+                integrator=self.world.integrator,
             )
 
         self._state, self.kin.params, out = self._dispatch_with_retry(
             _dispatch
         )
         t_dispatched = _time.perf_counter()
+        # integrator census: ONE physical program launch carried the
+        # megastep's k integrator calls — counted per backend name
+        _runtime.note_integrator_dispatch(self.world.integrator)
         self._note_warm(q, compact)
         out_fut = (
             self._fetcher.submit(out, on_ready=self._device_ready(t_dispatched))
@@ -2896,7 +2894,7 @@ class PipelinedStepper:
             n_rounds=self.n_rounds,
             compact=compact,
             q=q,
-            use_pallas=self.world.use_pallas,
+            integrator=self.world.integrator,
         )
 
     def _step_fn(self):

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from magicsoup_tpu.analysis import runtime as _runtime
 from magicsoup_tpu.containers import Cell, Chemistry
 from magicsoup_tpu.genetics import Genetics, PhenotypeCache
 from magicsoup_tpu.kinetics import Kinetics
@@ -40,7 +41,6 @@ from magicsoup_tpu.ops import diffusion as _diff
 from magicsoup_tpu.ops.integrate import (
     CellParams,
     default_deterministic,
-    integrate_signals,
 )
 from magicsoup_tpu.ops.params import (
     compact_rows,
@@ -102,42 +102,31 @@ def _make_enzymatic_activity(integrator):
     return _enzymatic_activity
 
 
-_activity_fns: dict = {}  # keyed by (det, pallas); built lazily
+_activity_fns: dict = {}  # keyed by integrator backend name; built lazily
 _activity_col_fns: dict = {}  # same keys; activity + column slice fused
 
 
-def _variant_key(det: bool, pallas: bool) -> tuple[bool, bool]:
-    # the Pallas kernel has no deterministic variant; World.__init__
-    # rejects the combination, so pallas keys are det-independent
-    return (False, True) if pallas else (det, False)
+def _get_activity_fn(integrator: str):
+    """The jitted activity program around one registered integrator
+    backend (``ops.backends`` is the only selection path — the backend
+    name fully determines the traced integrator body)."""
+    if integrator not in _activity_fns:
+        from magicsoup_tpu.ops import backends as _backends
+
+        _activity_fns[integrator] = _make_enzymatic_activity(
+            _backends.integrator_fn(integrator)
+        )
+    return _activity_fns[integrator]
 
 
-def _get_activity_fn(det: bool, pallas: bool):
-    key = _variant_key(det, pallas)
-    if key not in _activity_fns:
-        if pallas:
-            from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
-
-            interpret = jax.default_backend() != "tpu"
-            integrator = functools.partial(
-                integrate_signals_pallas, interpret=interpret
-            )
-        else:
-            def integrator(X, params, _det=det):
-                return integrate_signals(X, params, det=_det)
-
-        _activity_fns[key] = _make_enzymatic_activity(integrator)
-    return _activity_fns[key]
-
-
-def _get_activity_col_fn(det: bool, pallas: bool):
+def _get_activity_col_fn(integrator: str):
     """The activity step with one molecule column sliced out in the SAME
     program (traced column index, so one compile covers all columns) —
     saves the separate slice dispatch when a selection threshold will be
     fetched right after the step."""
-    key = _variant_key(det, pallas)
+    key = integrator
     if key not in _activity_col_fns:
-        activity = _get_activity_fn(det, pallas)
+        activity = _get_activity_fn(integrator)
 
         @functools.partial(jax.jit, static_argnames=("q",))
         def fn(
@@ -227,7 +216,7 @@ def _degrade_diffuse_permeate(
 # graftlint: disable=GL006 params is read-only in the step burst; the (map, molecules) successors ARE donated below
 @functools.partial(
     jax.jit,
-    static_argnames=("det", "pallas", "n_steps", "q"),
+    static_argnames=("det", "integrator", "n_steps", "q"),
     # the burst consumes (molecule_map, cell_molecules) and returns their
     # successors; donation lets XLA update them in place instead of
     # holding two copies of the largest world tensors for n_steps.
@@ -248,7 +237,7 @@ def _step_many(
     perm_factors: jax.Array,
     *,
     det: bool,
-    pallas: bool,
+    integrator: str,
     n_steps: int,
     q: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
@@ -257,7 +246,7 @@ def _step_many(
     loop's :func:`World.step_many` megastep counterpart.  The math and
     order per iteration are exactly ``enzymatic_activity()`` followed by
     ``degrade_and_diffuse_molecules()``."""
-    activity = _get_activity_fn(det, pallas)
+    activity = _get_activity_fn(integrator)
 
     def body(carry, _):
         # named_scope: profiler-trace phase labels only, no lowering
@@ -434,6 +423,7 @@ class World:
         batch_size: int | None = None,
         seed: int | None = None,
         mesh: "jax.sharding.Mesh | None" = None,
+        integrator: str | None = None,
         use_pallas: bool | None = None,
         phenotype_cache_size: int = 16384,
         telemetry=None,
@@ -492,39 +482,26 @@ class World:
             self._map_sharding = tiled.map_sharding(mesh)
             self._cell_sharding = tiled.cell_sharding(mesh)
 
-        # Pallas integrator: explicit opt-in (default from the env var at
-        # construction time, so the choice is fixed per instance).  The
-        # kernel has no SPMD partitioning rule, so mesh-placed worlds
-        # always use the XLA integrator.
-        if use_pallas is None:
-            import os
+        # Integrator backend: the ops.backends registry is the ONLY
+        # selection path — explicit ``integrator=`` name, the env vars,
+        # or the legacy ``use_pallas`` flag all resolve there, with the
+        # capability flags (mesh-able, det-able) enforced by the
+        # registry instead of scattered raises here.
+        from magicsoup_tpu.ops import backends as _backends
 
-            env_pallas = os.environ.get("MAGICSOUP_TPU_PALLAS") == "1"
-            if env_pallas and mesh is not None:
-                import warnings
-
-                warnings.warn(
-                    "MAGICSOUP_TPU_PALLAS=1 is ignored for mesh-placed"
-                    " worlds: the sharded step uses the XLA integrator"
-                )
-            use_pallas = env_pallas and mesh is None
-        if use_pallas and mesh is not None:
-            raise ValueError(
-                "use_pallas is not supported with a mesh: pallas_call has"
-                " no partitioning rule; the sharded step uses the XLA"
-                " integrator"
-            )
-        self.use_pallas = bool(use_pallas)
         # numeric mode, fixed per instance at construction (README
         # "Numeric modes"): deterministic = bit-reproducible across
         # backends, fast = backend-native lowerings
         self.deterministic = default_deterministic()
-        if self.use_pallas and self.deterministic:
-            raise ValueError(
-                "use_pallas is not supported in deterministic mode: the"
-                " kernel has no bit-reproducible variant; unset"
-                " MAGICSOUP_TPU_DETERMINISTIC or use the XLA integrator"
-            )
+        choice, pinned = _backends.resolve(
+            integrator,
+            use_pallas=use_pallas,
+            deterministic=self.deterministic,
+            mesh=mesh,
+        )
+        # unpinned = derived from the numeric mode only; the
+        # ``integrator`` property keeps following ``deterministic`` then
+        self._integrator_choice = choice if pinned else None
 
         self.genetics = Genetics(
             start_codons=start_codons,
@@ -1375,8 +1352,26 @@ class World:
     # physics                                                            #
     # ------------------------------------------------------------------ #
 
+    @property
+    def integrator(self) -> str:
+        """The resolved integrator backend name (``ops.backends``).
+
+        Pinned per instance when selected explicitly (``integrator=``,
+        ``use_pallas=True``, or an env var); otherwise it follows the
+        numeric mode (``xla-det`` when :attr:`deterministic`, else
+        ``xla-fast``) so post-construction mode flips stay coherent."""
+        choice = self.__dict__.get("_integrator_choice")
+        if choice is not None:
+            return choice
+        return "xla-det" if self.deterministic else "xla-fast"
+
+    @property
+    def use_pallas(self) -> bool:
+        """Legacy spelling of ``integrator == "pallas"`` (read-only)."""
+        return self.integrator == "pallas"
+
     def _activity_fn(self):
-        return _get_activity_fn(self.deterministic, self.use_pallas)
+        return _get_activity_fn(self.integrator)
 
     def enzymatic_activity(self, prefetch_column: int | None = None):
         """Catalyze reactions and transport for one time step; updates
@@ -1406,9 +1401,10 @@ class World:
                 self.kinetics.params,
                 q=q,
             )
+            _runtime.note_integrator_dispatch(self.integrator)
             self._note_activity_warm(q, has_col=False)
             return
-        fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
+        fn = _get_activity_col_fn(self.integrator)
         self._molecule_map, self._cell_molecules, col = fn(
             self._molecule_map,
             self._cell_molecules,
@@ -1419,6 +1415,7 @@ class World:
             q=q,
         )
         self._record_col_prefetch(prefetch_column, col)
+        _runtime.note_integrator_dispatch(self.integrator)
         self._note_activity_warm(q, has_col=True)
 
     def prewarm_activity(
@@ -1451,7 +1448,7 @@ class World:
             self.kinetics.params,
         )
         if has_col:
-            fn = _get_activity_col_fn(self.deterministic, self.use_pallas)
+            fn = _get_activity_col_fn(self.integrator)
             fn(*args, jnp.asarray(0, dtype=jnp.int32), q=q)
         else:
             self._activity_fn()(*args, q=q)
@@ -1569,10 +1566,11 @@ class World:
             self._diff_kernels,
             self._perm_factors,
             det=self.deterministic,
-            pallas=self.use_pallas,
+            integrator=self.integrator,
             n_steps=n_steps,
             q=q,
         )
+        _runtime.note_integrator_dispatch(self.integrator)
         self._np_lifetimes[: self.n_cells] += n_steps
 
     def increment_cell_lifetimes(self):
@@ -1832,10 +1830,16 @@ class World:
         self.__dict__.setdefault("_genomes_list", [])
         if legacy_genomes is not None:
             self._genomes_list = list(legacy_genomes)
-        self.__dict__.setdefault("use_pallas", False)
+        # integrator plane migration: ``use_pallas`` is a read-only
+        # property now — route a legacy pickle's stored bool into the
+        # backend-choice attribute the property derives from
+        legacy_pallas = self.__dict__.pop("use_pallas", False)
+        self.__dict__.setdefault(
+            "_integrator_choice", "pallas" if legacy_pallas else None
+        )
         self.__dict__.setdefault("deterministic", default_deterministic())
         self.__dict__.setdefault("_host_epoch", 0)
-        if self.use_pallas and self.deterministic:
+        if self._integrator_choice == "pallas" and self.deterministic:
             # same incompatibility __init__ rejects; a restored world must
             # not silently break the bit-reproducibility contract, and the
             # numeric mode is the stronger promise — drop the kernel
@@ -1846,7 +1850,7 @@ class World:
                 " is on; the kernel has no bit-reproducible variant, so"
                 " use_pallas is disabled"
             )
-            self.use_pallas = False
+            self._integrator_choice = None
         self.__dict__.setdefault("_mm_cache", None)
         self.__dict__.setdefault("_cm_cache", None)
         _pheno_size = self.__dict__.pop("_phenotype_cache_size", 16384)

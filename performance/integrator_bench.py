@@ -5,6 +5,15 @@ utilisation estimate (the op is memory-bound: its FLOPs are elementwise,
 there is no matmul).
 
     python performance/integrator_bench.py --cells 16384 --proteins 32 --signals 28
+    python performance/integrator_bench.py --backend xla-fast,pallas --fleet-b 1,4
+
+``--backend`` names registry backends (:mod:`magicsoup_tpu.ops.backends`)
+and ``--fleet-b`` adds a leading world axis of size B to every input —
+the B x backend grid emits one machine-readable JSON row per point
+(``integrator_point`` key), which ``scripts/summarize_capture.py`` folds
+into ``published["integrator"]`` best-value-wins per point.  For the
+pallas backend the batched points run the 2D ``(B, cells//tile_c)``
+kernel grid — ONE launch for all B worlds; the XLA backends vmap.
 
 Timing method: median of N repetitions of K chained integrator steps
 (lax.scan under one jit), synchronised by a VALUE FETCH of one output
@@ -32,6 +41,16 @@ def main() -> None:
                     help="integrator steps fused under one jit")
     ap.add_argument("--reps", type=int, default=7)
     ap.add_argument("--tile-c", type=int, default=None)
+    ap.add_argument(
+        "--backend",
+        default="xla-fast,pallas",
+        help="comma list of registry backend names for the grid rows",
+    )
+    ap.add_argument(
+        "--fleet-b",
+        default="1",
+        help="comma list of leading world-axis sizes B for the grid rows",
+    )
     args = ap.parse_args()
 
     import jax
@@ -134,7 +153,8 @@ def main() -> None:
     if t_pal:
         print(f"Pallas effective HBM bw (if 1x): {min_bytes / t_pal / 1e9:.1f} GB/s")
 
-    # one machine-readable line for scripts/summarize_capture.py
+    # legacy machine-readable summary line (no "integrator_point" key,
+    # so scripts/summarize_capture.py keeps it as the flat fallback)
     import json
 
     print(
@@ -151,6 +171,76 @@ def main() -> None:
         ),
         flush=True,
     )
+
+    # ------------------------------------------------ backend x B grid
+    # one JSON row per (registry backend, world-axis B) point; the
+    # capture summarizer folds these into published["integrator"]
+    from magicsoup_tpu.ops import backends as _backends
+
+    names = [n.strip() for n in args.backend.split(",") if n.strip()]
+    fleet_bs = [int(v) for v in args.fleet_b.split(",") if v.strip()]
+    metric = (
+        f"integrator_ms_per_step[c={c},p={p},s={s},chain={args.chain}]"
+    )
+
+    def stacked_inputs(b):
+        # distinct per-world signal matrices (a broadcast X would let a
+        # sufficiently clever compiler dedupe the world axis), shared
+        # parameter tensors broadcast to the leading axis
+        scale = 1.0 + 1e-3 * jnp.arange(b, dtype=jnp.float32)
+        Xb = X[None] * scale[:, None, None]
+        Pb = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (b,) + a.shape), params
+        )
+        return Xb, Pb
+
+    def timed_point(fn, Xb, Pb):
+        out = fn(Xb, Pb)
+        float(out.reshape(-1)[0])  # compile + true barrier
+        vals = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = fn(Xb, Pb)
+            float(out.reshape(-1)[0])  # value fetch = true barrier
+            vals.append((time.perf_counter() - t0 - rtt) / args.chain)
+        return statistics.median(vals)
+
+    for name in names:
+        base_fn = _backends.integrator_fn(name)
+        for b in fleet_bs:
+            point = f"{name}.B{b}"
+            if b == 1:
+                Xb, Pb, fn = X, params, base_fn
+            else:
+                Xb, Pb = stacked_inputs(b)
+                # pallas takes the rank-3 batched 2D-grid path natively
+                # (one launch for all B worlds); XLA backends vmap
+                fn = base_fn if name == "pallas" else jax.vmap(base_fn)
+            try:
+                t_point = timed_point(chain(fn), Xb, Pb)
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"grid {point}: FAILED"
+                    f" {type(e).__name__}: {str(e)[:200]}"
+                )
+                continue
+            print(f"grid {point:20s} {t_point * 1e3:8.3f} ms/step")
+            print(
+                json.dumps(
+                    {
+                        "integrator_point": point,
+                        "backend_name": name,
+                        "fleet_b": b,
+                        "metric": metric,
+                        "unit": "ms",
+                        "value": round(t_point * 1e3, 3),
+                        "ms_per_step": round(t_point * 1e3, 3),
+                        "shape": [c, p, s],
+                        "backend": jax.default_backend(),
+                    }
+                ),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

@@ -14,6 +14,13 @@ diagnostics.  Hypotheses, each isolated as one rung of this ladder:
      (log-space) kernel body with `pow`/`prod` rewritten as
      exp-sum-log / unrolled multiply chains should compile.
 
+Rungs 12-13 exercise the PRODUCTION entry point
+(`integrate_signals_pallas`) rather than a hand-built ladder body: the
+batched 2D grid `(B, cells // tile_c)` (one launch for a whole fleet
+rung group) and the VMEM-budget tile table default (`select_tile_c`) —
+run them after any Mosaic platform update to confirm the shipping
+launch configurations still lower.
+
 Run on the TPU attachment (takes ~a minute per rung, mostly remote
 compile):
 
@@ -193,6 +200,38 @@ def main() -> None:
             )
         o_ref[:] = Y
 
+    def run_batched():
+        # the production batched entry: rank-3 X + params with a leading
+        # world axis -> 2D grid (B, c // tile_c), one launch for B worlds
+        from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+
+        B = 3
+        scale = 1.0 + 0.5 * jnp.arange(B, dtype=jnp.float32)
+        Xb = X[None] * scale[:, None, None]
+        pb = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (B,) + a.shape), params
+        )
+        out = integrate_signals_pallas(
+            Xb, pb, tile_c=tc, interpret=args.interpret
+        )
+        np.asarray(out)  # value fetch = true barrier
+        return out
+
+    def run_budget_tiled():
+        # the production default launch: tile_c from the VMEM-budget
+        # tile table instead of the ladder's fixed --tile-c
+        from magicsoup_tpu.ops.pallas_integrate import (
+            integrate_signals_pallas,
+            select_tile_c,
+        )
+
+        tile = select_tile_c(c, p, s)
+        print(f"        tile table picked tile_c={tile} for c={c}",
+              flush=True)
+        out = integrate_signals_pallas(X, params, interpret=args.interpret)
+        np.asarray(out)  # value fetch = true barrier
+        return out
+
     full_ins = [X, params.Ke, params.Kmf, params.Kmb, params.Kmr,
                 params.Vmax, params.N, params.Nf, params.Nb, params.A]
     full_specs = [bs_cs, bs_cp, bs_cp, bs_cp, bs_cps, bs_cp,
@@ -224,6 +263,9 @@ def main() -> None:
             k_full_part, full_ins, full_specs)),
         11: ("fast-mode full 3-trim kernel", lambda: call(
             k_full_3trim, full_ins, full_specs)),
+        12: ("batched 2D grid (production entry, B=3)", run_batched),
+        13: ("VMEM-budget tile table default (production entry)",
+             run_budget_tiled),
     }
 
     picks = (
@@ -245,7 +287,7 @@ def main() -> None:
             head = str(e).splitlines()[0][:160] if str(e) else repr(e)[:160]
             print(f"rung {r:2d} FAIL  {time.perf_counter()-t0:6.1f}s  {name}"
                   f"\n        {head}", flush=True)
-            if r in (9, 10, 11):
+            if r in (9, 10, 11, 12, 13):
                 traceback.print_exc(limit=3)
     print("summary:", " ".join(f"{r}:{v}" for r, v in results.items()),
           flush=True)

@@ -89,6 +89,14 @@ round-trip); and a token-backed pipelined steady state must run under
 (`analysis.runtime` ``genome_decode_calls`` census — no per-cell string
 work on the megastep).
 
+``--pallas`` runs the integrator-backend smoke (GATING): a
+``World(integrator="pallas")`` pipelined run (interpret-mode kernel on
+CPU, fast numeric mode — the backend registry refuses det mode).  Gates:
+the warm steady state must hold ``hot_path_guard(compile_budget=0)``,
+the fetch census must count exactly ONE host fetch per megastep, the
+``runtime.snapshot()`` integrator census must bill every megastep to the
+pallas backend, and the final world must pass ``check.audit_world``.
+
 ``--differential`` runs the graftcheck differential smoke (GATING): one
 seeded spawn/step/mutate/kill/divide/compact schedule driven through the
 classic World driver, the pipelined stepper at K=1 and K=4, and a 2-tile
@@ -155,6 +163,8 @@ def main() -> None:
     ap.add_argument("--serve", action="store_true")
     # graftpulse live-metrics smoke (see metrics_main below)
     ap.add_argument("--metrics", action="store_true")
+    # pallas integrator-backend smoke (see pallas_main below)
+    ap.add_argument("--pallas", action="store_true")
     args = ap.parse_args()
     if args.chaos_child:
         return chaos_child(args)
@@ -176,6 +186,8 @@ def main() -> None:
         return serve_main(args)
     if args.metrics:
         return metrics_main(args)
+    if args.pallas:
+        return pallas_main(args)
 
     import jax
 
@@ -1207,6 +1219,126 @@ def genome_main(args) -> None:
     )
     if problems:
         raise SystemExit("genome smoke FAILED: " + "; ".join(problems))
+
+
+def pallas_main(args) -> None:
+    """GATING integrator-backend smoke: a ``World(integrator="pallas")``
+    pipelined run with the kernel in interpret mode on CPU.
+
+    Gates, in order: the warm steady state must hold
+    ``hot_path_guard(compile_budget=0)``; the fetch census must count
+    exactly ONE host fetch per megastep; the ``runtime.snapshot()``
+    integrator census must bill every measured megastep to the pallas
+    backend; and the final world must pass ``check.audit_world``.
+    """
+    import os
+
+    # the pallas backend is fast-mode only — a deterministic-mode env
+    # left by a surrounding harness would make the World ctor refuse
+    os.environ.pop("MAGICSOUP_TPU_DETERMINISTIC", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from magicsoup_tpu.cache import ensure_compile_cache
+
+    ensure_compile_cache()
+
+    import random
+
+    import magicsoup_tpu as ms
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.check import audit_world
+    from magicsoup_tpu.telemetry import fetch_stats
+
+    mols = [
+        ms.Molecule("pls-a", 10e3),
+        ms.Molecule("pls-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    rng = random.Random(args.seed)
+    world = ms.World(
+        chemistry=chem,
+        map_size=args.map_size,
+        seed=args.seed,
+        integrator="pallas",
+    )
+    world.spawn_cells(
+        [
+            ms.random_genome(s=args.genome_size, rng=rng)
+            for _ in range(args.n_cells)
+        ]
+    )
+    # chemistry-only dynamics: the capacity freezes after the first
+    # step, which is what makes the zero-compile steady state gateable
+    st = ms.PipelinedStepper(
+        world,
+        mol_name="pls-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=args.genome_size,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=args.megastep,
+    )
+    for _ in range(args.warmup + 1):
+        st.step()
+    st.drain()
+
+    problems = []
+    f0 = fetch_stats()["fetches"]
+    d0 = runtime.snapshot().get("integrator_dispatches_pallas", 0)
+    t0 = time.perf_counter()
+    try:
+        with runtime.hot_path_guard(compile_budget=0):
+            for _ in range(args.steps):
+                st.step()
+            st.drain()
+    except runtime.CompileBudgetExceeded as e:
+        problems.append(str(e))
+    dt = time.perf_counter() - t0
+    fetches = fetch_stats()["fetches"] - f0
+    pallas_n = runtime.snapshot().get("integrator_dispatches_pallas", 0) - d0
+    st.flush()
+    st.check_consistency()
+
+    if fetches != args.steps:
+        problems.append(
+            f"fetch census: {fetches} fetches for {args.steps} megasteps"
+            " (want exactly one per megastep)"
+        )
+    if pallas_n != args.steps:
+        problems.append(
+            f"integrator census: {pallas_n} pallas dispatches for"
+            f" {args.steps} megasteps (want exactly one per megastep)"
+        )
+    audit = audit_world(world)
+    if audit:
+        problems.append(f"audit: {[str(v) for v in audit]}")
+    per_step = args.steps * args.megastep / dt if dt > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"pallas smoke ({args.n_cells} cells, "
+                    f"{args.map_size}x{args.map_size} map, "
+                    "interpret, cpu)"
+                ),
+                "value": 0.0 if problems else 1.0,
+                "unit": "pass",
+                "steps_per_s": round(per_step, 4),
+                "fetches_per_megastep": fetches / max(args.steps, 1),
+                "pallas_dispatches": pallas_n,
+                "final_n_cells": world.n_cells,
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    if problems:
+        raise SystemExit("pallas smoke FAILED: " + "; ".join(problems))
 
 
 def fleet_chaos_main(args) -> None:

@@ -100,7 +100,9 @@ run() {
 # window+commit), then the 40k/det/diffusion preset validations, then the
 # Mosaic ladder and wider sweeps.
 run bench           1800 python bench.py
-run integrator       600 python performance/integrator_bench.py
+# backend x B grid: xla-fast vs the batched 2D-grid pallas kernel at
+# B in {1,4} — one JSON row per point for published["integrator"]
+run integrator       900 python performance/integrator_bench.py --backend xla-fast,pallas --fleet-b 1,4
 # 1800 s: a DIVERGING bitrepro re-runs both children to quantify ULP
 # magnitudes (scripts/bitrepro.py _divergence_magnitudes), roughly
 # doubling its runtime — and a conclusive divergence verdict is worth

@@ -10,10 +10,12 @@ Usage:
 Reads every `<harness>.log` in the directory, extracts the LAST JSON
 result line of each (the harnesses stream partial results first — the
 last line is the most complete; bench.py marks its early classic line
-with a " [classic]" metric suffix), plus bitrepro's verdict object, and
-prints one combined JSON document.  `--publish` writes the per-config
-steps/s (and the bitrepro verdict) into BASELINE.json so the measured
-record lives next to the target it is judged against.
+with a " [classic]" metric suffix), plus bitrepro's verdict object and
+the integrator bench's per-(backend, B) grid rows, and prints one
+combined JSON document.  `--publish` writes the per-config steps/s (and
+the bitrepro verdict, and the integrator points best-value-wins) into
+BASELINE.json so the measured record lives next to the target it is
+judged against.
 """
 import argparse
 import json
@@ -262,11 +264,23 @@ def summarize(outdir: Path) -> dict:
     reps = [r for r in _json_lines(outdir / "bitrepro.log") if "result" in r]
     if reps:
         summary["bitrepro"] = reps[-1]
-    integ = [
+    integ_rows = [
         r for r in _json_lines(outdir / "integrator.log") if "ms_per_step" in r
     ]
-    if integ:
-        summary["integrator"] = integ[-1]
+    # grid rows carry "integrator_point" ("<backend>.B<b>"); a log from
+    # an older bench has only the flat summary line, kept as fallback
+    ipoints: dict = {}
+    for r in integ_rows:
+        key = r.get("integrator_point")
+        if key is None:
+            continue
+        if "error" in r and "error" not in ipoints.get(key, {"error": 1}):
+            continue  # keep an existing clean row over a later error
+        ipoints[key] = r
+    if ipoints:
+        summary["integrator"] = ipoints
+    elif integ_rows:
+        summary["integrator"] = integ_rows[-1]
     tel = _telemetry_summary(outdir / "telemetry.jsonl")
     if tel is not None:
         summary["telemetry"] = tel
@@ -416,7 +430,43 @@ def publish(summary: dict) -> None:
             "capture_dir": summary["capture_dir"],
         }
         merged = True
-    for key in ("bitrepro", "integrator"):
+    integ = summary.get("integrator")
+    if integ and all(
+        isinstance(v, dict) and "integrator_point" in v
+        for v in integ.values()
+    ):
+        pub_integ = published.setdefault("integrator", {})
+        if not all(isinstance(v, dict) for v in pub_integ.values()):
+            # a legacy flat record (pre-grid bench) can't merge with
+            # per-point entries — the grid supersedes it wholesale
+            pub_integ = {}
+            published["integrator"] = pub_integ
+        for point, entry in integ.items():
+            if "error" in entry:
+                continue
+            # per-(backend, B)-point best-value-wins; integrator rows
+            # are ms per step (LOWER is better, like check_ops seconds),
+            # with the same metric-match overwrite rule as the bench
+            # entries: a changed workload renames the metric and must
+            # overwrite rather than chase a stale record
+            prev = pub_integ.get(point)
+            if (
+                isinstance(prev, dict)
+                and prev.get("metric") == entry.get("metric")
+                and prev.get("value", 0) <= entry.get("value", 0)
+            ):
+                continue
+            pub_integ[point] = {
+                **entry, "capture_dir": summary["capture_dir"]
+            }
+            merged = True
+    elif integ and "error" not in integ:
+        # legacy flat integrator row — last clean capture wins wholesale
+        published["integrator"] = {
+            **integ, "capture_dir": summary["capture_dir"]
+        }
+        merged = True
+    for key in ("bitrepro",):
         entry = summary.get(key)
         # same cleanliness rule as the bench entries: an errored verdict
         # (e.g. bitrepro's {"result": "error"} after a tunnel drop) must

@@ -107,6 +107,14 @@ python performance/smoke.py --serve
 # /healthz must carry the live queue_depth / oldest_command_age_s
 # fields.  Exits nonzero on any violation.
 python performance/smoke.py --metrics
+# integrator-backend smoke (GATING): a World(integrator="pallas")
+# pipelined run with the kernel in interpret mode — the warm steady
+# state must hold hot_path_guard(compile_budget=0), the fetch census
+# must count exactly ONE host fetch per megastep, the runtime
+# integrator census must bill every megastep to the pallas backend
+# (ops/backends.py registry routing, not a bypass), and the final
+# world must pass check.audit_world.  Exits nonzero on any violation.
+python performance/smoke.py --pallas
 # graftchaos campaign gate (GATING): the fast subset of the chaos
 # matrix (performance/chaos_matrix.py) — checkpoint ENOSPC mid-save
 # (counted, next save lands, no torn file), torn-write walk-back,

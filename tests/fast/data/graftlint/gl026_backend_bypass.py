@@ -1,0 +1,10 @@
+"""GL026 fixture: hot stepper-scoped function calling an integrator
+kernel directly instead of routing through the backend registry."""
+from magicsoup_tpu import stepper  # noqa: F401  (marks the module stepper-scoped)
+from magicsoup_tpu.ops.integrate import integrate_signals
+
+
+# graftlint: hot
+def step_activity(X, params):
+    X1 = integrate_signals(X, params, det=False)  # GL026: direct kernel call in hot path
+    return X1

@@ -534,6 +534,124 @@ def test_publish_genome_ops_lower_is_better(tmp_path, monkeypatch):
     assert out["mutate.token.8000"]["capture_dir"].endswith("cap-0.3")
 
 
+def _integ_row(
+    backend: str, b: int, value: float, *, error: str | None = None
+) -> str:
+    row = {
+        "integrator_point": f"{backend}.B{b}",
+        "backend_name": backend,
+        "fleet_b": b,
+        "metric": "integrator_ms_per_step[c=16384,p=32,s=28,chain=10]",
+        "unit": "ms",
+        "value": value,
+        "ms_per_step": value,
+        "shape": [16384, 32, 28],
+        "backend": "tpu",
+    }
+    if error is not None:
+        row["error"] = error
+    return json.dumps(row)
+
+
+_INTEG_LEGACY = json.dumps(
+    {
+        "ms_per_step": 9.9,
+        "pallas_ms_per_step": 5.5,
+        "shape": [16384, 32, 28],
+        "rtt_ms": 12.0,
+        "backend": "tpu",
+    }
+)
+
+
+def test_summarize_integrator_per_point_rows(tmp_path):
+    # performance/integrator_bench.py prints one row per (registry
+    # backend, world-axis B) point; the summary keys them
+    # "{backend}.B{b}", last clean row per point wins, and the legacy
+    # flat summary line is superseded when any grid row exists
+    (tmp_path / "integrator.log").write_text(
+        _INTEG_LEGACY
+        + "\n"
+        + _integ_row("xla-fast", 1, 4.2)
+        + "\n"
+        + _integ_row("pallas", 1, 0.0, error="mosaic crash")
+        + "\n"
+        + _integ_row("pallas", 1, 2.1)
+        + "\n"
+        + _integ_row("pallas", 4, 1.4)
+        + "\n"
+    )
+    summary = summarize_capture.summarize(tmp_path)
+    integ = summary["integrator"]
+    assert integ["xla-fast.B1"]["value"] == 4.2
+    assert integ["pallas.B1"]["value"] == 2.1
+    assert "error" not in integ["pallas.B1"]  # clean row beat the error
+    assert integ["pallas.B4"]["value"] == 1.4
+    assert "ms_per_step" not in integ  # flat line did not leak in
+
+
+def test_summarize_integrator_legacy_flat_fallback(tmp_path):
+    # a log from an older bench (no grid rows) keeps the flat schema
+    (tmp_path / "integrator.log").write_text(_INTEG_LEGACY + "\n")
+    summary = summarize_capture.summarize(tmp_path)
+    assert summary["integrator"]["ms_per_step"] == 9.9
+
+
+def test_publish_integrator_lower_is_better_per_point(tmp_path, monkeypatch):
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}) + "\n")
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+
+    def pub(rows: list[str], tag: str) -> dict:
+        cap = tmp_path / f"cap-{tag}"
+        cap.mkdir(exist_ok=True)
+        (cap / "integrator.log").write_text("\n".join(rows) + "\n")
+        summarize_capture.publish(summarize_capture.summarize(cap))
+        return json.loads(baseline.read_text())["published"]["integrator"]
+
+    out = pub([_integ_row("xla-fast", 1, 4.2), _integ_row("pallas", 1, 2.1)], "a")
+    assert out["xla-fast.B1"]["value"] == 4.2
+    assert out["pallas.B1"]["value"] == 2.1
+    # ms/step are lower-is-better: a faster later window upgrades one
+    # point without degrading the other, and errored points are refused
+    out = pub(
+        [
+            _integ_row("xla-fast", 1, 3.9),
+            _integ_row("pallas", 1, 2.8),
+            _integ_row("pallas", 4, 0.0, error="tunnel dropped"),
+        ],
+        "b",
+    )
+    assert out["xla-fast.B1"]["value"] == 3.9  # upgraded (faster)
+    assert out["pallas.B1"]["value"] == 2.1  # best record kept
+    assert "pallas.B4" not in out  # error never published
+    # provenance: each point carries the capture dir it was measured in
+    assert out["xla-fast.B1"]["capture_dir"].endswith("cap-b")
+    assert out["pallas.B1"]["capture_dir"].endswith("cap-a")
+
+
+def test_publish_integrator_grid_supersedes_legacy_flat(tmp_path, monkeypatch):
+    # a pre-grid flat record in BASELINE.json cannot merge with per-point
+    # entries — the first grid capture replaces it wholesale
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(
+        json.dumps(
+            {"published": {"integrator": {"ms_per_step": 9.9, "backend": "tpu"}}}
+        )
+        + "\n"
+    )
+    monkeypatch.setattr(summarize_capture, "_REPO", tmp_path)
+    cap = tmp_path / "cap-grid"
+    cap.mkdir()
+    (cap / "integrator.log").write_text(_integ_row("pallas", 4, 1.4) + "\n")
+    summarize_capture.publish(summarize_capture.summarize(cap))
+    out = json.loads(baseline.read_text())["published"]["integrator"]
+    assert out == {
+        "pallas.B4": {**json.loads(_integ_row("pallas", 4, 1.4)),
+                      "capture_dir": str(cap)},
+    }
+
+
 def _telemetry_lines(phase_ms: list[float], *, bad_counter: bool = False) -> str:
     # a minimal valid graftscope stream: meta, counters, steps, dispatch
     # rows with one timed phase, closing counters

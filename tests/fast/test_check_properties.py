@@ -147,8 +147,15 @@ def test_empty_world_audits_clean():
     "golden_file", sorted(p.name for p in GOLDEN.glob("*.json"))
 )
 def test_golden_trajectory_structural_digests(golden_file, monkeypatch):
-    monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
     rec = json.loads((GOLDEN / golden_file).read_text())
+    if rec["path"] in differential.PALLAS_PATHS:
+        # the pallas backend is fast-mode only: its golden trajectory
+        # runs (and was generated) WITHOUT deterministic mode — the
+        # structural digest is float-free, so it pins the trajectory
+        # regardless of the numeric mode
+        monkeypatch.delenv("MAGICSOUP_TPU_DETERMINISTIC", raising=False)
+    else:
+        monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
     assert rec["schema"] == "magicsoup_tpu.check.golden/1"
     assert rec["boundaries"] == list(differential.BOUNDARIES)
     got = differential.run_path(

@@ -544,7 +544,7 @@ def test_sharded_fleet_step_matches_unsharded():
         max_div=first.max_divisions,
         n_rounds=first.n_rounds,
         k=first.megastep,
-        use_pallas=False,
+        integrator="xla-det",
     )
     args = (
         group.fstate,

@@ -69,6 +69,7 @@ def marked_line(path: Path, code: str) -> int:
         ("gl023_host_genome.py", "GL023"),
         ("gl024_group_loop.py", "GL024"),
         ("gl025_bare_clock.py", "GL025"),
+        ("gl026_backend_bypass.py", "GL026"),
     ],
 )
 def test_rule_detects_fixture_violation(fixture, code):
@@ -209,6 +210,56 @@ def test_gl025_scoped_to_stepper_fleet_serve(tmp_path):
     p = tmp_path / "gl025_not_scoped.py"
     p.write_text(stripped)
     assert analyze([p], rules=["GL025"]) == []
+
+
+def test_gl026_waivable_deliberate_direct_call(tmp_path):
+    # a deliberate direct kernel call (e.g. a parity harness comparing
+    # backends side by side) waives with the standard inline
+    # annotation; pin that the machinery covers GL026
+    src = (FIXTURES / "gl026_backend_bypass.py").read_text()
+    waived = src.replace(
+        "# GL026: direct kernel call in hot path",
+        "# graftlint: disable=GL026 fixture",
+    )
+    assert waived != src
+    p = tmp_path / "gl026_waived.py"
+    p.write_text(waived)
+    assert analyze([p]) == []
+
+
+def test_gl026_scoped_to_stepper_fleet_serve(tmp_path):
+    # the SAME direct call is silent once the module stops being
+    # stepper-scoped: ops/backends.py itself (and bench/parity
+    # harnesses) legitimately name the kernels, so flagging every
+    # module would be noise
+    src = (FIXTURES / "gl026_backend_bypass.py").read_text()
+    stripped = src.replace(
+        "from magicsoup_tpu import stepper"
+        "  # noqa: F401  (marks the module stepper-scoped)",
+        "",
+    )
+    assert stripped != src
+    p = tmp_path / "gl026_not_scoped.py"
+    p.write_text(stripped)
+    assert analyze([p], rules=["GL026"]) == []
+
+
+def test_gl026_registry_routed_call_is_sanctioned(tmp_path):
+    # the fix the rule asks for — dispatching through the backend
+    # registry with the resolved name — must lint clean
+    src = (FIXTURES / "gl026_backend_bypass.py").read_text()
+    routed = src.replace(
+        "from magicsoup_tpu.ops.integrate import integrate_signals",
+        "from magicsoup_tpu.ops import backends as _backends",
+    ).replace(
+        "    X1 = integrate_signals(X, params, det=False)"
+        "  # GL026: direct kernel call in hot path",
+        '    X1 = _backends.integrate("xla-fast", X, params)',
+    )
+    assert routed != src
+    p = tmp_path / "gl026_routed.py"
+    p.write_text(routed)
+    assert analyze([p], rules=["GL026"]) == []
 
 
 def test_gl023_scoped_to_stepper_fleet_serve(tmp_path):

@@ -140,7 +140,7 @@ def test_megastep_det_mode_bit_identical_to_serial_steps(compact):
         max_div=st.max_divisions,
         n_rounds=st.n_rounds,
         q=None,
-        use_pallas=False,
+        integrator="xla-det",
     )
     copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
 
@@ -199,7 +199,7 @@ def test_step_dispatch_donates_input_buffers():
         n_rounds=st.n_rounds,
         compact=False,
         q=None,
-        use_pallas=False,
+        integrator="xla-fast",
     ).as_text()
     n_leaves = len(jax.tree_util.tree_leaves((st._state, st.kin.params)))
     assert lowered.count("tf.aliasing_output") == n_leaves

@@ -137,6 +137,43 @@ def test_metric_names_stable_across_restarts(tmp_path):
     assert "magicsoup_device_ms_total" in names1
     assert "magicsoup_command_queue_depth" in names1
     assert "magicsoup_oldest_command_age_seconds" in names1
+    assert "magicsoup_integrator_dispatches_total" in names1
+    assert types1["magicsoup_integrator_dispatches_total"] == "counter"
+
+
+def test_integrator_dispatches_labeled_per_backend(tmp_path):
+    # the per-backend integrator census rides its own labeled family —
+    # one series per ops.backends registry name, not a generic
+    # runtime_total{counter=...} row
+    from magicsoup_tpu.analysis import runtime as rt
+
+    svc = _service(tmp_path)
+    try:
+        svc._execute("create", _spec("acme"))
+        svc._execute("step", {"tenant": "acme", "megasteps": 1})
+        _drain(svc)
+        snap = rt.snapshot()
+        backends = {
+            k[len("integrator_dispatches_"):]: v
+            for k, v in snap.items()
+            if k.startswith("integrator_dispatches_")
+        }
+        assert backends, "serving a megastep must count a dispatch"
+        parsed = pulse.parse_exposition(svc.metrics_text())
+        for name, count in backends.items():
+            assert pulse.sample_value(
+                parsed,
+                "magicsoup_integrator_dispatches_total",
+                backend=name,
+            ) >= count
+        # and the generic counter-name family does NOT duplicate them
+        for s in parsed["samples"]:
+            if s["name"] == "magicsoup_runtime_total":
+                assert not s["labels"]["counter"].startswith(
+                    "integrator_dispatches_"
+                )
+    finally:
+        svc._shutdown()
 
 
 # ------------------------------------------- device-time attribution

@@ -13,8 +13,14 @@ import pytest
 
 import magicsoup_tpu as ms
 from magicsoup_tpu.examples.wood_ljungdahl import CHEMISTRY
+from magicsoup_tpu.ops import backends
 from magicsoup_tpu.ops.integrate import integrate_signals
-from magicsoup_tpu.ops.pallas_integrate import integrate_signals_pallas
+from magicsoup_tpu.ops.pallas_integrate import (
+    integrate_signals_pallas,
+    select_tile_c,
+    tile_vmem_bytes,
+    vmem_budget,
+)
 from magicsoup_tpu.util import random_genome
 
 
@@ -115,6 +121,156 @@ def test_world_use_pallas_rejects_mesh():
         )
 
 
+# ------------------------------------------------ batched world axis
+def test_pallas_batched_grid_bit_equal_per_world():
+    """The 2D-grid ``(B, cells//tile_c)`` launch: each world of a B=3
+    batch must come out BIT-equal to its own B=1 launch at the same
+    ``tile_c`` — tiles never cross the world axis, and the batched
+    kernel body squeezes to the exact rank-2 trim pass."""
+    world = _world_with_cells(48, seed=3)
+    cap = world._capacity
+    s2 = 2 * world.n_molecules
+    params0 = world.kinetics.params
+    # three distinct per-world parameter sets at one shape: scale the
+    # velocity ceiling per world (a fleet rung group shares shapes, not
+    # values)
+    per_world_params = [
+        type(params0)(
+            *(
+                np.asarray(t) * np.float32(f)
+                if name == "Vmax"
+                else np.asarray(t)
+                for name, t in zip(params0._fields, params0)
+            )
+        )
+        for f in (1.0, 0.5, 2.0)
+    ]
+    Xs, solo = [], []
+    tile = 16
+    for i, pw in enumerate(per_world_params):
+        nprng = np.random.default_rng(100 + i)
+        X = nprng.random((cap, s2), dtype=np.float32) * 5.0
+        Xs.append(X)
+        solo.append(
+            np.asarray(
+                integrate_signals_pallas(X, pw, tile_c=tile, interpret=True)
+            )
+        )
+    Xb = np.stack(Xs)
+    params_b = type(params0)(
+        *(
+            np.stack([np.asarray(getattr(pw, f)) for pw in per_world_params])
+            for f in params0._fields
+        )
+    )
+    out = np.asarray(
+        integrate_signals_pallas(Xb, params_b, tile_c=tile, interpret=True)
+    )
+    assert out.shape == (3, cap, s2)
+    for i in range(3):
+        assert out[i].tobytes() == solo[i].tobytes(), f"world {i} diverged"
+
+
+# ------------------------------------------------------- tile table
+def test_tile_vmem_bytes_hand_math():
+    # per 16-cell tile at (p=8, s=12): X in+out 2*12*4 = 96B/row,
+    # Ke/Kmf/Kmb/Vmax 4*8*4 = 128, Kmr 8*12*4 = 384, the four i16
+    # domain tensors 4*8*12*2 = 768, two live f32 intermediates
+    # 2*8*12*4 = 768 -> 2144 B/row * 16 rows
+    assert tile_vmem_bytes(16, 8, 12) == 16 * 2144 == 34304
+
+
+def test_select_tile_c_prefers_largest_fitting_divisor():
+    # (p=32, s=12): 8288 B/row.  256 rows = 2_121_728 B busts a 1.5 MiB
+    # budget; 128 rows = 1_060_864 B fits -> the table picks 128 (the
+    # old gcd(c,128) answer, now derived from the budget)
+    assert tile_vmem_bytes(1, 32, 12) == 8288
+    assert select_tile_c(256, 32, 12, budget=1_500_000) == 128
+    # with room for the whole capacity, one grid step is best
+    assert select_tile_c(256, 32, 12, budget=4_000_000) == 256
+
+
+def test_select_tile_c_whole_capacity_is_always_admissible():
+    # an odd capacity has no multiple-of-8 divisor, but the whole array
+    # as ONE tile needs no sublane alignment — small odd batches run
+    assert select_tile_c(63, 8, 12, budget=8 * 1024 * 1024) == 63
+
+
+def test_select_tile_c_degenerate_odd_capacity_refuses():
+    # the legacy gcd(c, 128) heuristic silently returned tile_c=1 here
+    # (one grid step PER CELL); the table refuses with a typed error
+    # naming the budget knob instead
+    with pytest.raises(ValueError, match="no usable pallas tile"):
+        select_tile_c(63, 8, 12, budget=tile_vmem_bytes(63, 8, 12) - 1)
+    with pytest.raises(
+        ValueError, match="MAGICSOUP_TPU_PALLAS_VMEM_BUDGET"
+    ):
+        select_tile_c(63, 8, 12, budget=1)
+
+
+def test_vmem_budget_env_knob(monkeypatch):
+    monkeypatch.setenv("MAGICSOUP_TPU_PALLAS_VMEM_BUDGET", "1500000")
+    assert vmem_budget() == 1_500_000
+    # the default table reads the knob
+    assert select_tile_c(256, 32, 12) == 128
+    monkeypatch.delenv("MAGICSOUP_TPU_PALLAS_VMEM_BUDGET")
+    assert vmem_budget() == 8 * 1024 * 1024
+
+
+# ------------------------------------------------- backend registry
+def test_registry_capability_flags_pinned():
+    assert set(backends.REGISTRY) == {"xla-fast", "xla-det", "pallas"}
+    assert backends.get_backend("xla-det").det_able
+    assert not backends.get_backend("pallas").det_able
+    assert not backends.get_backend("pallas").mesh_able
+    assert backends.get_backend("pallas").fleet_batchable
+    assert not backends.get_backend("xla-det").mosaic_safe
+    with pytest.raises(ValueError, match="unknown integrator backend"):
+        backends.get_backend("tpu-magic")
+
+
+def test_world_integrator_constructor_and_env(monkeypatch):
+    w = ms.World(chemistry=CHEMISTRY, map_size=32, seed=1, integrator="pallas")
+    assert w.integrator == "pallas" and w.use_pallas
+    monkeypatch.setenv("MAGICSOUP_TPU_INTEGRATOR", "pallas")
+    w2 = ms.World(chemistry=CHEMISTRY, map_size=32, seed=1)
+    assert w2.integrator == "pallas"
+    # explicit argument outranks the env var
+    monkeypatch.setenv("MAGICSOUP_TPU_INTEGRATOR", "xla-fast")
+    w3 = ms.World(chemistry=CHEMISTRY, map_size=32, seed=1, integrator="pallas")
+    assert w3.integrator == "pallas"
+    with pytest.raises(ValueError, match="unknown integrator backend"):
+        ms.World(chemistry=CHEMISTRY, map_size=32, seed=1, integrator="nope")
+
+
+def test_world_integrator_follows_numeric_mode_when_unpinned():
+    w = ms.World(chemistry=CHEMISTRY, map_size=32, seed=1)
+    assert w.integrator == "xla-fast"
+    w.deterministic = True
+    assert w.integrator == "xla-det"
+    w.deterministic = False
+    assert w.integrator == "xla-fast"
+
+
+def test_world_integrator_pallas_rejects_det(monkeypatch):
+    monkeypatch.setenv("MAGICSOUP_TPU_DETERMINISTIC", "1")
+    with pytest.raises(ValueError, match="deterministic"):
+        ms.World(
+            chemistry=CHEMISTRY, map_size=32, seed=1, integrator="pallas"
+        )
+
+
+def test_world_integrator_conflicting_legacy_flag():
+    with pytest.raises(ValueError, match="conflicts"):
+        ms.World(
+            chemistry=CHEMISTRY,
+            map_size=32,
+            seed=1,
+            integrator="xla-fast",
+            use_pallas=True,
+        )
+
+
 def test_pallas_integrator_parity_at_scale_with_flips():
     """A larger evolved population where borderline cells DO flip an
     equilibrium increment between the bodies — the parity contract
@@ -137,3 +293,148 @@ def test_pallas_integrator_parity_at_scale_with_flips():
         integrate_signals_pallas(X, params, tile_c=tile, interpret=True)
     )
     _assert_parity(out, np.concatenate(ref_tiles))
+
+
+# ------------------------------------------ fleet acceptance (B=3)
+@pytest.mark.slow
+def test_fleet_b3_pallas_one_dispatch_bit_identical_to_solo():
+    """The acceptance pin: a B=3 fleet megastep with the pallas backend
+    dispatches ONE integrator program (runtime dispatch census) and each
+    world's record is bit-identical to its own solo pallas run
+    (interpret mode, CPU).
+
+    Bit-identity scope: every INTEGER record lane (alive, rows,
+    occupancy, kills/divisions/spawned, genome stats) and the full
+    replayed structural state (cell count, genomes, positions,
+    lifetimes) — byte for byte.  The two float telemetry lanes
+    (mm_mass/cm_mass) and the concentration tensors are pinned at
+    1-ULP tolerance instead: they ride fast-mode XLA reductions that
+    the solo and scanned-fleet programs may legitimately reassociate
+    (the same reassociation freedom that makes fast mode non-det-able
+    — det mode pins them bit-exact, and pallas is fast-mode only by
+    capability flag)."""
+    import json
+    import math
+
+    from magicsoup_tpu.analysis import runtime
+    from magicsoup_tpu.fleet import FleetScheduler
+    from magicsoup_tpu.stepper import PipelinedStepper
+
+    mols = [
+        ms.Molecule("pk-a", 10e3),
+        ms.Molecule("pk-atp", 8e3, half_life=100_000),
+    ]
+    chem = ms.Chemistry(molecules=mols, reactions=[([mols[0]], [mols[1]])])
+    kw = dict(
+        mol_name="pk-atp",
+        kill_below=-1.0,
+        divide_above=1e30,
+        divide_cost=0.0,
+        target_cells=None,
+        genome_size=200,
+        lag=1,
+        p_mutation=0.0,
+        p_recombination=0.0,
+        megastep=2,
+    )
+
+    def _pallas_world(seed):
+        w = ms.World(
+            chemistry=chem, map_size=16, seed=seed, integrator="pallas"
+        )
+        rng = random.Random(seed)
+        w.spawn_cells([random_genome(s=200, rng=rng) for _ in range(12)])
+        return w
+
+    _FLOAT_LANES = ("mm_mass", "cm_mass", "genome_len_mean")
+
+    def _step_rows(path):
+        rows = [
+            json.loads(line)
+            for line in path.read_text().splitlines()
+            if line.strip()
+        ]
+        return [r for r in rows if r.get("type") == "step"]
+
+    def _split(rows):
+        ints = [
+            {k: v for k, v in r.items() if k not in _FLOAT_LANES}
+            for r in rows
+        ]
+        floats = [
+            {k: r[k] for k in _FLOAT_LANES if k in r} for r in rows
+        ]
+        return ints, floats
+
+    def _fingerprint(world):
+        import jax
+
+        n = world.n_cells
+        return {
+            "n": n,
+            "genomes": "\x00".join(world.cell_genomes),
+            "pos": np.asarray(world.cell_positions).tobytes(),
+            "lt": np.asarray(world.cell_lifetimes).tobytes(),
+            "div": np.asarray(world.cell_divisions).tobytes(),
+        }, (
+            np.asarray(jax.device_get(world.molecule_map)),
+            np.asarray(world.cell_molecules)[:n],
+        )
+
+    import tempfile
+    from pathlib import Path
+
+    seeds = (7, 11, 17)
+    solo_prints, solo_rows = [], []
+    td = Path(tempfile.mkdtemp(prefix="pallas_fleet_"))
+    for s in seeds:
+        st = PipelinedStepper(_pallas_world(s), **kw)
+        p = td / f"solo{s}.jsonl"
+        st.telemetry.attach(p)
+        st.step()
+        st.step()
+        st.flush()
+        st.telemetry.flush()
+        st.telemetry.detach()
+        solo_prints.append(_fingerprint(st.world))
+        solo_rows.append(_step_rows(p))
+
+    fleet = FleetScheduler(block=4)
+    lanes = [fleet.admit(_pallas_world(s), **kw) for s in seeds]
+    fleet_paths = []
+    for i, lane in enumerate(lanes):
+        p = td / f"fleet{i}.jsonl"
+        lane.telemetry.attach(p)
+        fleet_paths.append(p)
+    fleet.step()  # warm dispatch (cold compile)
+    fleet.drain()
+    assert len(fleet._groups) == 1, "3 same-rung worlds must share a group"
+
+    runtime.reset_counters()
+    fleet.step()
+    fleet.drain()
+    snap = runtime.snapshot()
+    # ONE physical integrator dispatch carried all three worlds
+    assert snap["integrator_dispatches_pallas"] == 1, snap
+    fleet.flush()
+    for lane in lanes:
+        lane.telemetry.flush()
+        lane.telemetry.detach()
+
+    for i, lane in enumerate(lanes):
+        label = f"world {i} (seed {seeds[i]})"
+        # integer record lanes: byte-for-byte
+        solo_ints, solo_floats = _split(solo_rows[i])
+        got_ints, got_floats = _split(_step_rows(fleet_paths[i]))
+        assert got_ints == solo_ints, f"{label}: record lanes diverged"
+        # float record lanes: 1-ULP (fast-mode reassociation)
+        for a, b in zip(solo_floats, got_floats):
+            for k2 in a:
+                assert math.isclose(
+                    a[k2], b[k2], rel_tol=1e-6
+                ), f"{label}: {k2} {a[k2]} vs {b[k2]}"
+        got_struct, got_f = _fingerprint(lane.world)
+        want_struct, want_f = solo_prints[i]
+        assert got_struct == want_struct, f"{label}: structural state diverged"
+        for a, b in zip(want_f, got_f):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=0)

@@ -100,7 +100,7 @@ def test_sharded_step_matches_unsharded():
     n_dev = jnp.asarray(world.n_cells, dtype=jnp.int32)
 
     # unsharded reference result
-    ref_mm, ref_cm = _get_activity_fn(det=False, pallas=False)(
+    ref_mm, ref_cm = _get_activity_fn("xla-fast")(
         world.molecule_map,
         world._cell_molecules,
         world._positions_dev,

@@ -187,7 +187,7 @@ def test_sharded_pipelined_step_collective_budget():
         n_rounds=st.n_rounds,
         compact=False,
         q=st._cap,
-        use_pallas=False,
+        integrator="xla-fast",
         mesh=mesh,
     )
 
